@@ -1,0 +1,92 @@
+"""Benchmark: tabular training samples/sec/chip on the flagship model.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+
+Baseline (BASELINE.md): >= 10M samples/sec on a v5e-16 slice == 625k
+samples/sec/chip, training the Shifu parity MLP (BASELINE config ladder #1/#2
+shape). The bench times the full jitted train step (fwd+bwd+Adadelta update,
+weighted-MSE loss) on synthetic device-resident data, so it measures the
+compute path the way the reference's hot loop ran sess.run([train_step, ...])
+(reference: resources/ssgd_monitor.py:271-276) minus host I/O.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_SAMPLES_PER_SEC_PER_CHIP = 10_000_000 / 16  # v5e-16 north star
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.config import (
+        DataConfig, JobConfig, ModelSpec, OptimizerConfig, TrainConfig)
+    from shifu_tpu.data import synthetic
+    from shifu_tpu.parallel import data_parallel_mesh, shard_batch
+    from shifu_tpu.train import init_state, make_train_step
+
+    num_features = 30
+    batch_size = 65536
+    schema = synthetic.make_schema(num_features=num_features)
+    job = JobConfig(
+        schema=schema,
+        data=DataConfig(batch_size=batch_size),
+        model=ModelSpec(
+            model_type="mlp",
+            hidden_nodes=(100, 100, 100),
+            activations=("relu", "relu", "relu"),
+            compute_dtype="bfloat16",
+        ),
+        train=TrainConfig(
+            epochs=1,
+            loss="weighted_mse",
+            optimizer=OptimizerConfig(name="adadelta", learning_rate=0.003),
+        ),
+    ).validate()
+
+    n_chips = len(jax.devices())
+    mesh = data_parallel_mesh() if n_chips > 1 else None
+
+    state = init_state(job, num_features, mesh)
+    train_step = make_train_step(job, mesh, donate=True)
+
+    rng = np.random.default_rng(0)
+    host_batch = {
+        "features": rng.standard_normal((batch_size, num_features)).astype(np.float32),
+        "target": (rng.random((batch_size, 1)) < 0.5).astype(np.float32),
+        "weight": np.ones((batch_size, 1), np.float32),
+    }
+    if mesh is not None:
+        batch = shard_batch(host_batch, mesh)
+    else:
+        batch = {k: jax.device_put(jnp.asarray(v)) for k, v in host_batch.items()}
+
+    # warmup / compile
+    state, m = train_step(state, batch)
+    jax.block_until_ready(m["loss"])
+
+    steps = 50
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = train_step(state, batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = steps * batch_size / dt
+    per_chip = samples_per_sec / n_chips
+    print(json.dumps({
+        "metric": "tabular_train_samples_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

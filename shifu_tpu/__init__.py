@@ -1,0 +1,25 @@
+"""shifu_tpu — a TPU-native (JAX/XLA/pjit/Pallas) training and scoring framework
+with the capabilities of PayPal's shifu-tensorflow (TF-on-YARN backend of the
+Shifu tabular-ML pipeline).
+
+Where the reference runs synchronous data-parallel SGD over a parameter-server
+topology on YARN (reference: shifu-tensorflow-on-yarn/src/main/resources/
+ssgd_monitor.py, yarn/appmaster/TensorflowSession.java), this framework runs a
+single SPMD program over a `jax.sharding.Mesh`, with XLA collectives over ICI
+replacing gRPC parameter push/pull, checkpoint-based elastic recovery replacing
+hot-standby backup workers, and a native (C++) scoring artifact replacing the
+libtensorflow JNI runtime of shifu-tensorflow-eval.
+
+Subpackages
+-----------
+- ``config``   typed job config + Shifu ModelConfig.json / ColumnConfig.json ingestion
+- ``data``     sharded gzip pipe-delimited reader, deterministic splits, device pipeline
+- ``models``   Flax model ladder: MLP, Wide&Deep, DeepFM, multi-task, FT-Transformer
+- ``ops``      losses / metrics / activations / initializers with reference parity
+- ``parallel`` mesh construction, sharding specs, collectives, multi-host init
+- ``train``    jitted train/eval steps, epoch loop, optimizers, checkpointing
+- ``export``   scoring artifact + GenericModelConfig.json sidecar + scorers
+- ``launcher`` job CLI: one SPMD program, console metrics, timeouts, restarts
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,35 @@
+from .schema import (
+    CheckpointConfig,
+    ColumnSpec,
+    ConfigError,
+    DataConfig,
+    DataSchema,
+    JobConfig,
+    MeshConfig,
+    ModelSpec,
+    OptimizerConfig,
+    RuntimeConfig,
+    TrainConfig,
+)
+from .shifu_compat import (
+    job_config_from_shifu,
+    parse_column_config,
+    parse_model_config,
+)
+
+__all__ = [
+    "CheckpointConfig",
+    "ColumnSpec",
+    "ConfigError",
+    "DataConfig",
+    "DataSchema",
+    "JobConfig",
+    "MeshConfig",
+    "ModelSpec",
+    "OptimizerConfig",
+    "RuntimeConfig",
+    "TrainConfig",
+    "job_config_from_shifu",
+    "parse_column_config",
+    "parse_model_config",
+]

@@ -1,0 +1,321 @@
+"""Typed configuration schema for shifu_tpu.
+
+The reference spreads configuration across three places: Hadoop XML key/value
+layers (reference: yarn/util/GlobalConfigurationKeys.java:22-155), Shifu's
+ModelConfig.json hyperparameters (reference: resources/ssgd_monitor.py:91-107,
+177-183) and a Java->Python env-var bridge (reference:
+yarn/container/TensorflowTaskExecutor.java:200-238).  Here everything collapses
+into one typed, serializable tree of dataclasses; `shifu_compat` fills it from
+the unchanged Shifu JSON files.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+
+class ConfigError(ValueError):
+    """Raised when a config is structurally invalid."""
+
+
+# ---------------------------------------------------------------------------
+# Columns / dataset
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ColumnSpec:
+    """One column of the normalized tabular input.
+
+    Mirrors what the reference extracts from ColumnConfig.json into the
+    SELECTED_COLUMN_NUMS / TARGET_COLUMN_NUM / WEIGHT_COLUMN_NUM env vars
+    (reference: yarn/client/TensorflowClient.java + TensorflowTaskExecutor.java:200-238).
+    """
+
+    index: int
+    name: str
+    is_target: bool = False
+    is_weight: bool = False
+    is_selected: bool = False
+    # categorical metadata (used by Wide&Deep / DeepFM embedding paths; the
+    # reference MLP treats everything as pre-normalized floats)
+    is_categorical: bool = False
+    vocab_size: int = 0
+
+
+@dataclass(frozen=True)
+class DataSchema:
+    """Column layout of one pipe-delimited normalized row."""
+
+    columns: tuple[ColumnSpec, ...] = ()
+    target_index: int = -1
+    weight_index: int = -1          # -1 => implicit weight 1.0 (reference: ssgd_monitor.py:417-421)
+    selected_indices: tuple[int, ...] = ()
+
+    @property
+    def feature_count(self) -> int:
+        return len(self.selected_indices)
+
+    @property
+    def categorical_indices(self) -> tuple[int, ...]:
+        by_index = {c.index: c for c in self.columns}
+        return tuple(i for i in self.selected_indices
+                     if i in by_index and by_index[i].is_categorical)
+
+    def validate(self) -> None:
+        if self.target_index < 0:
+            raise ConfigError("DataSchema.target_index must be set (>= 0)")
+        if not self.selected_indices:
+            raise ConfigError("DataSchema.selected_indices must be non-empty")
+        if self.target_index in self.selected_indices:
+            raise ConfigError("target column cannot also be a selected feature")
+        if self.weight_index >= 0 and self.weight_index in self.selected_indices:
+            raise ConfigError("weight column cannot also be a selected feature")
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Input pipeline configuration.
+
+    The reference round-robins gzip files across workers
+    (yarn/appmaster/TrainingDataSet.java:65-82) and re-draws a random row-level
+    train/valid split every run (ssgd_monitor.py:395 `random.random()`); here
+    the split is a deterministic per-row hash so resume/restart sees the same
+    partition.
+    """
+
+    paths: tuple[str, ...] = ()
+    delimiter: str = "|"
+    valid_ratio: float = 0.1        # reference default VALID_TRAINING_DATA_RATIO (ssgd_monitor.py:27)
+    split_seed: int = 0
+    batch_size: int = 100           # reference default BATCH_SIZE (ssgd_monitor.py:33)
+    shuffle_seed: int = 0
+    shuffle: bool = True
+    drop_remainder: bool = True     # static shapes for XLA
+    prefetch: int = 2
+
+    def validate(self) -> None:
+        if not (0.0 <= self.valid_ratio < 1.0):
+            raise ConfigError(f"valid_ratio must be in [0,1): {self.valid_ratio}")
+        if self.batch_size <= 0:
+            raise ConfigError("batch_size must be positive")
+
+
+# ---------------------------------------------------------------------------
+# Model
+# ---------------------------------------------------------------------------
+
+VALID_MODEL_TYPES = ("mlp", "wide_deep", "deepfm", "multitask", "ft_transformer")
+VALID_ACTIVATIONS = ("sigmoid", "tanh", "relu", "leakyrelu")
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """Model topology.
+
+    For `mlp` this mirrors ModelConfig.json train params NumHiddenLayers /
+    NumHiddenNodes / ActivationFunc (reference: ssgd_monitor.py:93-106) with a
+    sigmoid scoring head named `shifu_output_0` (ssgd_monitor.py:121).
+    """
+
+    model_type: str = "mlp"
+    hidden_nodes: tuple[int, ...] = (20,)     # reference fallback HIDDEN_NODES_COUNT=20 (ssgd_monitor.py:26)
+    activations: tuple[str, ...] = ("leakyrelu",)  # reference default (ssgd_monitor.py:77-90)
+    # Reference quirk, kept as explicit options: xavier init on *biases* too
+    # (ssgd_monitor.py:66-70) and an L2 regularizer that is declared but never
+    # added to the optimized loss (ssgd_monitor.py:59, loss at :129).
+    xavier_bias_init: bool = True
+    l2_scale: float = 0.0
+    # embedding path (wide_deep / deepfm / ft_transformer)
+    embedding_dim: int = 16
+    # multitask: number of output heads (Shifu multi-target mode)
+    num_heads: int = 1
+    head_names: tuple[str, ...] = ("shifu_output_0",)
+    # ft_transformer
+    num_layers: int = 3
+    num_attention_heads: int = 8
+    token_dim: int = 64
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.0
+    # numerics
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+
+    def validate(self) -> None:
+        if self.model_type not in VALID_MODEL_TYPES:
+            raise ConfigError(f"unknown model_type {self.model_type!r}; "
+                              f"expected one of {VALID_MODEL_TYPES}")
+        if len(self.hidden_nodes) != len(self.activations):
+            raise ConfigError("hidden_nodes and activations must have equal length")
+        for a in self.activations:
+            if a not in VALID_ACTIVATIONS:
+                raise ConfigError(f"unknown activation {a!r}")
+        if self.num_heads != len(self.head_names):
+            raise ConfigError("num_heads must match len(head_names)")
+
+
+# ---------------------------------------------------------------------------
+# Training
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    """Optimizer selection.
+
+    Reference default is Adadelta (ssgd_monitor.py:140) at LearningRate from
+    ModelConfig.json, falling back to 0.003 (ssgd_monitor.py:134-137).
+    """
+
+    name: str = "adadelta"
+    learning_rate: float = 0.003
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    grad_clip_norm: float = 0.0     # 0 disables
+    # gradient accumulation: the TPU analog of SAGN's 5-step local window
+    # (reference: resources/SAGN.py:110-142) — accumulate k microbatch grads
+    # before applying one update.
+    accumulate_steps: int = 1
+
+    def validate(self) -> None:
+        if self.learning_rate <= 0:
+            raise ConfigError("learning_rate must be positive")
+        if self.accumulate_steps < 1:
+            raise ConfigError("accumulate_steps must be >= 1")
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    epochs: int = 100               # reference: ModelConfig train.numTrainEpochs
+    loss: str = "weighted_mse"      # reference semantics: tf.losses.mean_squared_error on sigmoid (ssgd_monitor.py:129)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    seed: int = 42
+    eval_every_epochs: int = 1      # reference evaluates the valid set every epoch (ssgd_monitor.py:281-284)
+    log_every_steps: int = 0        # 0: epoch-level logging only, like the reference
+    bagging_sample_rate: float = 1.0
+
+    def validate(self) -> None:
+        if self.epochs <= 0:
+            raise ConfigError("epochs must be positive")
+        if self.loss not in ("weighted_mse", "bce", "weighted_bce"):
+            raise ConfigError(f"unknown loss {self.loss!r}")
+        self.optimizer.validate()
+
+
+# ---------------------------------------------------------------------------
+# Runtime / parallelism
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh.
+
+    Replaces the reference's PS/worker container topology
+    (yarn/util/CommonUtils.java:336-369 parseContainerRequests): `data` is the
+    batch (data-parallel) axis — the successor of N workers; `model` shards
+    parameters/embedding vocab — the successor of variable placement across PS
+    tasks (ssgd_monitor.py:202-206 replica_device_setter); `seq` is the
+    sequence/context-parallel axis for attention over long token axes.
+    """
+
+    data: int = 1
+    model: int = 1
+    seq: int = 1
+    axis_order: tuple[str, ...] = ("data", "seq", "model")
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.model * self.seq
+
+    def validate(self) -> None:
+        for name in ("data", "model", "seq"):
+            if getattr(self, name) < 1:
+                raise ConfigError(f"mesh axis {name} must be >= 1")
+
+
+@dataclass(frozen=True)
+class CheckpointConfig:
+    directory: str = ""
+    save_every_epochs: int = 1
+    max_to_keep: int = 3
+    resume: bool = True             # auto-resume from newest checkpoint (reference: MonitoredTrainingSession checkpoint_dir, ssgd_monitor.py:251-257)
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    # job-level controls (successors of shifu.application.* keys,
+    # GlobalConfigurationKeys.java:34-60)
+    app_name: str = "shifu_tpu"
+    timeout_seconds: int = 0        # 0: no timeout; reference client kills the YARN app on timeout (TensorflowClient.java:625-658)
+    max_restarts: int = 2           # checkpoint-restart budget; successor of backup-worker promotion (TensorflowApplicationMaster.java:410-426)
+    final_model_path: str = ""      # FINAL_MODEL_PATH env in the reference
+    tmp_model_path: str = ""        # TMP_MODEL_PATH env in the reference
+    distributed: bool = False       # multi-host: jax.distributed.initialize
+
+
+# ---------------------------------------------------------------------------
+# The whole job
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class JobConfig:
+    schema: DataSchema = field(default_factory=DataSchema)
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelSpec = field(default_factory=ModelSpec)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+
+    def validate(self) -> "JobConfig":
+        self.schema.validate()
+        self.data.validate()
+        self.model.validate()
+        self.train.validate()
+        self.runtime.mesh.validate()
+        return self
+
+    # -- serialization ------------------------------------------------------
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "JobConfig":
+        return _from_dict(cls, d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "JobConfig":
+        return cls.from_dict(json.loads(s))
+
+    def replace(self, **kw: Any) -> "JobConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def _from_dict(cls: type, d: Any) -> Any:
+    """Recursively build a (possibly nested) dataclass from plain dicts/lists."""
+    if not dataclasses.is_dataclass(cls):
+        return d
+    kwargs: dict[str, Any] = {}
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    for key, value in d.items():
+        if key not in fields:
+            raise ConfigError(f"unknown config key {key!r} for {cls.__name__}")
+        f = fields[key]
+        ftype = f.type if isinstance(f.type, type) else None
+        # resolve nested dataclass types by inspecting the default factory
+        default = f.default_factory() if f.default_factory is not dataclasses.MISSING else f.default  # type: ignore[misc]
+        if dataclasses.is_dataclass(default) and isinstance(value, dict):
+            kwargs[key] = _from_dict(type(default), value)
+        elif key == "columns" and isinstance(value, (list, tuple)):
+            kwargs[key] = tuple(_from_dict(ColumnSpec, v) if isinstance(v, dict) else v
+                                for v in value)
+        elif isinstance(value, list):
+            kwargs[key] = tuple(tuple(v) if isinstance(v, list) else v for v in value)
+        else:
+            kwargs[key] = value
+    return cls(**kwargs)

@@ -1,0 +1,29 @@
+from .pipeline import TabularDataset, batch_iterator, load_datasets, num_batches, pad_to_batch
+from .reader import (
+    count_rows,
+    list_data_files,
+    open_maybe_gzip,
+    parse_rows,
+    project_columns,
+    read_file,
+    shard_paths,
+)
+from .split import bagging_mask, row_uniform, train_valid_mask
+
+__all__ = [
+    "TabularDataset",
+    "batch_iterator",
+    "load_datasets",
+    "num_batches",
+    "pad_to_batch",
+    "count_rows",
+    "list_data_files",
+    "open_maybe_gzip",
+    "parse_rows",
+    "project_columns",
+    "read_file",
+    "shard_paths",
+    "bagging_mask",
+    "row_uniform",
+    "train_valid_mask",
+]

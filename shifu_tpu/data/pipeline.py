@@ -1,0 +1,149 @@
+"""In-memory dataset + batch pipeline feeding the SPMD train step.
+
+The reference's pipeline is: per-worker file shard -> full in-RAM Python lists
+-> feed_dict minibatches (reference: resources/ssgd_monitor.py:348-454,268-276).
+Here: per-host file shard -> vectorized parse -> contiguous numpy arrays ->
+static-shape batches (drop-remainder) handed to jax.device_put with a
+data-axis NamedSharding.  Epoch shuffles are deterministic in (seed, epoch),
+so a restart resumes with identical batch order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..config.schema import DataConfig, DataSchema
+from . import reader, split
+
+
+@dataclasses.dataclass
+class TabularDataset:
+    """Feature/target/weight arrays for one partition (train or valid)."""
+
+    features: np.ndarray  # (N, F) float32
+    target: np.ndarray    # (N, 1) float32
+    weight: np.ndarray    # (N, 1) float32
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.features.shape[0])
+
+    @property
+    def num_features(self) -> int:
+        return int(self.features.shape[1])
+
+    def take(self, idx: np.ndarray) -> "TabularDataset":
+        return TabularDataset(self.features[idx], self.target[idx], self.weight[idx])
+
+
+def load_datasets(
+    schema: DataSchema,
+    data: DataConfig,
+    host_index: int = 0,
+    num_hosts: int = 1,
+) -> tuple[TabularDataset, TabularDataset]:
+    """Load (train, valid) datasets for this host.
+
+    Files are round-robined across hosts (successor of
+    yarn/appmaster/TrainingDataSet.java:65-82); rows are split train/valid by
+    the deterministic hash in `split` (fixes the re-drawn random split quirk,
+    ssgd_monitor.py:395).
+    """
+    paths: list[str] = []
+    for p in data.paths:
+        paths.extend(reader.list_data_files(p))
+
+    feats, targs, weights, masks_v = [], [], [], []
+    # global row ids must be stable across hosts: derive from (file idx, row idx);
+    # shard by index so duplicate path strings still get distinct ids
+    for file_idx, path in enumerate(paths):
+        if file_idx % num_hosts != host_index:
+            continue
+        rows = reader.read_file(path, data.delimiter)
+        cols = reader.project_columns(rows, schema)
+        n = cols["features"].shape[0]
+        row_ids = (np.uint64(file_idx) << np.uint64(40)) + np.arange(n, dtype=np.uint64)
+        _, valid_mask = split.train_valid_mask(row_ids, data.valid_ratio, data.split_seed)
+        feats.append(cols["features"])
+        targs.append(cols["target"])
+        weights.append(cols["weight"])
+        masks_v.append(valid_mask)
+
+    if feats:
+        features = np.concatenate(feats)
+        target = np.concatenate(targs)
+        weight = np.concatenate(weights)
+        valid_mask = np.concatenate(masks_v)
+    else:
+        features = np.zeros((0, schema.feature_count), np.float32)
+        target = np.zeros((0, 1), np.float32)
+        weight = np.zeros((0, 1), np.float32)
+        valid_mask = np.zeros((0,), bool)
+
+    full = TabularDataset(features, target, weight)
+    train = full.take(~valid_mask)
+    valid = full.take(valid_mask)
+    return train, valid
+
+
+def batch_iterator(
+    ds: TabularDataset,
+    batch_size: int,
+    *,
+    shuffle: bool = True,
+    seed: int = 0,
+    epoch: int = 0,
+    drop_remainder: bool = True,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Yield {'features','target','weight'} batches with static shapes.
+
+    Shuffle order is a pure function of (seed, epoch) so every host and every
+    restart agrees.  drop_remainder keeps shapes static for XLA; the dropped
+    tail rotates across epochs because the permutation changes per epoch.
+    """
+    n = ds.num_rows
+    if n == 0:
+        return
+    if shuffle:
+        rng = np.random.default_rng(np.random.PCG64(seed * 1_000_003 + epoch))
+        order = rng.permutation(n)
+    else:
+        order = np.arange(n)
+    num_full = n // batch_size
+    end = num_full * batch_size if drop_remainder else n
+    for start in range(0, end, batch_size):
+        idx = order[start:start + batch_size]
+        yield {
+            "features": ds.features[idx],
+            "target": ds.target[idx],
+            "weight": ds.weight[idx],
+        }
+
+
+def num_batches(ds: TabularDataset, batch_size: int, drop_remainder: bool = True) -> int:
+    if drop_remainder:
+        return ds.num_rows // batch_size
+    return -(-ds.num_rows // batch_size)
+
+
+def pad_to_batch(batch: dict[str, np.ndarray], batch_size: int) -> tuple[dict[str, np.ndarray], np.ndarray]:
+    """Pad a short batch up to batch_size; returns (padded, validity mask).
+
+    Padding rows get weight 0 so they contribute nothing to weighted losses or
+    metrics — used by full-dataset eval so no validation row is dropped (the
+    reference evaluates the full valid set each epoch, ssgd_monitor.py:281-284).
+    """
+    n = batch["features"].shape[0]
+    if n == batch_size:
+        return batch, np.ones((batch_size,), bool)
+    pad = batch_size - n
+    out = {}
+    for k, v in batch.items():
+        out[k] = np.concatenate([v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+    out["weight"][n:] = 0.0
+    mask = np.zeros((batch_size,), bool)
+    mask[:n] = True
+    return out, mask

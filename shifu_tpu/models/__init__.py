@@ -1,0 +1,5 @@
+from .base import MLPTrunk, ScoringHead, ShifuDense
+from .mlp import ShifuMLP
+from .registry import build_model, register
+
+__all__ = ["MLPTrunk", "ScoringHead", "ShifuDense", "ShifuMLP", "build_model", "register"]

@@ -1,0 +1,88 @@
+"""Shared model building blocks.
+
+`ShifuDense` reproduces the reference's `nn_layer` (resources/
+ssgd_monitor.py:59-74): xavier-uniform kernel, xavier-init bias (a reference
+quirk kept behind `xavier_bias`), activation applied to `x @ W + b`.  Compute
+runs in `compute_dtype` (bfloat16 by default — MXU-native) with parameters
+kept in `param_dtype` (float32) and master-precision loss accumulation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ModelSpec
+from ..ops.activations import get_activation
+from ..ops.initializers import bias_init, xavier_uniform
+
+
+def dtype_of(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}[name]
+
+
+class ShifuDense(nn.Module):
+    features: int
+    activation: Optional[str] = None  # None => linear
+    xavier_bias: bool = True
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        y = nn.Dense(
+            self.features,
+            kernel_init=xavier_uniform,
+            bias_init=bias_init(self.xavier_bias),
+            param_dtype=dtype_of(self.param_dtype),
+            dtype=dtype_of(self.compute_dtype),
+        )(x)
+        if self.activation is not None:
+            y = get_activation(self.activation)(y)
+        return y
+
+
+class MLPTrunk(nn.Module):
+    """The hidden stack from ModelConfig (NumHiddenLayers/NumHiddenNodes/
+    ActivationFunc — reference: ssgd_monitor.py:93-110)."""
+
+    spec: ModelSpec
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for i, (n, act) in enumerate(zip(self.spec.hidden_nodes, self.spec.activations)):
+            x = ShifuDense(
+                features=n,
+                activation=act,
+                xavier_bias=self.spec.xavier_bias_init,
+                param_dtype=self.spec.param_dtype,
+                compute_dtype=self.spec.compute_dtype,
+                name=f"hidden_layer{i}",
+            )(x)
+        return x
+
+
+class ScoringHead(nn.Module):
+    """Linear head(s) producing logits; sigmoid lives in the loss/scorer.
+
+    The reference's head is Dense(1)+sigmoid named `shifu_output_0`
+    (ssgd_monitor.py:121); returning logits keeps the loss numerically exact
+    and lets XLA fuse the sigmoid where it is consumed.
+    """
+
+    spec: ModelSpec
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        y = ShifuDense(
+            features=self.spec.num_heads,
+            activation=None,
+            xavier_bias=self.spec.xavier_bias_init,
+            param_dtype=self.spec.param_dtype,
+            compute_dtype=self.spec.compute_dtype,
+            name="shifu_output_0",
+        )(x)
+        return y.astype(jnp.float32)

@@ -1,0 +1,26 @@
+"""The Shifu MLP — parity model with the reference trainer's network.
+
+Reference graph (resources/ssgd_monitor.py:93-129): input (B, F) float ->
+N hidden xavier dense layers with per-layer activations from ModelConfig ->
+Dense(1) sigmoid head `shifu_output_0`, trained with weighted MSE.  Here the
+model emits logits (B, num_heads); sigmoid is applied by the loss and scorer.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+
+from ..config.schema import ModelSpec
+from .base import MLPTrunk, ScoringHead, dtype_of
+
+
+class ShifuMLP(nn.Module):
+    spec: ModelSpec
+
+    @nn.compact
+    def __call__(self, features: jax.Array, *, train: bool = False) -> jax.Array:
+        del train  # no dropout/batchnorm in the parity MLP
+        x = features.astype(dtype_of(self.spec.compute_dtype))
+        x = MLPTrunk(spec=self.spec, name="trunk")(x)
+        return ScoringHead(spec=self.spec, name="head")(x)

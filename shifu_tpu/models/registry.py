@@ -1,0 +1,37 @@
+"""Model factory: ModelSpec.model_type -> Flax module.
+
+The model ladder tracks BASELINE.md's benchmark configs: MLP (parity with the
+reference trainer), Wide&Deep, DeepFM, multi-task heads, FT-Transformer.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+
+from ..config.schema import DataSchema, ModelSpec
+
+_BUILDERS: dict[str, Callable[[ModelSpec, DataSchema], nn.Module]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _BUILDERS[name] = fn
+        return fn
+    return deco
+
+
+def build_model(spec: ModelSpec, schema: DataSchema) -> nn.Module:
+    try:
+        builder = _BUILDERS[spec.model_type]
+    except KeyError:
+        raise KeyError(
+            f"unknown model_type {spec.model_type!r}; available: {sorted(_BUILDERS)}") from None
+    return builder(spec, schema)
+
+
+@register("mlp")
+def _build_mlp(spec: ModelSpec, schema: DataSchema) -> nn.Module:
+    from .mlp import ShifuMLP
+    return ShifuMLP(spec=spec)

@@ -1,0 +1,20 @@
+from .activations import get_activation, leaky_relu
+from .initializers import bias_init, xavier_bias, xavier_uniform
+from .losses import bce, get_loss, l2_penalty, multitask_loss, weighted_bce, weighted_mse
+from .metrics import auc, weighted_error
+
+__all__ = [
+    "get_activation",
+    "leaky_relu",
+    "bias_init",
+    "xavier_bias",
+    "xavier_uniform",
+    "bce",
+    "get_loss",
+    "l2_penalty",
+    "multitask_loss",
+    "weighted_bce",
+    "weighted_mse",
+    "auc",
+    "weighted_error",
+]

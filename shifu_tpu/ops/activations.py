@@ -1,0 +1,36 @@
+"""Activation registry with the reference's name mapping.
+
+Reference: resources/ssgd_monitor.py:77-90 — sigmoid/tanh/relu/leakyrelu by
+name; anything else (including None) falls back to leaky_relu.  TF's
+leaky_relu default alpha is 0.2; jax.nn.leaky_relu's default is 0.01, so alpha
+is pinned explicitly for parity.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Activation = Callable[[jax.Array], jax.Array]
+
+_LEAKY_ALPHA = 0.2  # tf.nn.leaky_relu default (TF 1.4), used by the reference
+
+
+def leaky_relu(x: jax.Array) -> jax.Array:
+    return jax.nn.leaky_relu(x, negative_slope=_LEAKY_ALPHA)
+
+
+_REGISTRY: dict[str, Activation] = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "leakyrelu": leaky_relu,
+}
+
+
+def get_activation(name: str | None) -> Activation:
+    if not name:
+        return leaky_relu
+    return _REGISTRY.get(str(name).lower(), leaky_relu)

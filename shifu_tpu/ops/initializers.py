@@ -1,0 +1,33 @@
+"""Parameter initializers matching the reference's choices.
+
+Reference: resources/ssgd_monitor.py:61-70 — xavier (glorot uniform) for both
+the [in, out] weight matrices and, as an explicit quirk, the [out] bias
+vectors.  TF's xavier on a rank-1 shape [n] treats fan_in = fan_out = n, i.e.
+uniform(-sqrt(6/(2n)), +sqrt(6/(2n))) = uniform(-sqrt(3/n), +sqrt(3/n)); that
+exact behavior is reproduced here so AUC parity comparisons start from the
+same init distribution.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.nn import initializers as jinit
+
+xavier_uniform = jinit.glorot_uniform()
+
+
+def xavier_bias(key: jax.Array, shape, dtype=jnp.float32) -> jax.Array:
+    """TF-style xavier init for a rank-1 bias: fan_in = fan_out = n."""
+    n = shape[-1]
+    limit = jnp.sqrt(3.0 / n).astype(dtype)
+    return jax.random.uniform(key, shape, dtype, minval=-limit, maxval=limit)
+
+
+def zeros_bias(key: jax.Array, shape, dtype=jnp.float32) -> jax.Array:
+    return jnp.zeros(shape, dtype)
+
+
+def bias_init(xavier: bool):
+    """Bias initializer factory: reference parity (xavier) or the modern zero init."""
+    return xavier_bias if xavier else zeros_bias

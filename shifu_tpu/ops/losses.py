@@ -1,0 +1,82 @@
+"""Losses with the reference's exact semantics, plus modern options.
+
+Reference loss: `tf.losses.mean_squared_error(predictions=sigmoid_out,
+labels=y, weights=sample_weight)` (resources/ssgd_monitor.py:129).  With TF's
+default reduction (SUM_BY_NONZERO_WEIGHTS) that is
+
+    sum(w * (p - y)^2) / count(w != 0)
+
+— weighted squared error on the sigmoid *probability*, NOT cross-entropy, and
+normalized by the count of non-zero-weight rows rather than the weight sum.
+`weighted_mse` reproduces that formula exactly; `bce`/`weighted_bce` are the
+proper-loss alternatives the reference lacked (SURVEY.md section 7.1 item 2).
+
+All losses are written on logits and rely on XLA fusing the sigmoid into the
+surrounding elementwise graph.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+# loss_fn(logits, target, weight) -> scalar; all inputs (B, H)
+LossFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
+
+
+def weighted_mse(logits: jax.Array, target: jax.Array, weight: jax.Array) -> jax.Array:
+    """sum(w * (sigmoid(logits) - y)^2) / count(w != 0) — reference parity."""
+    p = jax.nn.sigmoid(logits.astype(jnp.float32))
+    sq = weight * jnp.square(p - target)
+    nonzero = jnp.maximum(jnp.sum(weight != 0.0), 1)
+    return jnp.sum(sq) / nonzero.astype(jnp.float32)
+
+
+def bce(logits: jax.Array, target: jax.Array, weight: jax.Array) -> jax.Array:
+    """Unweighted sigmoid binary cross-entropy (mean over all rows)."""
+    del weight
+    logits = logits.astype(jnp.float32)
+    per_row = jnp.maximum(logits, 0) - logits * target + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return jnp.mean(per_row)
+
+
+def weighted_bce(logits: jax.Array, target: jax.Array, weight: jax.Array) -> jax.Array:
+    """Weight-normalized sigmoid binary cross-entropy."""
+    logits = logits.astype(jnp.float32)
+    per_row = jnp.maximum(logits, 0) - logits * target + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    denom = jnp.maximum(jnp.sum(weight), 1e-6)
+    return jnp.sum(weight * per_row) / denom
+
+
+_REGISTRY: dict[str, LossFn] = {
+    "weighted_mse": weighted_mse,
+    "bce": bce,
+    "weighted_bce": weighted_bce,
+}
+
+
+def get_loss(name: str) -> LossFn:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"unknown loss {name!r}; available: {sorted(_REGISTRY)}") from None
+
+
+def multitask_loss(base: LossFn):
+    """Average `base` across H heads: logits/target/weight are (B, H)."""
+    def fn(logits: jax.Array, target: jax.Array, weight: jax.Array) -> jax.Array:
+        h = logits.shape[-1]
+        per_head = [base(logits[:, i:i + 1], target[:, i:i + 1], weight) for i in range(h)]
+        return jnp.mean(jnp.stack(per_head))
+    return fn
+
+
+def l2_penalty(params, scale: float) -> jax.Array:
+    """Optional L2 on kernels+biases — the regularizer the reference declared
+    but never added to the optimized loss (ssgd_monitor.py:59 vs :129,143)."""
+    if scale <= 0.0:
+        return jnp.float32(0.0)
+    leaves = jax.tree_util.tree_leaves(params)
+    return scale * sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)

@@ -1,0 +1,56 @@
+"""Evaluation metrics.
+
+The reference reports per-epoch weighted train/valid error through its socket
+-> ZooKeeper -> ApplicationMaster pipeline (resources/ssgd_monitor.py:281-293,
+appmaster/TensorflowSession.java:595-626); AUC parity vs the TF-PS baseline is
+the headline accuracy metric (BASELINE.json).  AUC here is the exact weighted
+Mann-Whitney statistic with half-credit for ties.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def auc(scores: np.ndarray, labels: np.ndarray, weights: np.ndarray | None = None) -> float:
+    """Weighted ROC-AUC: P(score_pos > score_neg) + 0.5 * P(tie), O(n log n).
+
+    For each positive row, credit the negative weight ranked strictly below it
+    plus half the negative weight tied with it; normalize by wp * wn.
+    """
+    scores = np.asarray(scores, np.float64).ravel()
+    labels = np.asarray(labels, np.float64).ravel()
+    w = np.ones_like(scores) if weights is None else np.asarray(weights, np.float64).ravel()
+    keep = w > 0
+    scores, labels, w = scores[keep], labels[keep], w[keep]
+    pos = labels >= 0.5
+    wp, wn = w[pos].sum(), w[~pos].sum()
+    if wp == 0 or wn == 0:
+        return float("nan")
+
+    order = np.argsort(scores, kind="mergesort")
+    s, is_pos, ww = scores[order], pos[order], w[order]
+    neg_w = np.where(~is_pos, ww, 0.0)
+    cum_neg = np.cumsum(neg_w)
+
+    # vectorized tie groups: for a row in group [g0, g1],
+    # strictly-below = cum_neg[g0-1], tied = cum_neg[g1] - cum_neg[g0-1]
+    n = len(s)
+    new_group = np.concatenate([[False], s[1:] != s[:-1]])
+    starts = np.flatnonzero(np.concatenate([[True], s[1:] != s[:-1]]))
+    ends = np.concatenate([starts[1:], [n]]) - 1
+    group_id = np.cumsum(new_group.astype(np.int64))
+    below_g = np.where(starts > 0, cum_neg[np.maximum(starts - 1, 0)], 0.0)
+    tie_g = cum_neg[ends] - below_g
+    credit = (below_g + 0.5 * tie_g)[group_id]
+    return float(np.sum(ww[is_pos] * credit[is_pos]) / (wp * wn))
+
+
+def weighted_error(scores: np.ndarray, labels: np.ndarray, weights: np.ndarray | None = None) -> float:
+    """The reference's per-epoch 'error': weighted MSE of sigmoid scores with
+    TF's SUM_BY_NONZERO_WEIGHTS normalization (ssgd_monitor.py:129,281-284)."""
+    scores = np.asarray(scores, np.float64).ravel()
+    labels = np.asarray(labels, np.float64).ravel()
+    w = np.ones_like(scores) if weights is None else np.asarray(weights, np.float64).ravel()
+    nonzero = max(int(np.sum(w != 0)), 1)
+    return float(np.sum(w * (scores - labels) ** 2) / nonzero)

@@ -1,0 +1,35 @@
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    data_parallel_mesh,
+    host_shard_info,
+    make_mesh,
+)
+from .sharding import (
+    DEFAULT_RULES,
+    batch_sharding,
+    batch_spec,
+    param_shardings,
+    param_specs,
+    place_params,
+    replicated,
+    shard_batch,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "data_parallel_mesh",
+    "host_shard_info",
+    "make_mesh",
+    "DEFAULT_RULES",
+    "batch_sharding",
+    "batch_spec",
+    "param_shardings",
+    "param_specs",
+    "place_params",
+    "replicated",
+    "shard_batch",
+]

@@ -1,0 +1,61 @@
+"""Device mesh construction.
+
+The mesh replaces the reference's cluster topology: the `data` axis succeeds
+the N worker containers (each held a disjoint file shard —
+yarn/appmaster/TrainingDataSet.java:65-82), `model` succeeds parameter
+placement across PS containers (replica_device_setter round-robin,
+resources/ssgd_monitor.py:202-206), and `seq` is the sequence/context-parallel
+axis for attention models.  Collectives ride ICI inside a slice and DCN across
+slices; XLA chooses them from the shardings — nothing here speaks NCCL/gRPC.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..config.schema import ConfigError, MeshConfig
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+
+
+def make_mesh(cfg: Optional[MeshConfig] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh with axes (data, seq, model).
+
+    With no config, all local devices go on the data axis — the common
+    data-parallel tabular case.  Axis sizes must multiply to the device count.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if cfg is None:
+        cfg = MeshConfig(data=n)
+    if cfg.num_devices != n:
+        raise ConfigError(
+            f"mesh {cfg.data}x{cfg.seq}x{cfg.model} needs {cfg.num_devices} devices, have {n}")
+    sizes = {"data": cfg.data, "seq": cfg.seq, "model": cfg.model}
+    axis_names = tuple(cfg.axis_order)
+    shape = tuple(sizes[a] for a in axis_names)
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def data_parallel_mesh(num_devices: Optional[int] = None) -> Mesh:
+    devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return make_mesh(MeshConfig(data=len(devices)), devices)
+
+
+def host_shard_info(mesh: Mesh) -> tuple[int, int]:
+    """(host_index, num_hosts) for input-file sharding under multi-host SPMD."""
+    return jax.process_index(), jax.process_count()

@@ -1,0 +1,87 @@
+"""Sharding helpers: NamedShardings + batch/param placement.
+
+The reference's data plane was gRPC parameter push/pull between workers and
+parameter servers with a PS-hosted token-queue sync barrier
+(resources/ssgd_monitor.py:136-166).  Here placement is declarative:
+the global batch is sharded over the `data` axis, parameters are replicated
+(or sharded by rule, e.g. embedding vocab over `model`), and XLA emits the
+gradient all-reduce over ICI — the exact semantic of aggregate-N-grads in
+SyncReplicasOptimizer, without a parameter server.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+PyTree = Any
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, rank: int = 2) -> NamedSharding:
+    """Shard the leading (batch) dim over `data`; other dims unsharded."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (rank - 1))))
+
+
+def shard_batch(batch: Mapping[str, np.ndarray], mesh: Mesh) -> dict[str, jax.Array]:
+    """device_put every array in a batch dict with data-axis sharding."""
+    out = {}
+    for k, v in batch.items():
+        out[k] = jax.device_put(v, batch_sharding(mesh, rank=v.ndim))
+    return out
+
+
+def batch_spec(rank: int = 2) -> P:
+    return P(DATA_AXIS, *([None] * (rank - 1)))
+
+
+# -- parameter sharding rules ------------------------------------------------
+
+# rules: list of (path regex, PartitionSpec); first match wins, default replicated.
+ShardingRules = Sequence[tuple[str, P]]
+
+# Default ladder rules: embedding tables shard their vocab axis over `model`
+# (the successor of PS-side variable placement for big tables); everything
+# else replicates.
+DEFAULT_RULES: ShardingRules = (
+    (r".*[Ee]mbedding.*", P(MODEL_AXIS, None)),
+)
+
+
+def param_specs(params: PyTree, rules: ShardingRules = ()) -> PyTree:
+    """Map each param leaf (by '/'-joined path) to a PartitionSpec."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def spec_for(path: str, leaf) -> P:
+        for pattern, spec in rules:
+            if re.fullmatch(pattern, path) or re.search(pattern, path):
+                # rank-adapt: trim/pad the spec to the leaf rank
+                entries = list(spec) + [None] * (leaf.ndim - len(spec))
+                return P(*entries[: leaf.ndim])
+        return P()
+
+    paths = {jax.tree_util.keystr(kp): leaf for kp, leaf in flat}
+    treedef = jax.tree_util.tree_structure(params)
+    specs = [spec_for(jax.tree_util.keystr(kp), leaf) for kp, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def param_shardings(params: PyTree, mesh: Mesh, rules: ShardingRules = ()) -> PyTree:
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), param_specs(params, rules),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def place_params(params: PyTree, mesh: Mesh, rules: ShardingRules = ()) -> PyTree:
+    """device_put params according to rules (default: fully replicated)."""
+    shardings = param_shardings(params, mesh, rules)
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)

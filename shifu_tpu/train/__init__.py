@@ -1,0 +1,23 @@
+from .checkpoint import make_manager, restore, restore_latest, save
+from .loop import EpochMetrics, TrainResult, evaluate, init_state, train
+from .optimizers import build_optimizer
+from .step import make_eval_step, make_forward_fn, make_loss_fn, make_train_step
+from .train_state import TrainState
+
+__all__ = [
+    "make_manager",
+    "restore",
+    "restore_latest",
+    "save",
+    "EpochMetrics",
+    "TrainResult",
+    "evaluate",
+    "init_state",
+    "train",
+    "build_optimizer",
+    "make_eval_step",
+    "make_forward_fn",
+    "make_loss_fn",
+    "make_train_step",
+    "TrainState",
+]

@@ -1,0 +1,64 @@
+"""Checkpoint save / auto-resume via Orbax.
+
+Successor of the reference's `MonitoredTrainingSession(checkpoint_dir=
+TMP_MODEL_PATH)` auto-save/restore (resources/ssgd_monitor.py:251-257) and the
+recovery path where a promoted backup worker resumes from the newest
+checkpoint (SURVEY.md section 3.6).  Under SPMD, checkpoint-restart IS the
+fault-tolerance story: orbax writes sharded arrays (each host its shards) and
+restore re-places them onto the current mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def make_manager(directory: str, max_to_keep: int = 3) -> ocp.CheckpointManager:
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True)
+    return ocp.CheckpointManager(directory, options=options)
+
+
+def save(manager: ocp.CheckpointManager, step: int, state: Any,
+         extra: Optional[dict] = None) -> None:
+    """Save the train state (and a small metadata dict) at `step`."""
+    composite = dict(state=ocp.args.StandardSave(state))
+    if extra is not None:
+        composite["extra"] = ocp.args.JsonSave(extra)
+    manager.save(step, args=ocp.args.Composite(**composite))
+    manager.wait_until_finished()
+
+
+def latest_step(manager: ocp.CheckpointManager) -> Optional[int]:
+    return manager.latest_step()
+
+
+def restore(manager: ocp.CheckpointManager, step: int, abstract_state: Any,
+            with_extra: bool = False):
+    """Restore state saved at `step`, re-placed to match `abstract_state`'s
+    shardings (pass a state built the same way as at save time)."""
+    composite = dict(state=ocp.args.StandardRestore(abstract_state))
+    if with_extra:
+        composite["extra"] = ocp.args.JsonRestore()
+    out = manager.restore(step, args=ocp.args.Composite(**composite))
+    if with_extra:
+        return out["state"], out.get("extra")
+    return out["state"]
+
+
+def restore_latest(manager: ocp.CheckpointManager, abstract_state: Any,
+                   with_extra: bool = False):
+    """Auto-resume: restore the newest checkpoint or return None."""
+    step = latest_step(manager)
+    if step is None:
+        return None
+    out = restore(manager, step, abstract_state, with_extra=with_extra)
+    if with_extra:
+        state, extra = out
+        return state, extra, step
+    return out, step

@@ -1,0 +1,223 @@
+"""The training loop: one SPMD program from data to exported model.
+
+Collapses the reference's five-process pipeline (client -> AM -> container
+executor -> python trainer -> PS; SURVEY.md section 1) into one function.  The
+per-epoch console line keeps the reference's operator UX — epoch, weighted
+train/valid error, epoch wall time (fields of
+core/TrainingIntermediateResult.java:41-43, aggregated by
+appmaster/TensorflowSession.java:515-549) — plus AUC.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..config.schema import JobConfig
+from ..data import pipeline as pipe
+from ..models.registry import build_model
+from ..ops import metrics as metrics_lib
+from ..parallel import mesh as mesh_lib
+from ..parallel import sharding as shard_lib
+from . import checkpoint as ckpt_lib
+from .optimizers import build_optimizer
+from .step import make_eval_step, make_train_step
+from .train_state import TrainState
+
+Console = Callable[[str], None]
+
+
+@dataclasses.dataclass
+class EpochMetrics:
+    epoch: int
+    train_error: float
+    valid_error: float
+    valid_auc: float
+    epoch_time: float
+    valid_time: float
+
+    def console_line(self) -> str:
+        # Reference line shape: worker_index,time,current_epoch,training_loss,
+        # valid_loss,valid_time (ssgd_monitor.py:287-293) aggregated by the AM.
+        return (f"Epoch {self.epoch}: train_error={self.train_error:.6f} "
+                f"valid_error={self.valid_error:.6f} valid_auc={self.valid_auc:.4f} "
+                f"time={self.epoch_time:.2f}s valid_time={self.valid_time:.2f}s")
+
+
+@dataclasses.dataclass
+class TrainResult:
+    state: Any
+    history: list[EpochMetrics]
+    job: JobConfig
+    resumed_from_epoch: int = 0
+
+
+def init_state(job: JobConfig, num_features: int,
+               mesh: Optional[Mesh] = None) -> TrainState:
+    """Build model + optimizer and initialize (optionally mesh-placed) state."""
+    model = build_model(job.model, job.schema)
+    tx = build_optimizer(job.train.optimizer)
+    rng = jax.random.PRNGKey(job.train.seed)
+    dummy = jnp.zeros((1, num_features), jnp.float32)
+    variables = model.init(rng, dummy)
+    state = TrainState.create(apply_fn=model.apply, params=variables["params"], tx=tx)
+    if mesh is not None:
+        rules = shard_lib.DEFAULT_RULES if job.runtime.mesh.model > 1 else ()
+        placed_params = shard_lib.place_params(state.params, mesh, rules)
+        placed_opt = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, shard_lib.replicated(mesh))
+            if isinstance(x, jax.Array) else x,
+            state.opt_state)
+        state = state.replace(
+            params=placed_params,
+            opt_state=placed_opt,
+            step=jax.device_put(state.step, shard_lib.replicated(mesh)),
+        )
+    return state
+
+
+def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
+             eval_step, mesh: Optional[Mesh] = None,
+             batch_size: Optional[int] = None) -> tuple[float, float]:
+    """(weighted_error, auc) over the full dataset — every row counted, the
+    tail padded with zero-weight rows (reference evaluates the full valid set
+    per epoch, ssgd_monitor.py:281-284)."""
+    if ds.num_rows == 0:
+        return float("nan"), float("nan")
+    bs = batch_size or max(job.data.batch_size, 4096)
+    if mesh is not None:
+        # keep the per-device shard static
+        bs = -(-bs // mesh.size) * mesh.size
+    scores_parts, targets_parts, weights_parts = [], [], []
+    for batch in pipe.batch_iterator(ds, bs, shuffle=False, drop_remainder=False):
+        padded, mask = pipe.pad_to_batch(batch, bs)
+        if mesh is not None:
+            padded = shard_lib.shard_batch(padded, mesh)
+        s = np.asarray(jax.device_get(eval_step(state, padded)))
+        n = int(mask.sum())
+        scores_parts.append(s[:n])
+        targets_parts.append(batch["target"])
+        weights_parts.append(batch["weight"])
+    scores = np.concatenate(scores_parts)
+    targets = np.concatenate(targets_parts)
+    weights = np.concatenate(weights_parts)
+    err = metrics_lib.weighted_error(scores[:, 0], targets[:, 0], weights[:, 0])
+    auc = metrics_lib.auc(scores[:, 0], targets[:, 0], weights[:, 0])
+    return err, auc
+
+
+def train(job: JobConfig,
+          train_ds: Optional[pipe.TabularDataset] = None,
+          valid_ds: Optional[pipe.TabularDataset] = None,
+          mesh: Optional[Mesh] = None,
+          console: Optional[Console] = None,
+          epoch_callback: Optional[Callable[[EpochMetrics], None]] = None) -> TrainResult:
+    """Run the full training job; returns final state + per-epoch history.
+
+    Datasets may be passed directly (tests, bench) or loaded from
+    job.data.paths with per-host file sharding.
+    """
+    job = job.validate()
+    console = console or (lambda s: print(s, flush=True))
+
+    if train_ds is None:
+        host, nhosts = mesh_lib.host_shard_info(mesh) if mesh else (0, 1)
+        train_ds, valid_ds = pipe.load_datasets(job.schema, job.data, host, nhosts)
+    assert valid_ds is not None
+
+    num_features = train_ds.num_features or job.schema.feature_count
+    state = init_state(job, num_features, mesh)
+
+    # auto-resume (successor of MonitoredTrainingSession restore-on-start)
+    start_epoch = 0
+    manager = None
+    if job.runtime.checkpoint.directory:
+        manager = ckpt_lib.make_manager(job.runtime.checkpoint.directory,
+                                        job.runtime.checkpoint.max_to_keep)
+        if job.runtime.checkpoint.resume:
+            restored = ckpt_lib.restore_latest(
+                manager, jax.tree_util.tree_map(lambda x: x, state), with_extra=True)
+            if restored is not None:
+                r_state, extra, step = restored
+                state = state.replace(params=r_state.params,
+                                      opt_state=r_state.opt_state,
+                                      step=r_state.step)
+                start_epoch = int((extra or {}).get("epoch", 0))
+                console(f"Resumed from checkpoint step {step} (epoch {start_epoch})")
+
+    train_step = make_train_step(job, mesh)
+    eval_step = make_eval_step(job)
+
+    if train_ds.num_rows == 0:
+        raise ValueError("training dataset has 0 rows — nothing to train on")
+
+    bs = job.data.batch_size
+    mesh_size = mesh.size if mesh is not None else 1
+    if bs > train_ds.num_rows and job.data.drop_remainder:
+        # A dataset smaller than the batch would silently train zero steps;
+        # clamp down (keeping per-device divisibility) and say so.
+        bs = max((train_ds.num_rows // mesh_size) * mesh_size, mesh_size)
+        console(f"batch_size {job.data.batch_size} > {train_ds.num_rows} rows; "
+                f"clamped to {bs}")
+    if mesh is not None:
+        bs = -(-bs // mesh.size) * mesh.size  # divisible per-device shards
+
+    history: list[EpochMetrics] = []
+    for epoch in range(start_epoch, job.train.epochs):
+        t0 = time.perf_counter()
+        # loss accumulates on device; host sync happens once per epoch so
+        # async dispatch keeps the chips busy (bench.py measures the same way)
+        loss_acc = None
+        loss_n = 0
+        for batch in pipe.batch_iterator(
+                train_ds, bs, shuffle=job.data.shuffle,
+                seed=job.data.shuffle_seed, epoch=epoch,
+                drop_remainder=job.data.drop_remainder):
+            if mesh is not None:
+                batch = shard_lib.shard_batch(batch, mesh)
+            state, step_metrics = train_step(state, batch)
+            loss = step_metrics["loss"]
+            loss_acc = loss if loss_acc is None else loss_acc + loss
+            loss_n += 1
+        if loss_n == 0:
+            raise ValueError(
+                f"epoch {epoch} produced 0 batches "
+                f"({train_ds.num_rows} rows, batch_size {bs}, "
+                f"drop_remainder={job.data.drop_remainder})")
+        loss_sum = float(jax.device_get(loss_acc))
+        epoch_time = time.perf_counter() - t0
+
+        tv0 = time.perf_counter()
+        if epoch % job.train.eval_every_epochs == 0 or epoch == job.train.epochs - 1:
+            valid_error, valid_auc = evaluate(state, valid_ds, job, eval_step, mesh)
+        else:
+            valid_error, valid_auc = float("nan"), float("nan")
+        valid_time = time.perf_counter() - tv0
+
+        m = EpochMetrics(
+            epoch=epoch,
+            train_error=loss_sum / max(loss_n, 1),
+            valid_error=valid_error,
+            valid_auc=valid_auc,
+            epoch_time=epoch_time,
+            valid_time=valid_time,
+        )
+        history.append(m)
+        console(m.console_line())
+        if epoch_callback is not None:
+            epoch_callback(m)
+
+        if manager is not None and (
+                (epoch + 1) % job.runtime.checkpoint.save_every_epochs == 0
+                or epoch == job.train.epochs - 1):
+            ckpt_lib.save(manager, int(jax.device_get(state.step)), state,
+                          extra={"epoch": epoch + 1})
+
+    return TrainResult(state=state, history=history, job=job,
+                       resumed_from_epoch=start_epoch)

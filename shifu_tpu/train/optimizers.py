@@ -1,0 +1,51 @@
+"""Optimizer factory.
+
+The reference backend uses exactly one optimizer — Adadelta at ModelConfig's
+LearningRate (resources/ssgd_monitor.py:140, fallback lr 0.003), wrapped in
+SyncReplicasOptimizer for cross-worker aggregation.  Under SPMD the
+aggregation is the mean-gradient all-reduce XLA inserts for a data-sharded
+batch, so the optimizer here is just the local update rule.  Gradient
+accumulation (optax.MultiSteps) is the analog of SAGN's k-step local window
+(resources/SAGN.py:110-142).
+"""
+
+from __future__ import annotations
+
+import optax
+
+from ..config.schema import ConfigError, OptimizerConfig
+
+# TF 1.4 AdadeltaOptimizer defaults (the reference passes only learning_rate):
+# rho=0.95, epsilon=1e-8.
+_TF_ADADELTA_RHO = 0.95
+_TF_ADADELTA_EPS = 1e-8
+
+
+def build_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    name = cfg.name.lower()
+    lr = cfg.learning_rate
+    if name == "adadelta":
+        tx = optax.adadelta(learning_rate=lr, rho=_TF_ADADELTA_RHO, eps=_TF_ADADELTA_EPS)
+    elif name == "adam":
+        tx = optax.adam(lr)
+    elif name == "adamw":
+        tx = optax.adamw(lr, weight_decay=cfg.weight_decay)
+    elif name in ("sgd", "gradientdescent"):
+        tx = optax.sgd(lr)
+    elif name == "momentum":
+        tx = optax.sgd(lr, momentum=cfg.momentum)
+    elif name == "rmsprop":
+        tx = optax.rmsprop(lr)
+    elif name == "adagrad":
+        tx = optax.adagrad(lr)
+    else:
+        raise ConfigError(f"unknown optimizer {cfg.name!r}")
+
+    chain = []
+    if cfg.grad_clip_norm > 0:
+        chain.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    chain.append(tx)
+    out = optax.chain(*chain) if len(chain) > 1 else tx
+    if cfg.accumulate_steps > 1:
+        out = optax.MultiSteps(out, every_k_schedule=cfg.accumulate_steps)
+    return out

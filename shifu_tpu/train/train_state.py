@@ -1,0 +1,40 @@
+"""Train state: params + optimizer state + step, as one pytree.
+
+Replaces the reference's PS-hosted variable set + global_step
+(resources/ssgd_monitor.py:123-127): under SPMD the whole state is one pytree
+placed by sharding rule (replicated by default, embedding tables sharded),
+and `step` is the successor of the chief-maintained global_step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads: Any) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(step=self.step + 1, params=new_params, opt_state=new_opt_state)
+
+    @classmethod
+    def create(cls, apply_fn: Callable, params: Any,
+               tx: optax.GradientTransformation) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            apply_fn=apply_fn,
+            tx=tx,
+        )

@@ -1,0 +1,76 @@
+"""Test harness config: virtual 8-device CPU mesh.
+
+Must run before jax is imported anywhere: tests exercise the multi-chip SPMD
+paths on 8 virtual CPU devices (the single-process stand-in for a TPU slice —
+SURVEY.md section 4's testability requirement the reference never met).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the image presets JAX_PLATFORMS=axon
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image's sitecustomize pre-imports parts of jax before this conftest runs,
+# so the env vars above may be too late — set the config directly as well
+# (safe: backends are not initialized until first use).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass  # older jax: XLA_FLAGS fallback above covers it
+
+# repo root importable regardless of how pytest is invoked
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def small_job():
+    """A tiny WDBC-like job config: 30 features, 2x16 MLP."""
+    from shifu_tpu.config import DataConfig, JobConfig, ModelSpec, OptimizerConfig, TrainConfig
+    from shifu_tpu.data import synthetic
+
+    schema = synthetic.make_schema(num_features=30)
+    return JobConfig(
+        schema=schema,
+        data=DataConfig(batch_size=64, valid_ratio=0.1),
+        model=ModelSpec(model_type="mlp", hidden_nodes=(16, 16),
+                        activations=("tanh", "tanh"), compute_dtype="float32"),
+        train=TrainConfig(epochs=3, optimizer=OptimizerConfig(name="adam", learning_rate=3e-3)),
+    ).validate()
+
+
+@pytest.fixture(scope="session")
+def small_data(small_job):
+    from shifu_tpu.data import pipeline, reader, synthetic
+
+    rows = synthetic.make_rows(4096, small_job.schema, seed=7, noise=0.3)
+    cols = reader.project_columns(rows, small_job.schema)
+    full = pipeline.TabularDataset(cols["features"], cols["target"], cols["weight"])
+    n = full.num_rows
+    split_at = int(n * 0.9)
+    train = full.take(np.arange(split_at))
+    valid = full.take(np.arange(split_at, n))
+    return train, valid

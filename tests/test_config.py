@@ -1,0 +1,122 @@
+"""Config schema + Shifu JSON ingestion tests.
+
+Fixture JSONs mirror the fields the reference reads from ModelConfig.json
+(reference: resources/ssgd_monitor.py:91-107,177-183) and the column selection
+the Java side derives from ColumnConfig.json."""
+
+import json
+
+import pytest
+
+from shifu_tpu.config import (
+    ConfigError,
+    JobConfig,
+    ModelSpec,
+    job_config_from_shifu,
+    parse_column_config,
+    parse_model_config,
+)
+
+MODEL_CONFIG = {
+    "basic": {"name": "wdbc"},
+    "dataSet": {"targetColumnName": "diagnosis", "weightColumnName": None},
+    "train": {
+        "baggingSampleRate": 1.0,
+        "validSetRate": 0.2,
+        "numTrainEpochs": 7,
+        "algorithm": "NN",
+        "params": {
+            "NumHiddenLayers": 2,
+            "NumHiddenNodes": [30, 10],
+            "ActivationFunc": ["tanh", "ReLU"],
+            "LearningRate": 0.05,
+            "Propagation": "Q",
+        },
+    },
+}
+
+
+def make_column_config():
+    cols = [
+        {"columnNum": 0, "columnName": "id", "columnFlag": "Meta", "finalSelect": False},
+        {"columnNum": 1, "columnName": "diagnosis", "columnFlag": "Target", "finalSelect": False},
+    ]
+    for i in range(2, 32):
+        cols.append({"columnNum": i, "columnName": f"f{i}", "columnType": "N",
+                     "finalSelect": i < 30})  # 28 selected
+    return cols
+
+
+def test_parse_model_config_topology():
+    spec, train_cfg, dataset = parse_model_config(MODEL_CONFIG)
+    assert spec.model_type == "mlp"
+    assert spec.hidden_nodes == (30, 10)
+    assert spec.activations == ("tanh", "relu")
+    assert train_cfg.epochs == 7
+    assert train_cfg.optimizer.name == "adadelta"  # Propagation Q -> reference Adadelta
+    assert train_cfg.optimizer.learning_rate == 0.05
+    assert dataset["targetColumnName"] == "diagnosis"
+
+
+def test_parse_model_config_activation_fallback():
+    mc = json.loads(json.dumps(MODEL_CONFIG))
+    mc["train"]["params"]["ActivationFunc"] = ["bogus", None]
+    spec, _, _ = parse_model_config(mc)
+    # unknown/None -> leakyrelu, like the reference (ssgd_monitor.py:77-90)
+    assert spec.activations == ("leakyrelu", "leakyrelu")
+
+
+def test_parse_column_config_selection():
+    schema = parse_column_config(make_column_config(), target_column_name="diagnosis")
+    assert schema.target_index == 1
+    assert schema.weight_index == -1
+    assert len(schema.selected_indices) == 28
+    assert 0 not in schema.selected_indices  # meta excluded
+    assert 1 not in schema.selected_indices  # target excluded
+
+
+def test_job_config_from_shifu(tmp_path):
+    mc = tmp_path / "ModelConfig.json"
+    cc = tmp_path / "ColumnConfig.json"
+    mc.write_text(json.dumps(MODEL_CONFIG))
+    cc.write_text(json.dumps(make_column_config()))
+    job = job_config_from_shifu(str(mc), str(cc))
+    assert job.data.valid_ratio == 0.2
+    assert job.model.hidden_nodes == (30, 10)
+    assert job.schema.feature_count == 28
+
+
+def test_json_roundtrip(small_job):
+    job2 = JobConfig.from_json(small_job.to_json())
+    assert job2 == small_job
+
+
+def test_validation_errors():
+    with pytest.raises(ConfigError):
+        ModelSpec(hidden_nodes=(10, 10), activations=("tanh",)).validate()
+    with pytest.raises(ConfigError):
+        ModelSpec(model_type="nope", hidden_nodes=(1,), activations=("tanh",)).validate()
+
+
+def test_hidden_nodes_shorter_than_layers_raises():
+    mc = json.loads(json.dumps(MODEL_CONFIG))
+    mc["train"]["params"]["NumHiddenLayers"] = 3
+    with pytest.raises(ConfigError):
+        parse_model_config(mc)
+
+
+def test_shifu_loss_aliases():
+    mc = json.loads(json.dumps(MODEL_CONFIG))
+    mc["train"]["params"]["Loss"] = "squared"
+    _, tc, _ = parse_model_config(mc)
+    assert tc.loss == "weighted_mse"
+    mc["train"]["params"]["Loss"] = "log"
+    _, tc, _ = parse_model_config(mc)
+    assert tc.loss == "weighted_bce"
+
+
+def test_optimizer_explicit_wins_over_propagation():
+    mc = json.loads(json.dumps(MODEL_CONFIG))
+    mc["train"]["params"]["Optimizer"] = "adam"
+    _, tc, _ = parse_model_config(mc)
+    assert tc.optimizer.name == "adam"

@@ -1,0 +1,145 @@
+"""Data layer tests: parsing, gzip, deterministic split, batching.
+
+On-disk format parity: gzip pipe-delimited float rows, the format the
+reference trainer reads (reference: resources/ssgd_monitor.py:375-385)."""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.data import (
+    batch_iterator,
+    load_datasets,
+    num_batches,
+    pad_to_batch,
+    parse_rows,
+    project_columns,
+    read_file,
+    row_uniform,
+    shard_paths,
+    train_valid_mask,
+)
+from shifu_tpu.data import synthetic
+from shifu_tpu.data.pipeline import TabularDataset
+from shifu_tpu.config import DataConfig
+
+
+def test_parse_rows_basic():
+    out = parse_rows("1|2.5|3\n4|5|6.25\n")
+    np.testing.assert_allclose(out, [[1, 2.5, 3], [4, 5, 6.25]])
+
+
+def test_parse_rows_bad_cell_is_nan():
+    out = parse_rows("1|x|3\n4|5|6\n")
+    assert out.shape == (2, 3)
+    assert np.isnan(out[0, 1])
+    assert out[1, 1] == 5
+
+
+def test_parse_rows_empty():
+    assert parse_rows("").size == 0
+
+
+def test_gzip_roundtrip(tmp_path):
+    schema = synthetic.make_schema(num_features=5)
+    rows = synthetic.make_rows(100, schema, seed=1)
+    paths = synthetic.write_files(rows, str(tmp_path / "d"), num_files=3)
+    assert all(p.endswith(".gz") for p in paths)
+    back = np.concatenate([read_file(p) for p in paths])
+    np.testing.assert_allclose(back, rows, rtol=1e-4, atol=1e-5)
+
+
+def test_project_columns_weight_clamp():
+    schema = synthetic.make_schema(num_features=2, with_weight=True)
+    rows = np.array([[1.0, -3.0, 0.5, 0.5],
+                     [0.0, 2.0, 0.1, 0.2]], dtype=np.float32)
+    cols = project_columns(rows, schema)
+    # negative weight clamps to 1.0 (reference: ssgd_monitor.py:413-417)
+    assert cols["weight"][0, 0] == 1.0
+    assert cols["weight"][1, 0] == 2.0
+
+
+def test_split_deterministic():
+    ids = np.arange(10000, dtype=np.uint64)
+    t1, v1 = train_valid_mask(ids, 0.1, seed=3)
+    t2, v2 = train_valid_mask(ids, 0.1, seed=3)
+    np.testing.assert_array_equal(v1, v2)
+    assert 0.08 < v1.mean() < 0.12
+    _, v3 = train_valid_mask(ids, 0.1, seed=4)
+    assert (v1 != v3).any()
+
+
+def test_row_uniform_distribution():
+    u = row_uniform(np.arange(50000, dtype=np.uint64), seed=9)
+    assert 0.0 <= u.min() and u.max() < 1.0
+    assert abs(u.mean() - 0.5) < 0.01
+
+
+def test_shard_paths_round_robin():
+    paths = [f"p{i}" for i in range(10)]
+    shards = [shard_paths(paths, i, 3) for i in range(3)]
+    assert sorted(sum(shards, [])) == sorted(paths)
+    assert len(shards[0]) == 4
+
+
+def test_load_datasets_end_to_end(tmp_path):
+    schema = synthetic.make_schema(num_features=8)
+    rows = synthetic.make_rows(2000, schema, seed=2)
+    synthetic.write_files(rows, str(tmp_path / "data"), num_files=4)
+    cfg = DataConfig(paths=(str(tmp_path / "data"),), valid_ratio=0.1)
+    train, valid = load_datasets(schema, cfg)
+    assert train.num_rows + valid.num_rows == 2000
+    assert 100 < valid.num_rows < 300
+    assert train.num_features == 8
+    # two-host sharding covers all rows exactly once
+    t0, v0 = load_datasets(schema, cfg, host_index=0, num_hosts=2)
+    t1, v1 = load_datasets(schema, cfg, host_index=1, num_hosts=2)
+    assert t0.num_rows + v0.num_rows + t1.num_rows + v1.num_rows == 2000
+
+
+def test_batch_iterator_shapes_and_determinism():
+    ds = TabularDataset(
+        features=np.arange(100 * 3, dtype=np.float32).reshape(100, 3),
+        target=np.zeros((100, 1), np.float32),
+        weight=np.ones((100, 1), np.float32),
+    )
+    batches = list(batch_iterator(ds, 32, shuffle=True, seed=5, epoch=0))
+    assert len(batches) == 3 == num_batches(ds, 32)
+    assert all(b["features"].shape == (32, 3) for b in batches)
+    again = list(batch_iterator(ds, 32, shuffle=True, seed=5, epoch=0))
+    np.testing.assert_array_equal(batches[0]["features"], again[0]["features"])
+    other_epoch = list(batch_iterator(ds, 32, shuffle=True, seed=5, epoch=1))
+    assert (batches[0]["features"] != other_epoch[0]["features"]).any()
+
+
+def test_pad_to_batch_zero_weight():
+    batch = {
+        "features": np.ones((5, 2), np.float32),
+        "target": np.ones((5, 1), np.float32),
+        "weight": np.ones((5, 1), np.float32),
+    }
+    padded, mask = pad_to_batch(batch, 8)
+    assert padded["features"].shape == (8, 2)
+    assert mask.sum() == 5
+    assert padded["weight"][5:].sum() == 0.0
+
+
+def test_parse_rows_bad_cell_mid_file_keeps_all_rows():
+    # regression: a bad cell must not silently drop subsequent rows
+    out = parse_rows("1|2\nabc|4\n5|6")
+    assert out.shape == (3, 2)
+    assert np.isnan(out[1, 0])
+    assert out[2, 0] == 5.0
+
+
+def test_load_datasets_duplicate_paths_distinct_ids(tmp_path):
+    schema = synthetic.make_schema(num_features=4)
+    rows = synthetic.make_rows(100, schema, seed=3)
+    paths = synthetic.write_files(rows, str(tmp_path / "d"), num_files=1)
+    cfg = DataConfig(paths=(paths[0], paths[0]), valid_ratio=0.5, split_seed=1)
+    train, valid = load_datasets(schema, cfg)
+    # duplicate files get distinct row-id bases, so the two copies split
+    # independently (same mask would give exactly 2x one copy's counts)
+    assert train.num_rows + valid.num_rows == 200

@@ -1,0 +1,122 @@
+"""Losses / metrics / activations parity tests.
+
+weighted_mse must reproduce TF's `tf.losses.mean_squared_error(...,
+weights=w)` SUM_BY_NONZERO_WEIGHTS semantics, the exact loss the reference
+optimizes (reference: resources/ssgd_monitor.py:129)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.ops import (
+    auc,
+    bce,
+    get_activation,
+    get_loss,
+    weighted_bce,
+    weighted_error,
+    weighted_mse,
+)
+from shifu_tpu.ops.initializers import xavier_bias
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_weighted_mse_matches_tf_semantics():
+    rng = np.random.default_rng(0)
+    logits = rng.standard_normal((16, 1)).astype(np.float32)
+    target = (rng.random((16, 1)) < 0.5).astype(np.float32)
+    weight = rng.uniform(0, 2, (16, 1)).astype(np.float32)
+    weight[3] = 0.0  # zero-weight row excluded from the denominator
+    got = float(weighted_mse(jnp.array(logits), jnp.array(target), jnp.array(weight)))
+    p = _sigmoid(logits)
+    expected = np.sum(weight * (p - target) ** 2) / np.sum(weight != 0)
+    assert got == pytest.approx(expected, rel=1e-5)
+
+
+def test_weighted_mse_all_ones_weight_is_plain_mse():
+    logits = jnp.array([[0.0], [2.0]])
+    target = jnp.array([[0.0], [1.0]])
+    weight = jnp.ones((2, 1))
+    got = float(weighted_mse(logits, target, weight))
+    p = _sigmoid(np.array([[0.0], [2.0]]))
+    assert got == pytest.approx(float(np.mean((p - np.array([[0.], [1.]])) ** 2)), rel=1e-5)
+
+
+def test_bce_matches_reference_formula():
+    logits = jnp.array([[0.5], [-1.0], [3.0]])
+    target = jnp.array([[1.0], [0.0], [1.0]])
+    got = float(bce(logits, target, jnp.ones((3, 1))))
+    l = np.array([0.5, -1.0, 3.0])
+    y = np.array([1.0, 0.0, 1.0])
+    expected = np.mean(np.maximum(l, 0) - l * y + np.log1p(np.exp(-np.abs(l))))
+    assert got == pytest.approx(expected, rel=1e-4)  # float32 compute
+
+
+def test_weighted_bce_zero_weight_rows_ignored():
+    logits = jnp.array([[1.0], [99.0]])
+    target = jnp.array([[1.0], [0.0]])
+    weight = jnp.array([[1.0], [0.0]])
+    got = float(weighted_bce(logits, target, weight))
+    l = 1.0
+    expected = np.log1p(np.exp(-l))
+    assert got == pytest.approx(expected, rel=1e-5)
+
+
+def test_get_loss_unknown():
+    with pytest.raises(KeyError):
+        get_loss("nope")
+
+
+def test_auc_perfect_and_random():
+    labels = np.array([0, 0, 1, 1])
+    assert auc(np.array([0.1, 0.2, 0.8, 0.9]), labels) == 1.0
+    assert auc(np.array([0.9, 0.8, 0.2, 0.1]), labels) == 0.0
+    assert auc(np.array([0.5, 0.5, 0.5, 0.5]), labels) == 0.5
+
+
+def test_auc_matches_sklearn_when_available():
+    sk = pytest.importorskip("sklearn.metrics")
+    rng = np.random.default_rng(1)
+    scores = rng.random(500)
+    labels = (rng.random(500) < 0.3).astype(float)
+    scores[labels == 1] += 0.2  # separable-ish
+    assert auc(scores, labels) == pytest.approx(
+        sk.roc_auc_score(labels, scores), abs=1e-10)
+    w = rng.uniform(0.1, 3.0, 500)
+    assert auc(scores, labels, w) == pytest.approx(
+        sk.roc_auc_score(labels, scores, sample_weight=w), abs=1e-10)
+
+
+def test_auc_with_ties():
+    scores = np.array([0.5, 0.5, 0.5, 0.1])
+    labels = np.array([1, 0, 1, 0])
+    # each positive ties one negative (0.5 credit each) and beats the 0.1 negative
+    expected = (0.5 * 1 + 1) / 2  # per positive: (0.5 + 1)/2 negatives
+    assert auc(scores, labels) == pytest.approx(expected)
+
+
+def test_weighted_error_nonzero_denominator():
+    s = np.array([0.5, 0.8])
+    y = np.array([0.0, 1.0])
+    w = np.array([1.0, 0.0])
+    assert weighted_error(s, y, w) == pytest.approx(0.25)
+
+
+def test_activation_fallback_and_leaky_alpha():
+    f = get_activation("unknown_thing")
+    # reference fallback: leaky_relu with TF alpha 0.2 (ssgd_monitor.py:77-90)
+    assert float(f(jnp.array(-1.0))) == pytest.approx(-0.2)
+    assert float(get_activation("relu")(jnp.array(-1.0))) == 0.0
+
+
+def test_xavier_bias_range():
+    key = jax.random.PRNGKey(0)
+    b = xavier_bias(key, (100,))
+    limit = np.sqrt(3.0 / 100)
+    assert float(jnp.abs(b).max()) <= limit
+    assert float(jnp.abs(b).max()) > limit * 0.5  # actually spread out

@@ -1,0 +1,78 @@
+"""End-to-end single-host training tests — the minimum e2e slice.
+
+The acceptance bar mirrors the reference's observable behavior: training on a
+learnable synthetic tabular set drives weighted error down and valid AUC well
+above chance (the reference's only accuracy contract is AUC parity —
+BASELINE.md), and per-epoch console lines are emitted."""
+
+import numpy as np
+import pytest
+
+from shifu_tpu.train import train
+
+
+def test_train_e2e_learns(small_job, small_data):
+    train_ds, valid_ds = small_data
+    lines = []
+    result = train(small_job, train_ds, valid_ds, console=lines.append)
+    assert len(result.history) == small_job.train.epochs
+    last = result.history[-1]
+    assert last.valid_auc > 0.65, f"model failed to learn: auc={last.valid_auc}"
+    assert last.train_error < result.history[0].train_error or last.valid_auc > 0.8
+    assert len(lines) == small_job.train.epochs
+    assert "valid_auc" in lines[-1]
+
+
+def test_train_deterministic(small_job, small_data):
+    train_ds, valid_ds = small_data
+    job = small_job.replace(train=small_job.train)
+    r1 = train(job, train_ds, valid_ds, console=lambda s: None)
+    r2 = train(job, train_ds, valid_ds, console=lambda s: None)
+    assert r1.history[-1].train_error == pytest.approx(
+        r2.history[-1].train_error, rel=1e-6)
+    assert r1.history[-1].valid_auc == pytest.approx(
+        r2.history[-1].valid_auc, abs=1e-9)
+
+
+def test_train_adadelta_reference_optimizer(small_job, small_data):
+    """The reference's exact optimizer (Adadelta, ssgd_monitor.py:140) must
+    also learn, at its default-ish LR."""
+    from shifu_tpu.config import OptimizerConfig
+    train_ds, valid_ds = small_data
+    job = small_job.replace(train=small_job.train.__class__(
+        epochs=5,
+        loss="weighted_mse",
+        optimizer=OptimizerConfig(name="adadelta", learning_rate=1.0),
+    ))
+    result = train(job, train_ds, valid_ds, console=lambda s: None)
+    assert result.history[-1].valid_auc > 0.6
+
+
+def test_gradient_accumulation(small_job, small_data):
+    from shifu_tpu.config import OptimizerConfig
+    train_ds, valid_ds = small_data
+    job = small_job.replace(train=small_job.train.__class__(
+        epochs=2,
+        optimizer=OptimizerConfig(name="adam", learning_rate=3e-3, accumulate_steps=4),
+    ))
+    result = train(job, train_ds, valid_ds, console=lambda s: None)
+    assert np.isfinite(result.history[-1].train_error)
+
+
+def test_small_dataset_clamps_batch_and_trains(small_job, small_data):
+    """Regression: dataset smaller than batch_size must not silently no-op."""
+    train_ds, valid_ds = small_data
+    tiny = train_ds.take(np.arange(40))  # < batch_size 64
+    lines = []
+    result = train(small_job.replace(train=small_job.train.__class__(epochs=1)),
+                   tiny, valid_ds, console=lines.append)
+    assert any("clamped" in l for l in lines)
+    assert np.isfinite(result.history[-1].train_error)
+
+
+def test_empty_dataset_raises(small_job, small_data):
+    train_ds, valid_ds = small_data
+    empty = train_ds.take(np.arange(0))
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="0 rows"):
+        train(small_job, empty, valid_ds, console=lambda s: None)
