@@ -1,0 +1,4 @@
+from .artifact import build_program, export_stablehlo, save_artifact
+from .scorer import Scorer, load_scorer
+
+__all__ = ["build_program", "export_stablehlo", "save_artifact", "Scorer", "load_scorer"]

@@ -1,0 +1,145 @@
+"""Model export: the scoring artifact + Shifu sidecar.
+
+Replaces the reference chief worker's end-of-training export
+(resources/ssgd_monitor.py:302-345 rebuild-graph + SavedModel write, sidecar
+at :457-490): after training, the framework writes a self-contained artifact
+directory that the eval side scores WITHOUT any TF/JAX runtime:
+
+    <export_dir>/
+      GenericModelConfig.json   # byte-compatible sidecar fields (inputnames=
+                                # [shifu_input_0], outputnames=shifu_output_0,
+                                # normtype=ZSCALE, tags=[serve])
+      topology.json             # format v1: an op-list "program" + metadata
+      weights.npz               # flat params, keys referenced by the program
+      scoring.mlir              # StableHLO of the scoring fn (AOT/native path)
+
+The op-list program is the artifact's executable spec: a sequence of simple
+ops (dense / activation / sigmoid head) interpreted identically by the Python
+scorer (export/scorer.py) and the native C++ scorer (runtime/), so every
+scorer implementation scores bit-for-bit the same model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from ..config.schema import JobConfig, ModelSpec
+
+FORMAT_VERSION = 1
+SIDE_CAR = "GenericModelConfig.json"
+TOPOLOGY = "topology.json"
+WEIGHTS = "weights.npz"
+STABLEHLO = "scoring.mlir"
+
+
+def _key_name(entry: Any) -> str:
+    if hasattr(entry, "key"):
+        return str(entry.key)
+    if hasattr(entry, "idx"):
+        return str(entry.idx)
+    return str(entry)
+
+
+def _flatten_params(params: Any) -> dict[str, np.ndarray]:
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    return {"/".join(_key_name(e) for e in kp): np.asarray(jax.device_get(leaf))
+            for kp, leaf in flat}
+
+
+def build_program(spec: ModelSpec) -> list[dict[str, Any]]:
+    """The op-list for sequential (MLP-family) models.
+
+    Each dense op references weight keys in weights.npz; the trailing sigmoid
+    reproduces the reference's sigmoid scoring head (ssgd_monitor.py:121).
+    """
+    if spec.model_type != "mlp":
+        raise NotImplementedError(
+            f"op-list export for model_type={spec.model_type!r} not yet supported")
+    program: list[dict[str, Any]] = []
+    for i, act in enumerate(spec.activations):
+        program.append({
+            "op": "dense",
+            "kernel": f"trunk/hidden_layer{i}/Dense_0/kernel",
+            "bias": f"trunk/hidden_layer{i}/Dense_0/bias",
+            "activation": act,
+        })
+    program.append({
+        "op": "dense",
+        "kernel": "head/shifu_output_0/Dense_0/kernel",
+        "bias": "head/shifu_output_0/Dense_0/bias",
+        "activation": "sigmoid",
+    })
+    return program
+
+
+def export_stablehlo(forward_fn, params, num_features: int, path: str,
+                     batch: int = 1) -> bool:
+    """Serialize the scoring fn to StableHLO text (input for the AOT/native
+    compile path).  Best-effort: returns False when jax.export is unavailable."""
+    try:
+        from jax import export as jax_export
+        import jax.numpy as jnp
+
+        fn = lambda feats: forward_fn(params, feats)
+        exported = jax_export.export(jax.jit(fn))(
+            jax.ShapeDtypeStruct((batch, num_features), jnp.float32))
+        with open(path, "w") as f:
+            f.write(exported.mlir_module())
+        return True
+    except Exception:
+        return False
+
+
+def save_artifact(params: Any, job: JobConfig, export_dir: str,
+                  forward_fn=None, algorithm: str = "tensorflow") -> str:
+    """Write the full scoring artifact; returns export_dir.
+
+    `algorithm` defaults to "tensorflow" for byte-level sidecar parity with
+    the reference (ssgd_monitor.py:476-490) so an unmodified Shifu eval step
+    routes the model to its generic scorer the same way.
+    """
+    os.makedirs(export_dir, exist_ok=True)
+
+    flat = _flatten_params(params)
+    np.savez(os.path.join(export_dir, WEIGHTS), **flat)
+
+    program = build_program(job.model)
+    missing = [op[k] for op in program for k in ("kernel", "bias")
+               if op.get(k) and op[k] not in flat]
+    if missing:
+        raise ValueError(f"program references missing weights: {missing}; "
+                         f"have {sorted(flat)}")
+
+    topology = {
+        "format_version": FORMAT_VERSION,
+        "model_type": job.model.model_type,
+        "num_features": job.schema.feature_count,
+        "num_heads": job.model.num_heads,
+        "head_names": list(job.model.head_names),
+        "selected_indices": list(job.schema.selected_indices),
+        "program": program,
+    }
+    with open(os.path.join(export_dir, TOPOLOGY), "w") as f:
+        json.dump(topology, f, indent=2)
+
+    sidecar = {
+        "inputnames": ["shifu_input_0"],
+        "properties": {
+            "algorithm": algorithm,
+            "tags": ["serve"],
+            "outputnames": "shifu_output_0",
+            "normtype": "ZSCALE",
+        },
+    }
+    with open(os.path.join(export_dir, SIDE_CAR), "w") as f:
+        json.dump(sidecar, f, indent=4)
+
+    if forward_fn is not None:
+        export_stablehlo(forward_fn, params, job.schema.feature_count,
+                         os.path.join(export_dir, STABLEHLO))
+    return export_dir

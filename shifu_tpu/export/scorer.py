@@ -1,0 +1,92 @@
+"""Pure-numpy scorer for exported artifacts — no JAX/TF at score time.
+
+Functional replacement for the reference's eval module
+(shifu-tensorflow-eval/src/main/java/ml/shifu/shifu/tensorflow/
+TensorflowModel.java): `init` loads the artifact (:112-172), `compute` scores
+one row double->float->double in [0,1] (:52-109).  Improvements over the
+reference: batch scoring (`compute_batch`), zero native runtime dependency
+for the Python path, and the same op-list program is also executed by the
+native C++ scorer (shifu_tpu/runtime) for JVM callers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Sequence
+
+import numpy as np
+
+from .artifact import SIDE_CAR, TOPOLOGY, WEIGHTS
+
+_LEAKY_ALPHA = 0.2  # keep in sync with ops/activations.py
+
+
+def _act(name: str, x: np.ndarray) -> np.ndarray:
+    if name == "sigmoid":
+        # numerically stable piecewise sigmoid
+        out = np.empty_like(x)
+        pos = x >= 0
+        out[pos] = 1.0 / (1.0 + np.exp(-x[pos]))
+        ex = np.exp(x[~pos])
+        out[~pos] = ex / (1.0 + ex)
+        return out
+    if name == "tanh":
+        return np.tanh(x)
+    if name == "relu":
+        return np.maximum(x, 0.0)
+    if name == "leakyrelu":
+        return np.where(x >= 0, x, _LEAKY_ALPHA * x)
+    if name in (None, "", "linear"):
+        return x
+    raise ValueError(f"unknown activation {name!r}")
+
+
+class Scorer:
+    """Loads an artifact directory and scores rows.
+
+    API parity with TensorflowModel: `compute(row) -> float` for one row
+    (TensorflowModel.java:52-109); `compute_batch(rows) -> (N, H)` is the
+    batch extension the reference lacked.
+    """
+
+    def __init__(self, export_dir: str):
+        with open(os.path.join(export_dir, TOPOLOGY)) as f:
+            self.topology = json.load(f)
+        with open(os.path.join(export_dir, SIDE_CAR)) as f:
+            self.sidecar = json.load(f)
+        if self.topology.get("format_version") != 1:
+            raise ValueError(f"unsupported artifact format: "
+                             f"{self.topology.get('format_version')}")
+        with np.load(os.path.join(export_dir, WEIGHTS)) as z:
+            self.weights = {k: z[k].astype(np.float32) for k in z.files}
+        self.num_features = int(self.topology["num_features"])
+        self.program = self.topology["program"]
+        self.input_names = self.sidecar.get("inputnames", ["shifu_input_0"])
+        self.output_name = self.sidecar.get("properties", {}).get(
+            "outputnames", "shifu_output_0")
+
+    def compute_batch(self, rows: np.ndarray) -> np.ndarray:
+        """Score (N, F) float rows -> (N, num_heads) probabilities."""
+        x = np.asarray(rows, dtype=np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.shape[1] != self.num_features:
+            raise ValueError(
+                f"expected {self.num_features} features, got {x.shape[1]}")
+        for op in self.program:
+            if op["op"] == "dense":
+                x = x @ self.weights[op["kernel"]] + self.weights[op["bias"]]
+                x = _act(op.get("activation"), x)
+            else:
+                raise ValueError(f"unknown op {op['op']!r}")
+        return x
+
+    def compute(self, row: Sequence[float]) -> float:
+        """Single-row double score in [0,1] — the reference's exact call shape
+        (double[] in, single double out, TensorflowModel.java:63-91)."""
+        return float(self.compute_batch(np.asarray(row, dtype=np.float64))[0, 0])
+
+
+def load_scorer(export_dir: str) -> Scorer:
+    return Scorer(export_dir)

@@ -1,0 +1,3 @@
+from .native_scorer import MODEL_BIN, NativeScorer, build_library, pack_native
+
+__all__ = ["MODEL_BIN", "NativeScorer", "build_library", "pack_native"]

@@ -1,0 +1,187 @@
+// shifu_scorer — native CPU scoring engine for exported shifu_tpu artifacts.
+//
+// This is the framework's authored native-code component, replacing the
+// reference's use of the TensorFlow 1.4 C++ runtime over JNI
+// (reference: shifu-tensorflow-eval/pom.xml:59-73 libtensorflow_jni, loaded
+// by TensorflowModel.java:169 SavedModelBundle.load).  Where the reference
+// dragged in a full TF runtime to score a small MLP row-at-a-time, this is a
+// dependency-free C ABI library (~no runtime deps beyond libm) that executes
+// the artifact's op-list program: a chain of dense layers with fused
+// activations, matching export/scorer.py bit-for-bit in float32.
+//
+// Model file format ("model.bin", little-endian, packed by
+// shifu_tpu/runtime/native_scorer.py:pack_native):
+//   magic   u32 = 0x55464853 ("SHFU")
+//   version u32 = 1
+//   num_features u32, num_heads u32, num_ops u32
+//   per op:
+//     activation u32 (0 linear, 1 sigmoid, 2 tanh, 3 relu, 4 leakyrelu)
+//     in_dim u32, out_dim u32
+//     kernel f32[in_dim*out_dim]  (row-major, [in][out])
+//     bias   f32[out_dim]
+//
+// C ABI (bind from Java via JNA/JNI, from Python via ctypes):
+//   shifu_scorer_load / _free / _num_features / _num_heads /
+//   shifu_scorer_compute_batch (float rows) / shifu_scorer_compute (double row)
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x55464853u;  // "SHFU"
+constexpr float kLeakyAlpha = 0.2f;       // TF 1.4 leaky_relu default (parity)
+
+enum Activation : uint32_t {
+  kLinear = 0,
+  kSigmoid = 1,
+  kTanh = 2,
+  kRelu = 3,
+  kLeakyRelu = 4,
+};
+
+struct DenseOp {
+  uint32_t activation;
+  uint32_t in_dim;
+  uint32_t out_dim;
+  std::vector<float> kernel;  // [in][out]
+  std::vector<float> bias;    // [out]
+};
+
+struct Model {
+  uint32_t num_features = 0;
+  uint32_t num_heads = 0;
+  std::vector<DenseOp> ops;
+  uint32_t max_width = 0;
+};
+
+bool read_u32(FILE* f, uint32_t* out) {
+  return std::fread(out, sizeof(uint32_t), 1, f) == 1;
+}
+
+float apply_act(uint32_t act, float x) {
+  switch (act) {
+    case kSigmoid:
+      // stable piecewise sigmoid, same formulation as the python scorer
+      if (x >= 0.0f) return 1.0f / (1.0f + std::exp(-x));
+      { float e = std::exp(x); return e / (1.0f + e); }
+    case kTanh: return std::tanh(x);
+    case kRelu: return x > 0.0f ? x : 0.0f;
+    case kLeakyRelu: return x >= 0.0f ? x : kLeakyAlpha * x;
+    default: return x;
+  }
+}
+
+// y[b][out] = act(x[b][in] @ kernel[in][out] + bias[out])
+// Row-major kernel keeps the inner loop contiguous over `out` so the
+// compiler vectorizes it; batches iterate outermost.
+void dense_forward(const DenseOp& op, const float* x, float* y, int batch) {
+  const uint32_t in = op.in_dim, out = op.out_dim;
+  for (int b = 0; b < batch; ++b) {
+    const float* row = x + static_cast<size_t>(b) * in;
+    float* dst = y + static_cast<size_t>(b) * out;
+    std::memcpy(dst, op.bias.data(), out * sizeof(float));
+    for (uint32_t i = 0; i < in; ++i) {
+      const float v = row[i];
+      const float* krow = op.kernel.data() + static_cast<size_t>(i) * out;
+      for (uint32_t o = 0; o < out; ++o) dst[o] += v * krow[o];
+    }
+    for (uint32_t o = 0; o < out; ++o) dst[o] = apply_act(op.activation, dst[o]);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* shifu_scorer_load(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  auto model = new Model();
+  uint32_t magic = 0, version = 0, num_ops = 0;
+  bool ok = read_u32(f, &magic) && magic == kMagic &&
+            read_u32(f, &version) && version == 1 &&
+            read_u32(f, &model->num_features) &&
+            read_u32(f, &model->num_heads) && read_u32(f, &num_ops);
+  if (ok) {
+    model->max_width = model->num_features;
+    model->ops.resize(num_ops);
+    for (uint32_t i = 0; ok && i < num_ops; ++i) {
+      DenseOp& op = model->ops[i];
+      ok = read_u32(f, &op.activation) && read_u32(f, &op.in_dim) &&
+           read_u32(f, &op.out_dim);
+      if (!ok) break;
+      op.kernel.resize(static_cast<size_t>(op.in_dim) * op.out_dim);
+      op.bias.resize(op.out_dim);
+      ok = std::fread(op.kernel.data(), sizeof(float), op.kernel.size(), f) ==
+               op.kernel.size() &&
+           std::fread(op.bias.data(), sizeof(float), op.bias.size(), f) ==
+               op.bias.size();
+      if (op.out_dim > model->max_width) model->max_width = op.out_dim;
+      if (op.in_dim > model->max_width) model->max_width = op.in_dim;
+    }
+  }
+  std::fclose(f);
+  if (!ok) {
+    delete model;
+    return nullptr;
+  }
+  return model;
+}
+
+void shifu_scorer_free(void* handle) { delete static_cast<Model*>(handle); }
+
+int shifu_scorer_num_features(void* handle) {
+  return handle ? static_cast<int>(static_cast<Model*>(handle)->num_features) : -1;
+}
+
+int shifu_scorer_num_heads(void* handle) {
+  return handle ? static_cast<int>(static_cast<Model*>(handle)->num_heads) : -1;
+}
+
+// rows: [n][num_features] float32; out: [n][num_heads]. Returns 0 on success.
+int shifu_scorer_compute_batch(void* handle, const float* rows, int n,
+                               float* out) {
+  if (!handle || !rows || !out || n <= 0) return 1;
+  const Model& m = *static_cast<Model*>(handle);
+  const size_t width = m.max_width;
+  std::vector<float> buf_a(static_cast<size_t>(n) * width);
+  std::vector<float> buf_b(static_cast<size_t>(n) * width);
+  // pack input into buf_a (contiguous at num_features stride)
+  std::memcpy(buf_a.data(), rows,
+              static_cast<size_t>(n) * m.num_features * sizeof(float));
+  const float* cur = buf_a.data();
+  float* nxt = buf_b.data();
+  uint32_t cur_dim = m.num_features;
+  for (const DenseOp& op : m.ops) {
+    if (op.in_dim != cur_dim) return 2;  // corrupt program
+    dense_forward(op, cur, nxt, n);
+    cur_dim = op.out_dim;
+    const float* tmp = cur;
+    cur = nxt;
+    nxt = const_cast<float*>(tmp);
+  }
+  if (cur_dim != m.num_heads) return 3;
+  std::memcpy(out, cur, static_cast<size_t>(n) * m.num_heads * sizeof(float));
+  return 0;
+}
+
+// Single-row double API, mirroring TensorflowModel.compute's double[] in /
+// double out contract (TensorflowModel.java:52-109).
+double shifu_scorer_compute(void* handle, const double* row) {
+  if (!handle || !row) return -1.0;
+  const Model& m = *static_cast<Model*>(handle);
+  std::vector<float> frow(m.num_features);
+  for (uint32_t i = 0; i < m.num_features; ++i)
+    frow[i] = static_cast<float>(row[i]);
+  std::vector<float> out(m.num_heads);
+  if (shifu_scorer_compute_batch(handle, frow.data(), 1, out.data()) != 0)
+    return -1.0;
+  return static_cast<double>(out[0]);
+}
+
+}  // extern "C"

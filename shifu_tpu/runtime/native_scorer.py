@@ -1,0 +1,134 @@
+"""Native scorer packaging, build, and ctypes binding.
+
+Pipeline: `pack_native(export_dir)` converts an artifact's topology.json +
+weights.npz into the flat `model.bin` the C++ engine mmaps;
+`build_library()` compiles `csrc/shifu_scorer.cc` once (g++, no deps);
+`NativeScorer` binds the C ABI via ctypes with the same compute /
+compute_batch API as the Python Scorer.  Java callers bind the same .so via
+JNA/JNI — that is the JVM path replacing the reference's
+libtensorflow_jni-backed TensorflowModel (TensorflowModel.java:169).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import struct
+import subprocess
+from typing import Optional, Sequence
+
+import numpy as np
+
+_ACT_IDS = {"linear": 0, None: 0, "": 0, "sigmoid": 1, "tanh": 2,
+            "relu": 3, "leakyrelu": 4}
+
+_MAGIC = 0x55464853  # "SHFU"
+MODEL_BIN = "model.bin"
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc", "shifu_scorer.cc")
+_LIB_NAME = "libshifu_scorer.so"
+
+
+def pack_native(export_dir: str) -> str:
+    """Pack topology.json + weights.npz into model.bin; returns its path."""
+    with open(os.path.join(export_dir, "topology.json")) as f:
+        topo = json.load(f)
+    with np.load(os.path.join(export_dir, "weights.npz")) as z:
+        weights = {k: np.asarray(z[k], dtype=np.float32) for k in z.files}
+
+    out_path = os.path.join(export_dir, MODEL_BIN)
+    with open(out_path, "wb") as f:
+        program = topo["program"]
+        f.write(struct.pack("<5I", _MAGIC, 1, int(topo["num_features"]),
+                            int(topo["num_heads"]), len(program)))
+        for op in program:
+            if op["op"] != "dense":
+                raise ValueError(f"native pack: unsupported op {op['op']!r}")
+            kernel = weights[op["kernel"]]
+            bias = weights[op["bias"]]
+            if kernel.ndim != 2 or bias.shape != (kernel.shape[1],):
+                raise ValueError(f"bad shapes for {op['kernel']}: "
+                                 f"{kernel.shape} / {bias.shape}")
+            act = _ACT_IDS.get(op.get("activation"), None)
+            if act is None:
+                raise ValueError(f"unknown activation {op.get('activation')!r}")
+            f.write(struct.pack("<3I", act, kernel.shape[0], kernel.shape[1]))
+            f.write(np.ascontiguousarray(kernel).tobytes())
+            f.write(np.ascontiguousarray(bias).tobytes())
+    return out_path
+
+
+def build_library(out_dir: Optional[str] = None, force: bool = False) -> str:
+    """Compile the C++ engine into a shared library (cached); returns path."""
+    out_dir = out_dir or os.path.join(os.path.dirname(_SRC), "..", "_build")
+    out_dir = os.path.abspath(out_dir)
+    os.makedirs(out_dir, exist_ok=True)
+    lib_path = os.path.join(out_dir, _LIB_NAME)
+    if os.path.exists(lib_path) and not force and (
+            os.path.getmtime(lib_path) >= os.path.getmtime(_SRC)):
+        return lib_path
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", lib_path, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return lib_path
+
+
+class NativeScorer:
+    """ctypes wrapper over the C ABI; API-compatible with export.Scorer."""
+
+    def __init__(self, export_dir: str, lib_path: Optional[str] = None):
+        bin_path = os.path.join(export_dir, MODEL_BIN)
+        if not os.path.exists(bin_path):
+            pack_native(export_dir)
+        self._lib = ctypes.CDLL(lib_path or build_library())
+        self._lib.shifu_scorer_load.restype = ctypes.c_void_p
+        self._lib.shifu_scorer_load.argtypes = [ctypes.c_char_p]
+        self._lib.shifu_scorer_free.argtypes = [ctypes.c_void_p]
+        self._lib.shifu_scorer_num_features.argtypes = [ctypes.c_void_p]
+        self._lib.shifu_scorer_num_heads.argtypes = [ctypes.c_void_p]
+        self._lib.shifu_scorer_compute_batch.restype = ctypes.c_int
+        self._lib.shifu_scorer_compute_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float)]
+        self._lib.shifu_scorer_compute.restype = ctypes.c_double
+        self._lib.shifu_scorer_compute.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_double)]
+        self._handle = self._lib.shifu_scorer_load(bin_path.encode())
+        if not self._handle:
+            raise RuntimeError(f"failed to load native model: {bin_path}")
+        self.num_features = self._lib.shifu_scorer_num_features(self._handle)
+        self.num_heads = self._lib.shifu_scorer_num_heads(self._handle)
+
+    def compute_batch(self, rows: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(rows, dtype=np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.shape[1] != self.num_features:
+            raise ValueError(f"expected {self.num_features} features, got {x.shape[1]}")
+        n = x.shape[0]
+        out = np.empty((n, self.num_heads), dtype=np.float32)
+        rc = self._lib.shifu_scorer_compute_batch(
+            self._handle,
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise RuntimeError(f"native scorer error code {rc}")
+        return out
+
+    def compute(self, row: Sequence[float]) -> float:
+        r = np.ascontiguousarray(row, dtype=np.float64)
+        if r.shape[0] != self.num_features:
+            raise ValueError(f"expected {self.num_features} features, got {r.shape[0]}")
+        return float(self._lib.shifu_scorer_compute(
+            self._handle, r.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.shifu_scorer_free(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best-effort
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,69 @@
+"""Checkpoint / auto-resume tests — the SPMD fault-tolerance story replacing
+the reference's hot-standby backup workers (SURVEY.md section 5.3: parity =
+health monitoring + automatic checkpoint-restart)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from shifu_tpu.config import CheckpointConfig, RuntimeConfig
+from shifu_tpu.train import train
+
+
+def _with_ckpt(job, directory, epochs=None):
+    return job.replace(
+        train=job.train.__class__(epochs=epochs or job.train.epochs,
+                                  optimizer=job.train.optimizer),
+        runtime=RuntimeConfig(checkpoint=CheckpointConfig(
+            directory=directory, save_every_epochs=1)),
+    )
+
+
+def test_save_and_auto_resume(tmp_path, small_job, small_data):
+    train_ds, valid_ds = small_data
+    job = _with_ckpt(small_job, str(tmp_path / "ckpt"), epochs=3)
+
+    r1 = train(job, train_ds, valid_ds, console=lambda s: None)
+    assert len(r1.history) == 3
+
+    # second run: everything done, restores and runs 0 epochs
+    lines = []
+    r2 = train(job, train_ds, valid_ds, console=lines.append)
+    assert r2.resumed_from_epoch == 3
+    assert len(r2.history) == 0
+    assert any("Resumed" in l for l in lines)
+
+
+def test_resume_continues_training(tmp_path, small_job, small_data):
+    """Interrupted run (2 of 4 epochs) resumes at epoch 2 and matches the
+    uninterrupted run's final state — deterministic restart."""
+    train_ds, valid_ds = small_data
+    d_interrupted = str(tmp_path / "a")
+    job4 = _with_ckpt(small_job, d_interrupted, epochs=4)
+    job2 = _with_ckpt(small_job, d_interrupted, epochs=2)
+
+    train(job2, train_ds, valid_ds, console=lambda s: None)      # "crash" after 2
+    r_resumed = train(job4, train_ds, valid_ds, console=lambda s: None)
+    assert r_resumed.resumed_from_epoch == 2
+    assert [m.epoch for m in r_resumed.history] == [2, 3]
+
+    job4b = _with_ckpt(small_job, str(tmp_path / "b"), epochs=4)
+    r_straight = train(job4b, train_ds, valid_ds, console=lambda s: None)
+
+    p1 = jax.tree_util.tree_leaves(r_resumed.state.params)
+    p2 = jax.tree_util.tree_leaves(r_straight.state.params)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_resume_disabled(tmp_path, small_job, small_data):
+    train_ds, valid_ds = small_data
+    d = str(tmp_path / "ckpt")
+    job = _with_ckpt(small_job, d, epochs=2)
+    train(job, train_ds, valid_ds, console=lambda s: None)
+    job_no_resume = job.replace(runtime=RuntimeConfig(
+        checkpoint=CheckpointConfig(directory=d, resume=False)))
+    r = train(job_no_resume, train_ds, valid_ds, console=lambda s: None)
+    assert r.resumed_from_epoch == 0
+    assert len(r.history) == 2

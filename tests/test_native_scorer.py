@@ -1,0 +1,99 @@
+"""Native C++ scorer tests: build, pack, and bit-parity with the Python
+scorer and the training-time forward — the native-runtime replacement of the
+reference's JNI TensorflowModelTest (TensorflowModelTest.java:35-60)."""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import jax
+
+from shifu_tpu.export import load_scorer, save_artifact
+from shifu_tpu.train import init_state, make_forward_fn
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="g++ not available")
+
+
+@pytest.fixture(scope="module")
+def artifact_dir(tmp_path_factory):
+    from shifu_tpu.config import JobConfig, ModelSpec
+    from shifu_tpu.data import synthetic
+
+    schema = synthetic.make_schema(num_features=10)
+    job = JobConfig(
+        schema=schema,
+        model=ModelSpec(model_type="mlp", hidden_nodes=(16, 8),
+                        activations=("leakyrelu", "tanh"),
+                        compute_dtype="float32"),
+    ).validate()
+    state = init_state(job, 10)
+    forward = make_forward_fn(job, state.apply_fn)
+    out = str(tmp_path_factory.mktemp("native") / "model")
+    save_artifact(state.params, job, out, forward_fn=forward)
+    return job, state, forward, out
+
+
+def test_build_library():
+    from shifu_tpu.runtime import build_library
+    lib = build_library()
+    assert os.path.exists(lib)
+
+
+def test_pack_and_load(artifact_dir):
+    from shifu_tpu.runtime import MODEL_BIN, NativeScorer, pack_native
+    _, _, _, out = artifact_dir
+    bin_path = pack_native(out)
+    assert os.path.exists(bin_path)
+    scorer = NativeScorer(out)
+    assert scorer.num_features == 10
+    assert scorer.num_heads == 1
+    scorer.close()
+
+
+def test_native_matches_python_scorer(artifact_dir):
+    from shifu_tpu.runtime import NativeScorer
+    _, _, _, out = artifact_dir
+    py = load_scorer(out)
+    nat = NativeScorer(out)
+    rng = np.random.default_rng(0)
+    rows = rng.standard_normal((256, 10)).astype(np.float32)
+    np.testing.assert_allclose(nat.compute_batch(rows), py.compute_batch(rows),
+                               rtol=1e-6, atol=1e-7)
+    nat.close()
+
+
+def test_native_matches_jax_forward(artifact_dir):
+    from shifu_tpu.runtime import NativeScorer
+    job, state, forward, out = artifact_dir
+    nat = NativeScorer(out)
+    rng = np.random.default_rng(1)
+    rows = rng.standard_normal((64, 10)).astype(np.float32)
+    want = np.asarray(jax.device_get(forward(state.params, rows)))
+    np.testing.assert_allclose(nat.compute_batch(rows), want, rtol=1e-5, atol=1e-6)
+    nat.close()
+
+
+def test_native_single_row_double_contract(artifact_dir):
+    """The reference's exact scoring call: double[] in, double in [0,1] out."""
+    from shifu_tpu.runtime import NativeScorer
+    _, _, _, out = artifact_dir
+    nat = NativeScorer(out)
+    rng = np.random.default_rng(2)
+    score = nat.compute(rng.standard_normal(10))
+    assert 0.0 <= score <= 1.0
+    nat.close()
+
+
+def test_native_corrupt_file(tmp_path):
+    from shifu_tpu.runtime.native_scorer import build_library
+    import ctypes
+    bad = tmp_path / "model.bin"
+    bad.write_bytes(b"NOTAMODEL")
+    lib = ctypes.CDLL(build_library())
+    lib.shifu_scorer_load.restype = ctypes.c_void_p
+    lib.shifu_scorer_load.argtypes = [ctypes.c_char_p]
+    assert lib.shifu_scorer_load(str(bad).encode()) is None
