@@ -1,0 +1,6 @@
+from .cli import EXIT_FAIL, EXIT_OK, EXIT_TIMEOUT, main
+from .console import ConsoleBoard, tail_board
+from .supervisor import supervise
+
+__all__ = ["EXIT_FAIL", "EXIT_OK", "EXIT_TIMEOUT", "main", "ConsoleBoard",
+           "tail_board", "supervise"]

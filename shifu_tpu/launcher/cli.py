@@ -1,0 +1,252 @@
+"""Job launcher CLI — the one-command successor of the reference's
+TensorflowClient (yarn/client/TensorflowClient.java:290 main, args
+`-globalconfig <xml> ...` at :147-154).
+
+Usage:
+    python -m shifu_tpu.launcher.cli train \
+        --modelconfig ModelConfig.json --columnconfig ColumnConfig.json \
+        --data /path/to/normalized [...] \
+        [--globalconfig global.xml] [--output out_dir] [--devices N]
+        [--supervise]
+
+Where the reference client uploaded resources to HDFS, submitted a YARN AM,
+and polled it every 10s (TensorflowClient.java:333-426,625-658), this runs
+the single SPMD program in-process (or under the supervisor for
+checkpoint-restart fault tolerance), streams per-epoch lines to the console
+board, enforces the job timeout, exports the scoring artifact, and returns a
+Shifu-style exit status (0 success / 1 failure / 3 timeout).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Optional, Sequence
+
+EXIT_OK = 0
+EXIT_FAIL = 1
+EXIT_TIMEOUT = 3
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="shifu-tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    t = sub.add_parser("train", help="train a model from Shifu configs")
+    t.add_argument("--modelconfig", required=True, help="Shifu ModelConfig.json")
+    t.add_argument("--columnconfig", required=True, help="Shifu ColumnConfig.json")
+    t.add_argument("--data", nargs="*", default=[], help="training data files/dirs")
+    t.add_argument("--globalconfig", default=None,
+                   help="Hadoop-style XML (-globalconfig parity)")
+    t.add_argument("--output", default=None, help="job output dir")
+    t.add_argument("--devices", type=int, default=0,
+                   help="limit device count (0 = all)")
+    t.add_argument("--epochs", type=int, default=0, help="override epochs")
+    t.add_argument("--batch-size", type=int, default=0, help="override batch size")
+    t.add_argument("--timeout", type=int, default=0,
+                   help="job timeout seconds (0 = none)")
+    t.add_argument("--supervise", action="store_true",
+                   help="run under the restart supervisor")
+    t.add_argument("--max-restarts", type=int, default=-1,
+                   help="supervisor restart budget (-1 = from config)")
+
+    s = sub.add_parser("score", help="score rows with an exported artifact")
+    s.add_argument("--model", required=True, help="artifact dir")
+    s.add_argument("--input", required=True, help="pipe-delimited rows file")
+    s.add_argument("--output", default="-", help="output file (- = stdout)")
+    s.add_argument("--native", action="store_true", help="use the C++ engine")
+    return p
+
+
+def _assemble_job(args) -> "JobConfig":
+    import dataclasses
+
+    from ..config import job_config_from_shifu
+    from ..config.schema import CheckpointConfig
+    from ..utils import xmlconfig
+
+    job = job_config_from_shifu(args.modelconfig, args.columnconfig,
+                                data_paths=tuple(args.data))
+
+    merged_xml: dict[str, str] = {}
+    if args.globalconfig:
+        merged_xml = xmlconfig.parse_configuration_xml(args.globalconfig)
+        job = xmlconfig.apply_to_job(job, merged_xml)
+
+    out_dir = args.output or os.path.join(
+        os.getcwd(), f"shifu_tpu_job_{time.strftime('%Y%m%d_%H%M%S')}")
+    os.makedirs(out_dir, exist_ok=True)
+
+    # overrides, highest precedence (the reference's programmatic layer)
+    train = job.train
+    if args.epochs:
+        train = dataclasses.replace(train, epochs=args.epochs)
+    data = job.data
+    if args.batch_size:
+        data = dataclasses.replace(data, batch_size=args.batch_size)
+    runtime = job.runtime
+    if args.timeout:
+        runtime = dataclasses.replace(runtime, timeout_seconds=args.timeout)
+    if not runtime.checkpoint.directory:
+        runtime = dataclasses.replace(
+            runtime, checkpoint=dataclasses.replace(
+                runtime.checkpoint, directory=os.path.join(out_dir, "tmp_model")))
+    if not runtime.final_model_path:
+        runtime = dataclasses.replace(
+            runtime, final_model_path=os.path.join(out_dir, "final_model"))
+    job = job.replace(train=train, data=data, runtime=runtime)
+
+    # persist the merged view (global-final.xml parity + typed JSON)
+    xmlconfig.write_configuration_xml(
+        {**merged_xml,
+         "shifu.application.epochs": str(job.train.epochs),
+         "shifu.application.final-model-path": job.runtime.final_model_path,
+         "shifu.application.tmp-model-path": job.runtime.checkpoint.directory},
+        os.path.join(out_dir, "global-final.xml"))
+    with open(os.path.join(out_dir, "job-config.json"), "w") as f:
+        f.write(job.to_json())
+    return job, out_dir
+
+
+def run_train(args) -> int:
+    job, out_dir = _assemble_job(args)
+
+    if args.supervise:
+        from .supervisor import supervise
+        max_restarts = (args.max_restarts if args.max_restarts >= 0
+                        else job.runtime.max_restarts)
+        # rebuild the child command from parsed args (supervisor flags stripped);
+        # pin --output so every attempt shares the checkpoint dir and resumes
+        child_args = ["train",
+                      "--modelconfig", args.modelconfig,
+                      "--columnconfig", args.columnconfig,
+                      "--output", out_dir]
+        if args.data:
+            child_args += ["--data", *args.data]
+        if args.globalconfig:
+            child_args += ["--globalconfig", args.globalconfig]
+        for flag, val in (("--devices", args.devices), ("--epochs", args.epochs),
+                          ("--batch-size", args.batch_size), ("--timeout", args.timeout)):
+            if val:
+                child_args += [flag, str(val)]
+        return supervise(child_args, max_restarts=max_restarts,
+                         board_path=os.path.join(out_dir, "console.board"))
+
+    import jax
+
+    from ..export import save_artifact
+    from ..parallel import data_parallel_mesh
+    from ..train import make_forward_fn, train
+    from .console import ConsoleBoard
+
+    board = ConsoleBoard(os.path.join(out_dir, "console.board"))
+    n_devices = len(jax.devices())
+    if args.devices:
+        n_devices = min(n_devices, args.devices)
+    mesh = data_parallel_mesh(n_devices) if n_devices > 1 else None
+
+    board(f"shifu_tpu train: {job.runtime.app_name} devices={n_devices} "
+          f"model={job.model.model_type} epochs={job.train.epochs} "
+          f"batch={job.data.batch_size}")
+
+    deadline = (time.monotonic() + job.runtime.timeout_seconds
+                if job.runtime.timeout_seconds else None)
+
+    def check_timeout(_m):
+        if deadline is not None and time.monotonic() > deadline:
+            board(f"job timeout ({job.runtime.timeout_seconds}s) exceeded — aborting")
+            raise TimeoutError("job timeout")
+        _maybe_inject_fault(_m, board)
+
+    try:
+        result = train(job, mesh=mesh, console=board, epoch_callback=check_timeout)
+    except TimeoutError:
+        board.close()
+        return EXIT_TIMEOUT
+    except Exception as e:  # noqa: BLE001 - job boundary
+        board(f"training failed: {type(e).__name__}: {e}")
+        board.close()
+        return EXIT_FAIL
+
+    forward = make_forward_fn(job, result.state.apply_fn)
+    export_dir = save_artifact(result.state.params, job,
+                               job.runtime.final_model_path, forward_fn=forward)
+    try:
+        from ..runtime import pack_native
+        pack_native(export_dir)
+    except Exception as e:  # native pack is best-effort at train time
+        board(f"native pack skipped: {e}")
+    board(f"model exported to {export_dir}")
+    if result.history:
+        last = result.history[-1]
+        board(f"final: valid_error={last.valid_error:.6f} valid_auc={last.valid_auc:.4f}")
+    board.close()
+    return EXIT_OK
+
+
+def _maybe_inject_fault(metrics, board) -> None:
+    """Deliberate fault injection for resilience tests — the always-on version
+    of the reference's commented-out PS-killer (yarn/util/CommonUtils.java:
+    265-274).  SHIFU_TPU_FAULT_EPOCH=k hard-kills the process after epoch k."""
+    fault_epoch = os.environ.get("SHIFU_TPU_FAULT_EPOCH")
+    if fault_epoch is not None and metrics.epoch == int(fault_epoch):
+        board(f"FAULT INJECTION: killing process after epoch {metrics.epoch}")
+        os._exit(17)
+
+
+def run_score(args) -> int:
+    import numpy as np
+
+    from ..data import reader
+
+    rows = reader.read_file(args.input)
+    if args.native:
+        from ..runtime import NativeScorer
+        scorer = NativeScorer(args.model)
+    else:
+        from ..export import load_scorer
+        scorer = load_scorer(args.model)
+    n_feat = scorer.num_features if hasattr(scorer, "num_features") else rows.shape[1]
+    scores = scorer.compute_batch(rows[:, :n_feat])
+    out = sys.stdout if args.output == "-" else open(args.output, "w")
+    for s in scores:
+        out.write("|".join(f"{v:.6f}" for v in s) + "\n")
+    if out is not sys.stdout:
+        out.close()
+    return EXIT_OK
+
+
+def _apply_platform_env() -> None:
+    """Honor SHIFU_TPU_PLATFORM / SHIFU_TPU_CPU_DEVICES before backend init.
+
+    Needed because this image's sitecustomize force-registers the TPU backend
+    regardless of JAX_PLATFORMS, so subprocess tests (and CPU-only users)
+    need an in-process override."""
+    plat = os.environ.get("SHIFU_TPU_PLATFORM")
+    if not plat:
+        return
+    import jax
+    try:
+        jax.config.update("jax_platforms", plat)
+        n = os.environ.get("SHIFU_TPU_CPU_DEVICES")
+        if n and plat == "cpu":
+            jax.config.update("jax_num_cpu_devices", int(n))
+    except RuntimeError:
+        pass  # backends already initialized
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    _apply_platform_env()
+    args = build_parser().parse_args(argv)
+    if args.command == "train":
+        return run_train(args)
+    if args.command == "score":
+        return run_score(args)
+    return EXIT_FAIL
+
+
+if __name__ == "__main__":
+    sys.exit(main())

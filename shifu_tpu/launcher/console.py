@@ -1,0 +1,59 @@
+"""Console board: progress lines to stdout + an append-only board file.
+
+Successor of the reference's 4-hop metrics pipeline (python socket ->
+SocketServer -> ZooKeeper -> AM aggregate -> HDFS ClientConsoleBoard file ->
+client TailThread; SURVEY.md section 5.5).  Under SPMD there is one program,
+so the board is written directly: every line goes to stdout immediately and
+is appended (flushed) to a board file that an external tail — or the
+supervisor's liveness monitor — can follow.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Optional
+
+
+class ConsoleBoard:
+    def __init__(self, board_path: Optional[str] = None, echo: bool = True):
+        self.board_path = board_path
+        self.echo = echo
+        self._fh = None
+        if board_path:
+            os.makedirs(os.path.dirname(os.path.abspath(board_path)), exist_ok=True)
+            self._fh = open(board_path, "a", buffering=1)
+
+    def __call__(self, line: str) -> None:
+        stamped = f"[{time.strftime('%Y-%m-%d %H:%M:%S')}] {line}"
+        if self.echo:
+            print(stamped, flush=True)
+        if self._fh is not None:
+            self._fh.write(stamped + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def tail_board(board_path: str, from_start: bool = True):
+    """Generator yielding board lines as they appear (the reference client's
+    TailThread, TensorflowClient.java:829-841). Stops when the file is
+    removed; callers normally run it in a thread."""
+    pos = 0
+    while not os.path.exists(board_path):
+        time.sleep(0.1)
+    with open(board_path, "r") as f:
+        if not from_start:
+            f.seek(0, os.SEEK_END)
+        while True:
+            line = f.readline()
+            if line:
+                yield line.rstrip("\n")
+            else:
+                if not os.path.exists(board_path):
+                    return
+                time.sleep(0.2)

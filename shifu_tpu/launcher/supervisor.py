@@ -1,0 +1,80 @@
+"""Restart supervisor: failure detection + checkpoint-restart elasticity.
+
+The SPMD successor of the reference's whole fault-tolerance subsystem
+(SURVEY.md section 5.3): heartbeat liveness (TensorflowApplicationMaster.java:
+63-112), exit-code accounting (TensorflowSession.java:417-460), and
+hot-standby backup promotion (weakupBackup, TensorflowSession.java:748-781).
+Under SPMD any chip loss kills the step, so hot standbys are replaced by:
+run the training job as a child process; if it dies, restart it (bounded by
+max_restarts) and let checkpoint auto-resume continue from the last saved
+epoch; if it stops making progress (no board writes within the liveness
+window), kill and restart — the heartbeat analog.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Optional, Sequence
+
+DEFAULT_LIVENESS_SECONDS = 25.0  # reference: 1s heartbeat x 25 allowed misses
+                                 # (GlobalConfigurationKeys.java:76-79)
+
+
+def supervise(child_argv: Sequence[str],
+              max_restarts: int = 2,
+              board_path: Optional[str] = None,
+              liveness_seconds: float = 0.0,
+              poll_seconds: float = 0.5,
+              python: Optional[str] = None) -> int:
+    """Run `python -m shifu_tpu.launcher.cli <child_argv>` with restarts.
+
+    Returns the child's final exit code (0 on eventual success).  A child that
+    fails (nonzero exit / killed) is restarted up to max_restarts times;
+    checkpoint auto-resume makes the restart continue, not repeat.  If
+    liveness_seconds > 0 and the board file stops growing for that long, the
+    child is presumed hung, killed, and the restart budget is charged —
+    heartbeat-expiry parity.
+    """
+    python = python or sys.executable
+    cmd = [python, "-m", "shifu_tpu.launcher.cli", *child_argv]
+    attempts = 0
+    while True:
+        attempts += 1
+        start = time.monotonic()
+        proc = subprocess.Popen(cmd)
+        last_size = -1
+        last_progress = time.monotonic()
+        killed_for_hang = False
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            if liveness_seconds > 0 and board_path and os.path.exists(board_path):
+                size = os.path.getsize(board_path)
+                if size != last_size:
+                    last_size = size
+                    last_progress = time.monotonic()
+                elif time.monotonic() - last_progress > liveness_seconds:
+                    print(f"supervisor: no progress for {liveness_seconds}s — "
+                          f"killing attempt {attempts}", flush=True)
+                    proc.kill()
+                    proc.wait()
+                    rc = -9
+                    killed_for_hang = True
+                    break
+            time.sleep(poll_seconds)
+        if rc == 0:
+            if attempts > 1:
+                print(f"supervisor: succeeded after {attempts} attempts", flush=True)
+            return 0
+        elapsed = time.monotonic() - start
+        print(f"supervisor: attempt {attempts} exited rc={rc} "
+              f"after {elapsed:.1f}s"
+              + (" (liveness kill)" if killed_for_hang else ""), flush=True)
+        if attempts > max_restarts:
+            print(f"supervisor: restart budget exhausted "
+                  f"({max_restarts} restarts)", flush=True)
+            return rc if isinstance(rc, int) and rc > 0 else 1

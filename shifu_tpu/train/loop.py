@@ -210,14 +210,17 @@ def train(job: JobConfig,
         )
         history.append(m)
         console(m.console_line())
-        if epoch_callback is not None:
-            epoch_callback(m)
 
+        # save before the callback so external kills (timeout, fault
+        # injection, preemption) never lose the completed epoch
         if manager is not None and (
                 (epoch + 1) % job.runtime.checkpoint.save_every_epochs == 0
                 or epoch == job.train.epochs - 1):
             ckpt_lib.save(manager, int(jax.device_get(state.step)), state,
                           extra={"epoch": epoch + 1})
+
+        if epoch_callback is not None:
+            epoch_callback(m)
 
     return TrainResult(state=state, history=history, job=job,
                        resumed_from_epoch=start_epoch)

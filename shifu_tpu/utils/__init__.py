@@ -1,0 +1,3 @@
+from . import xmlconfig
+
+__all__ = ["xmlconfig"]

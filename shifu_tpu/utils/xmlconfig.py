@@ -1,0 +1,125 @@
+"""Hadoop-style XML configuration ingestion (`shifu.*` key namespace).
+
+Config-system parity with the reference (SURVEY.md section 5.6): the reference
+layers baked-in `global-default.xml` <- user `-globalconfig` XML <-
+programmatic keys, serializes `global-final.xml`, and ships it to every
+container (reference: yarn/client/TensorflowClient.java:211-224,389-403; key
+namespace yarn/util/GlobalConfigurationKeys.java:22-155).  Here the same XML
+files parse into a flat dict and map onto the typed JobConfig; unknown keys
+are preserved for forward-compat and re-serialized into the job dir's
+`global-final.xml` equivalent.
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from typing import Any, Mapping, Optional
+
+# reference key namespace (GlobalConfigurationKeys.java)
+KEY_EPOCHS = "shifu.application.epochs"
+KEY_TIMEOUT = "shifu.application.timeout"
+KEY_TRAINING_DATA_PATH = "shifu.application.training-data-path"
+KEY_TMP_MODEL_PATH = "shifu.application.tmp-model-path"
+KEY_FINAL_MODEL_PATH = "shifu.application.final-model-path"
+KEY_APP_NAME = "shifu.application.name"
+KEY_WORKER_INSTANCES = "shifu.worker.instances"
+KEY_PS_INSTANCES = "shifu.ps.instances"
+KEY_BACKUP_INSTANCES = "shifu.worker.instances.backup"
+KEY_BATCH_SIZE = "shifu.application.batch-size"
+KEY_MAX_RESTARTS = "shifu.application.max-restarts"
+KEY_HEARTBEAT_INTERVAL = "shifu.task.heartbeat-interval-ms"
+KEY_MAX_MISSED_HEARTBEATS = "shifu.task.max-missed-heartbeats"
+
+
+def parse_configuration_xml(path: str) -> dict[str, str]:
+    """Parse one Hadoop `<configuration><property><name/><value/>` file.
+
+    Tolerates the reference's quirk of concatenated XML documents in one file
+    (global-default-bk.xml:183-188 contains two) by parsing only the first
+    document and ignoring trailing garbage.
+    """
+    with open(path, "r") as f:
+        text = f.read()
+    # first <configuration>...</configuration> document only
+    start = text.find("<configuration")
+    if start < 0:
+        raise ValueError(f"{path}: no <configuration> element")
+    end = text.find("</configuration>", start)
+    if end < 0:
+        raise ValueError(f"{path}: unterminated <configuration>")
+    doc = text[start:end + len("</configuration>")]
+    root = ET.fromstring(doc)
+    out: dict[str, str] = {}
+    for prop in root.iter("property"):
+        name = prop.findtext("name")
+        value = prop.findtext("value")
+        if name is not None and value is not None:
+            out[name.strip()] = value.strip()
+    return out
+
+
+def layer_configs(*dicts: Mapping[str, str]) -> dict[str, str]:
+    """Later dicts win — the reference's default <- user <- programmatic order."""
+    merged: dict[str, str] = {}
+    for d in dicts:
+        merged.update(d)
+    return merged
+
+
+def write_configuration_xml(config: Mapping[str, str], path: str) -> None:
+    """Serialize the merged config (the `global-final.xml` the reference wrote
+    and localized into every container, TensorflowClient.java:389-403)."""
+    root = ET.Element("configuration")
+    for name in sorted(config):
+        prop = ET.SubElement(root, "property")
+        ET.SubElement(prop, "name").text = name
+        ET.SubElement(prop, "value").text = str(config[name])
+    tree = ET.ElementTree(root)
+    ET.indent(tree)
+    tree.write(path, encoding="unicode", xml_declaration=True)
+
+
+def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
+    """Overlay `shifu.*` keys onto a JobConfig (returns a new JobConfig)."""
+    from ..config.schema import CheckpointConfig, RuntimeConfig
+
+    train = job.train
+    data = job.data
+    runtime = job.runtime
+
+    if KEY_EPOCHS in conf:
+        train = train.__class__(
+            epochs=int(conf[KEY_EPOCHS]), loss=train.loss,
+            optimizer=train.optimizer, seed=train.seed,
+            eval_every_epochs=train.eval_every_epochs,
+            log_every_steps=train.log_every_steps,
+            bagging_sample_rate=train.bagging_sample_rate)
+    if KEY_BATCH_SIZE in conf:
+        import dataclasses
+        data = dataclasses.replace(data, batch_size=int(conf[KEY_BATCH_SIZE]))
+    if KEY_TRAINING_DATA_PATH in conf and not data.paths:
+        import dataclasses
+        data = dataclasses.replace(
+            data, paths=tuple(conf[KEY_TRAINING_DATA_PATH].split(",")))
+
+    import dataclasses
+    rt_kw: dict[str, Any] = {}
+    if KEY_TIMEOUT in conf:
+        # reference timeout is milliseconds (client-side kill,
+        # TensorflowClient.java:625-658)
+        rt_kw["timeout_seconds"] = int(int(conf[KEY_TIMEOUT]) / 1000)
+    if KEY_APP_NAME in conf:
+        rt_kw["app_name"] = conf[KEY_APP_NAME]
+    if KEY_FINAL_MODEL_PATH in conf:
+        rt_kw["final_model_path"] = conf[KEY_FINAL_MODEL_PATH]
+    if KEY_TMP_MODEL_PATH in conf:
+        rt_kw["tmp_model_path"] = conf[KEY_TMP_MODEL_PATH]
+        ck = dataclasses.replace(runtime.checkpoint,
+                                 directory=conf[KEY_TMP_MODEL_PATH])
+        rt_kw["checkpoint"] = ck
+    if KEY_MAX_RESTARTS in conf:
+        rt_kw["max_restarts"] = int(conf[KEY_MAX_RESTARTS])
+    if rt_kw:
+        runtime = dataclasses.replace(runtime, **rt_kw)
+
+    return job.replace(train=train, data=data, runtime=runtime)
