@@ -53,6 +53,9 @@ class DataSchema:
     target_index: int = -1
     weight_index: int = -1          # -1 => implicit weight 1.0 (reference: ssgd_monitor.py:417-421)
     selected_indices: tuple[int, ...] = ()
+    # Shifu multi-target mode (multitask models): ordered target columns.
+    # Empty => single-target via target_index.
+    target_indices: tuple[int, ...] = ()
 
     @property
     def feature_count(self) -> int:
@@ -64,13 +67,18 @@ class DataSchema:
         return tuple(i for i in self.selected_indices
                      if i in by_index and by_index[i].is_categorical)
 
+    @property
+    def all_target_indices(self) -> tuple[int, ...]:
+        return self.target_indices if self.target_indices else (self.target_index,)
+
     def validate(self) -> None:
-        if self.target_index < 0:
+        if self.target_index < 0 and not self.target_indices:
             raise ConfigError("DataSchema.target_index must be set (>= 0)")
         if not self.selected_indices:
             raise ConfigError("DataSchema.selected_indices must be non-empty")
-        if self.target_index in self.selected_indices:
-            raise ConfigError("target column cannot also be a selected feature")
+        for t in self.all_target_indices:
+            if t in self.selected_indices:
+                raise ConfigError("target column cannot also be a selected feature")
         if self.weight_index >= 0 and self.weight_index in self.selected_indices:
             raise ConfigError("weight column cannot also be a selected feature")
 

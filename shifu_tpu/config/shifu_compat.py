@@ -84,6 +84,7 @@ def parse_column_config(
     column_config: Sequence[dict[str, Any]],
     target_column_name: Optional[str] = None,
     weight_column_name: Optional[str] = None,
+    multi_target_names: Optional[Sequence[str]] = None,
 ) -> DataSchema:
     """Build a DataSchema from Shifu's ColumnConfig.json list.
 
@@ -96,6 +97,8 @@ def parse_column_config(
     target_index = -1
     weight_index = -1
     selected: list[int] = []
+    multi_targets = list(multi_target_names or [])
+    target_index_by_name: dict[str, int] = {}
 
     for entry in column_config:
         index = int(entry.get("columnNum", entry.get("index", len(columns))))
@@ -105,7 +108,10 @@ def parse_column_config(
         final_select = bool(entry.get("finalSelect", False))
 
         is_target = (flag == _FLAG_TARGET) or (
-            target_column_name is not None and name == target_column_name)
+            target_column_name is not None and name == target_column_name) or (
+            name in multi_targets)
+        if name in multi_targets:
+            target_index_by_name[name] = index
         is_weight = (flag == _FLAG_WEIGHT) or (
             weight_column_name is not None and name == weight_column_name)
         is_meta = flag == _FLAG_META
@@ -145,11 +151,16 @@ def parse_column_config(
         columns = [ColumnSpec(**{**c.__dict__, "is_selected": c.index in set(selected)})
                    for c in columns]
 
+    target_indices = tuple(target_index_by_name[n] for n in multi_targets
+                           if n in target_index_by_name)
+    if target_indices and target_index < 0:
+        target_index = target_indices[0]
     schema = DataSchema(
         columns=tuple(columns),
         target_index=target_index,
         weight_index=weight_index,
         selected_indices=tuple(sorted(selected)),
+        target_indices=target_indices,
     )
     schema.validate()
     return schema
@@ -262,6 +273,7 @@ def job_config_from_shifu(
         column_config,
         target_column_name=dataset.get("targetColumnName"),
         weight_column_name=dataset.get("weightColumnName"),
+        multi_target_names=dataset.get("multiTargetColumnNames"),
     )
 
     valid_ratio = float((model_config.get("train") or {}).get("validSetRate", 0.1))

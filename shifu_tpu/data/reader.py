@@ -175,7 +175,8 @@ def project_columns(
     """Project raw (N, C) rows into features/target/weight arrays.
 
     - features: schema.selected_indices columns, NaN-imputed with impute_value
-    - target:   (N, 1), from schema.target_index
+    - target:   (N, H) — H target columns (1 for single-target, schema's
+      target_indices order for Shifu multi-target mode)
     - weight:   (N, 1); 1.0 when schema.weight_index < 0, and negative weights
       clamp to 1.0 like the reference (ssgd_monitor.py:413-417).
     """
@@ -183,7 +184,8 @@ def project_columns(
     sel = np.asarray(schema.selected_indices, dtype=np.int64)
     features = rows[:, sel] if n else np.zeros((0, len(sel)), np.float32)
     features = np.nan_to_num(features, nan=impute_value)
-    target = rows[:, schema.target_index:schema.target_index + 1] if n else np.zeros((0, 1), np.float32)
+    tgt_idx = np.asarray(schema.all_target_indices, dtype=np.int64)
+    target = rows[:, tgt_idx] if n else np.zeros((0, len(tgt_idx)), np.float32)
     if schema.weight_index >= 0:
         weight = rows[:, schema.weight_index:schema.weight_index + 1].copy()
         weight[~(weight >= 0.0)] = 1.0  # negatives and NaNs -> 1.0

@@ -23,15 +23,20 @@ def make_schema(
     with_weight: bool = False,
     num_categorical: int = 0,
     vocab_size: int = 100,
+    num_targets: int = 1,
 ) -> DataSchema:
-    """Column layout: [target, (weight,) f0..fN-1]; last num_categorical are categorical."""
-    columns = [ColumnSpec(index=0, name="target", is_target=True)]
+    """Column layout: [targets..., (weight,) f0..fN-1]; the last
+    num_categorical features are categorical; num_targets > 1 models Shifu
+    multi-target mode."""
+    columns = [ColumnSpec(index=t, name=f"target{t}" if num_targets > 1 else "target",
+                          is_target=True)
+               for t in range(num_targets)]
     weight_index = -1
-    offset = 1
+    offset = num_targets
     if with_weight:
-        weight_index = 1
-        columns.append(ColumnSpec(index=1, name="wgt", is_weight=True))
-        offset = 2
+        weight_index = offset
+        columns.append(ColumnSpec(index=weight_index, name="wgt", is_weight=True))
+        offset += 1
     selected = []
     for i in range(num_features):
         idx = offset + i
@@ -45,6 +50,7 @@ def make_schema(
         target_index=0,
         weight_index=weight_index,
         selected_indices=tuple(selected),
+        target_indices=tuple(range(num_targets)) if num_targets > 1 else (),
     )
 
 
@@ -73,8 +79,9 @@ def make_rows(
     if num_idx:
         x = rng.standard_normal((num_rows, len(num_idx))).astype(np.float32)
         rows[:, num_idx] = x
-        w = rng.standard_normal(len(num_idx)) / np.sqrt(len(num_idx))
-        logits += x @ w
+        w = rng.standard_normal(len(num_idx))
+        w /= max(np.linalg.norm(w), 1e-9)  # unit norm: signal strength is
+        logits += 1.5 * (x @ w)            # seed-independent (std 1.5)
     for i in sorted(cat_set):
         vocab = max(by_index[i].vocab_size, 2)
         ids = rng.integers(0, vocab, size=num_rows)
@@ -82,9 +89,15 @@ def make_rows(
         effect = rng.standard_normal(vocab) * 0.5
         logits += effect[ids]
 
-    logits += noise * rng.standard_normal(num_rows)
-    prob = 1.0 / (1.0 + np.exp(-logits))
-    rows[:, schema.target_index] = (rng.random(num_rows) < prob).astype(np.float32)
+    for h, t_idx in enumerate(schema.all_target_indices):
+        # each target head mixes the shared logits with its own projection
+        head_logits = logits + noise * rng.standard_normal(num_rows)
+        if h > 0 and num_idx:
+            w_h = rng.standard_normal(len(num_idx))
+            w_h /= max(np.linalg.norm(w_h), 1e-9)
+            head_logits = 0.5 * head_logits + 1.5 * (rows[:, num_idx] @ w_h)
+        prob = 1.0 / (1.0 + np.exp(-head_logits))
+        rows[:, t_idx] = (rng.random(num_rows) < prob).astype(np.float32)
     if schema.weight_index >= 0:
         rows[:, schema.weight_index] = rng.uniform(0.5, 2.0, num_rows).astype(np.float32)
     return rows

@@ -1,5 +1,15 @@
 from .base import MLPTrunk, ScoringHead, ShifuDense
+from .deepfm import DeepFM
+from .embedding import CategoricalEmbed, FieldLayout, NumericEmbed, field_layout, split_features
+from .ft_transformer import FTTransformer
 from .mlp import ShifuMLP
+from .multitask import MultiTask
 from .registry import build_model, register
+from .wide_deep import WideDeep
 
-__all__ = ["MLPTrunk", "ScoringHead", "ShifuDense", "ShifuMLP", "build_model", "register"]
+__all__ = [
+    "MLPTrunk", "ScoringHead", "ShifuDense", "DeepFM", "CategoricalEmbed",
+    "FieldLayout", "NumericEmbed", "field_layout", "split_features",
+    "FTTransformer", "ShifuMLP", "MultiTask", "build_model", "register",
+    "WideDeep",
+]

@@ -1,0 +1,108 @@
+"""FT-Transformer tabular model (BASELINE ladder config #5, stretch rung).
+
+Feature Tokenizer + Transformer: every selected column becomes a token
+(numeric: x_j * w_j + b_j; categorical: table lookup — models/embedding.py),
+a CLS token is prepended, L pre-LN transformer blocks attend over the feature
+axis, and the CLS representation feeds the `shifu_output_0` head.  New
+capability over the reference (no attention anywhere — SURVEY.md section 5.7).
+
+TPU-first notes: attention runs through ops/attention.mha (float32 softmax,
+bf16 matmuls on the MXU); with a `seq`-axis mesh the same math is available
+sequence-parallel via ops/attention.ring_attention (feature-token counts
+~10^2-10^3 fit single-chip, so the model defaults to local attention).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ModelSpec
+from ..ops.attention import mha
+from ..ops.initializers import xavier_uniform
+from .base import ShifuDense, dtype_of
+from .embedding import (CategoricalEmbed, FieldLayout, NumericEmbed,
+                        split_features)
+
+
+class TransformerBlock(nn.Module):
+    spec: ModelSpec
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        cdt = dtype_of(self.spec.compute_dtype)
+        d = self.spec.token_dim
+        h = self.spec.num_attention_heads
+        assert d % h == 0, "token_dim must divide num_attention_heads"
+        dh = d // h
+        b, s, _ = x.shape
+
+        # pre-LN attention
+        y = nn.LayerNorm(dtype=cdt, name="ln_attn")(x)
+        qkv = nn.Dense(3 * d, kernel_init=xavier_uniform, dtype=cdt,
+                       param_dtype=dtype_of(self.spec.param_dtype),
+                       name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
+        k = k.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
+        v = v.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
+        attn = mha(q, k, v).transpose(0, 2, 1, 3).reshape(b, s, d)
+        attn = nn.Dense(d, kernel_init=xavier_uniform, dtype=cdt,
+                        param_dtype=dtype_of(self.spec.param_dtype),
+                        name="proj")(attn)
+        if self.spec.dropout_rate > 0:
+            attn = nn.Dropout(self.spec.dropout_rate, deterministic=not train)(attn)
+        x = x + attn
+
+        # pre-LN MLP
+        y = nn.LayerNorm(dtype=cdt, name="ln_mlp")(x)
+        y = nn.Dense(self.spec.mlp_ratio * d, kernel_init=xavier_uniform,
+                     dtype=cdt, param_dtype=dtype_of(self.spec.param_dtype),
+                     name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(d, kernel_init=xavier_uniform, dtype=cdt,
+                     param_dtype=dtype_of(self.spec.param_dtype),
+                     name="mlp_out")(y)
+        if self.spec.dropout_rate > 0:
+            y = nn.Dropout(self.spec.dropout_rate, deterministic=not train)(y)
+        return x + y
+
+
+class FTTransformer(nn.Module):
+    spec: ModelSpec
+    layout: FieldLayout
+
+    @nn.compact
+    def __call__(self, features: jax.Array, *, train: bool = False) -> jax.Array:
+        cdt = dtype_of(self.spec.compute_dtype)
+        d = self.spec.token_dim
+        numeric, ids = split_features(features, self.layout)
+
+        tokens = []
+        if self.layout.num_numeric:
+            tokens.append(NumericEmbed(layout=self.layout, dim=d,
+                                       param_dtype=self.spec.param_dtype,
+                                       compute_dtype=self.spec.compute_dtype,
+                                       name="numeric_tokenizer")(numeric))
+        if self.layout.num_categorical:
+            tokens.append(CategoricalEmbed(layout=self.layout, dim=d,
+                                           param_dtype=self.spec.param_dtype,
+                                           compute_dtype=self.spec.compute_dtype,
+                                           name="cat_tokenizer")(ids))
+        x = jnp.concatenate(tokens, axis=1)  # (B, F, d)
+
+        cls = self.param("cls_token", xavier_uniform, (1, 1, d),
+                         dtype_of(self.spec.param_dtype))
+        cls = jnp.broadcast_to(cls.astype(cdt), (x.shape[0], 1, d))
+        x = jnp.concatenate([cls, x.astype(cdt)], axis=1)
+
+        for i in range(self.spec.num_layers):
+            x = TransformerBlock(spec=self.spec, name=f"block_{i}")(x, train=train)
+
+        cls_out = nn.LayerNorm(dtype=cdt, name="ln_final")(x[:, 0, :])
+        return ShifuDense(features=self.spec.num_heads, activation=None,
+                          xavier_bias=self.spec.xavier_bias_init,
+                          param_dtype=self.spec.param_dtype,
+                          compute_dtype=self.spec.compute_dtype,
+                          name="shifu_output_0")(cls_out).astype(jnp.float32)

@@ -1,0 +1,42 @@
+"""Multi-task model: shared trunk + per-task towers (BASELINE ladder config
+#4: fraud + chargeback heads, Shifu multi-target mode).
+
+New capability over the reference (single sigmoid head only,
+resources/ssgd_monitor.py:121).  Each task h gets its own small tower and a
+logit; heads are named `shifu_output_{h}` so the export sidecar enumerates
+them; the loss averages per-head weighted losses (ops/losses.multitask_loss).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ModelSpec
+from .base import MLPTrunk, ShifuDense, dtype_of
+
+
+class MultiTask(nn.Module):
+    spec: ModelSpec
+
+    @nn.compact
+    def __call__(self, features: jax.Array, *, train: bool = False) -> jax.Array:
+        del train
+        x = features.astype(dtype_of(self.spec.compute_dtype))
+        trunk = MLPTrunk(spec=self.spec, name="trunk")(x)
+        logits = []
+        tower_width = max(self.spec.hidden_nodes[-1] // 2, 4)
+        for h in range(self.spec.num_heads):
+            t = ShifuDense(features=tower_width,
+                           activation=self.spec.activations[-1],
+                           xavier_bias=self.spec.xavier_bias_init,
+                           param_dtype=self.spec.param_dtype,
+                           compute_dtype=self.spec.compute_dtype,
+                           name=f"tower_{h}")(trunk)
+            logits.append(ShifuDense(features=1, activation=None,
+                                     xavier_bias=self.spec.xavier_bias_init,
+                                     param_dtype=self.spec.param_dtype,
+                                     compute_dtype=self.spec.compute_dtype,
+                                     name=f"shifu_output_{h}")(t))
+        return jnp.concatenate(logits, axis=-1).astype(jnp.float32)

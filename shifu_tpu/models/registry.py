@@ -35,3 +35,30 @@ def build_model(spec: ModelSpec, schema: DataSchema) -> nn.Module:
 def _build_mlp(spec: ModelSpec, schema: DataSchema) -> nn.Module:
     from .mlp import ShifuMLP
     return ShifuMLP(spec=spec)
+
+
+@register("wide_deep")
+def _build_wide_deep(spec: ModelSpec, schema: DataSchema) -> nn.Module:
+    from .embedding import field_layout
+    from .wide_deep import WideDeep
+    return WideDeep(spec=spec, layout=field_layout(schema))
+
+
+@register("deepfm")
+def _build_deepfm(spec: ModelSpec, schema: DataSchema) -> nn.Module:
+    from .deepfm import DeepFM
+    from .embedding import field_layout
+    return DeepFM(spec=spec, layout=field_layout(schema))
+
+
+@register("multitask")
+def _build_multitask(spec: ModelSpec, schema: DataSchema) -> nn.Module:
+    from .multitask import MultiTask
+    return MultiTask(spec=spec)
+
+
+@register("ft_transformer")
+def _build_ft_transformer(spec: ModelSpec, schema: DataSchema) -> nn.Module:
+    from .embedding import field_layout
+    from .ft_transformer import FTTransformer
+    return FTTransformer(spec=spec, layout=field_layout(schema))
