@@ -51,15 +51,17 @@ def _flatten_params(params: Any) -> dict[str, np.ndarray]:
             for kp, leaf in flat}
 
 
-def build_program(spec: ModelSpec) -> list[dict[str, Any]]:
+def build_program(spec: ModelSpec) -> Optional[list[dict[str, Any]]]:
     """The op-list for sequential (MLP-family) models.
 
     Each dense op references weight keys in weights.npz; the trailing sigmoid
     reproduces the reference's sigmoid scoring head (ssgd_monitor.py:121).
+    Returns None for model types whose graph is not a dense chain — those
+    artifacts carry the full model spec instead and score through the
+    JAX-fallback scorer (export/scorer.py JaxScorer; still CPU-only, no TF).
     """
     if spec.model_type != "mlp":
-        raise NotImplementedError(
-            f"op-list export for model_type={spec.model_type!r} not yet supported")
+        return None
     program: list[dict[str, Any]] = []
     for i, act in enumerate(spec.activations):
         program.append({
@@ -109,12 +111,14 @@ def save_artifact(params: Any, job: JobConfig, export_dir: str,
     np.savez(os.path.join(export_dir, WEIGHTS), **flat)
 
     program = build_program(job.model)
-    missing = [op[k] for op in program for k in ("kernel", "bias")
-               if op.get(k) and op[k] not in flat]
-    if missing:
-        raise ValueError(f"program references missing weights: {missing}; "
-                         f"have {sorted(flat)}")
+    if program is not None:
+        missing = [op[k] for op in program for k in ("kernel", "bias")
+                   if op.get(k) and op[k] not in flat]
+        if missing:
+            raise ValueError(f"program references missing weights: {missing}; "
+                             f"have {sorted(flat)}")
 
+    import dataclasses
     topology = {
         "format_version": FORMAT_VERSION,
         "model_type": job.model.model_type,
@@ -123,6 +127,9 @@ def save_artifact(params: Any, job: JobConfig, export_dir: str,
         "head_names": list(job.model.head_names),
         "selected_indices": list(job.schema.selected_indices),
         "program": program,
+        # full specs for the JAX-fallback scorer (and future op-list lowerings)
+        "model_spec": dataclasses.asdict(job.model),
+        "schema": dataclasses.asdict(job.schema),
     }
     with open(os.path.join(export_dir, TOPOLOGY), "w") as f:
         json.dump(topology, f, indent=2)

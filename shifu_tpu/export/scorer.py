@@ -88,5 +88,68 @@ class Scorer:
         return float(self.compute_batch(np.asarray(row, dtype=np.float64))[0, 0])
 
 
-def load_scorer(export_dir: str) -> Scorer:
-    return Scorer(export_dir)
+class JaxScorer:
+    """Fallback scorer for non-chain models (wide_deep/deepfm/multitask/
+    ft_transformer): rebuilds the Flax model from the artifact's stored spec
+    and scores on the CPU backend.  Still satisfies the eval contract — no TF
+    runtime, commodity CPU — at the cost of a jax dependency; the native
+    C++ op-list path covers these model types as their ops are lowered."""
+
+    def __init__(self, export_dir: str):
+        import jax
+        import jax.numpy as jnp
+
+        from ..config.schema import DataSchema, ModelSpec, _from_dict
+        from ..models.registry import build_model
+
+        with open(os.path.join(export_dir, TOPOLOGY)) as f:
+            self.topology = json.load(f)
+        with open(os.path.join(export_dir, SIDE_CAR)) as f:
+            self.sidecar = json.load(f)
+        spec = _from_dict(ModelSpec, self.topology["model_spec"])
+        schema = _from_dict(DataSchema, self.topology["schema"])
+        self.num_features = int(self.topology["num_features"])
+        model = build_model(spec, schema)
+
+        with np.load(os.path.join(export_dir, WEIGHTS)) as z:
+            flat = {k: z[k] for k in z.files}
+        params = _unflatten(flat)
+
+        def fwd(feats):
+            return jax.nn.sigmoid(model.apply({"params": params}, feats))
+
+        self._fwd = jax.jit(fwd)
+        self._jnp = jnp
+
+    def compute_batch(self, rows: np.ndarray) -> np.ndarray:
+        x = np.asarray(rows, dtype=np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.shape[1] != self.num_features:
+            raise ValueError(
+                f"expected {self.num_features} features, got {x.shape[1]}")
+        return np.asarray(self._fwd(self._jnp.asarray(x)))
+
+    def compute(self, row: Sequence[float]) -> float:
+        return float(self.compute_batch(np.asarray(row, dtype=np.float64))[0, 0])
+
+
+def _unflatten(flat: dict[str, np.ndarray]) -> dict:
+    out: dict = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return out
+
+
+def load_scorer(export_dir: str):
+    """Scorer for an artifact: op-list interpreter when the program exists,
+    JAX fallback otherwise."""
+    with open(os.path.join(export_dir, TOPOLOGY)) as f:
+        topo = json.load(f)
+    if topo.get("program"):
+        return Scorer(export_dir)
+    return JaxScorer(export_dir)

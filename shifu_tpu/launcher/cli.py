@@ -210,7 +210,17 @@ def run_score(args) -> int:
         from ..export import load_scorer
         scorer = load_scorer(args.model)
     n_feat = scorer.num_features if hasattr(scorer, "num_features") else rows.shape[1]
-    scores = scorer.compute_batch(rows[:, :n_feat])
+    if rows.shape[1] == n_feat:
+        feats = rows
+    else:
+        # full normalized rows: project the artifact's selected feature columns
+        with open(os.path.join(args.model, "topology.json")) as f:
+            sel = json.load(f).get("selected_indices")
+        if sel and rows.shape[1] > max(sel):
+            feats = np.nan_to_num(rows[:, sel], nan=0.0)
+        else:
+            feats = rows[:, :n_feat]
+    scores = scorer.compute_batch(feats)
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     for s in scores:
         out.write("|".join(f"{v:.6f}" for v in s) + "\n")

@@ -33,6 +33,11 @@ def pack_native(export_dir: str) -> str:
     """Pack topology.json + weights.npz into model.bin; returns its path."""
     with open(os.path.join(export_dir, "topology.json")) as f:
         topo = json.load(f)
+    if not topo.get("program"):
+        raise ValueError(
+            f"artifact has no op-list program (model_type="
+            f"{topo.get('model_type')!r}); the native engine currently lowers "
+            "dense-chain models only — use the JAX-fallback scorer")
     with np.load(os.path.join(export_dir, "weights.npz")) as z:
         weights = {k: np.asarray(z[k], dtype=np.float32) for k in z.files}
 
