@@ -103,6 +103,15 @@ class DataConfig:
     shuffle: bool = True
     drop_remainder: bool = True     # static shapes for XLA
     prefetch: int = 2
+    # staged epochs: device-put (block_batches, B, F) blocks once and
+    # lax.scan the train step on device — one H2D transfer per block instead
+    # of per batch; the 10M+ samples/sec input path (SURVEY.md section 7.3)
+    staged: bool = True
+    block_batches: int = 32
+    # device-resident tier: when the training partition fits in this many
+    # bytes of HBM, transfer it once and reorder batches on device each epoch
+    # (zero steady-state H2D).  0 disables.
+    device_resident_bytes: int = 2 << 30
 
     def validate(self) -> None:
         if not (0.0 <= self.valid_ratio < 1.0):

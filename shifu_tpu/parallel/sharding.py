@@ -44,6 +44,16 @@ def batch_spec(rank: int = 2) -> P:
     return P(DATA_AXIS, *([None] * (rank - 1)))
 
 
+def block_sharding(mesh: Mesh, rank: int = 3) -> NamedSharding:
+    """Staged-epoch blocks (nb, B, ...): shard the batch (second) axis."""
+    return NamedSharding(mesh, P(None, DATA_AXIS, *([None] * (rank - 2))))
+
+
+def shard_blocks(blocks: Mapping[str, np.ndarray], mesh: Mesh) -> dict[str, jax.Array]:
+    return {k: jax.device_put(v, block_sharding(mesh, v.ndim))
+            for k, v in blocks.items()}
+
+
 # -- parameter sharding rules ------------------------------------------------
 
 # rules: list of (path regex, PartitionSpec); first match wins, default replicated.

@@ -1,7 +1,9 @@
 from .checkpoint import make_manager, restore, restore_latest, save
 from .loop import EpochMetrics, TrainResult, evaluate, init_state, train
 from .optimizers import build_optimizer
-from .step import make_eval_step, make_forward_fn, make_loss_fn, make_train_step
+from .step import (make_device_epoch_step, make_epoch_scan_step,
+                   make_eval_step, make_forward_fn, make_loss_fn,
+                   make_train_step)
 from .train_state import TrainState
 
 __all__ = [
@@ -15,6 +17,8 @@ __all__ = [
     "init_state",
     "train",
     "build_optimizer",
+    "make_device_epoch_step",
+    "make_epoch_scan_step",
     "make_eval_step",
     "make_forward_fn",
     "make_loss_fn",

@@ -11,6 +11,7 @@ appmaster/TensorflowSession.java:515-549) — plus AUC.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Callable, Optional
 
@@ -27,7 +28,7 @@ from ..parallel import mesh as mesh_lib
 from ..parallel import sharding as shard_lib
 from . import checkpoint as ckpt_lib
 from .optimizers import build_optimizer
-from .step import make_eval_step, make_train_step
+from .step import make_epoch_scan_step, make_eval_step, make_train_step
 from .train_state import TrainState
 
 Console = Callable[[str], None]
@@ -151,9 +152,6 @@ def train(job: JobConfig,
                 start_epoch = int((extra or {}).get("epoch", 0))
                 console(f"Resumed from checkpoint step {step} (epoch {start_epoch})")
 
-    train_step = make_train_step(job, mesh)
-    eval_step = make_eval_step(job)
-
     if train_ds.num_rows == 0:
         raise ValueError("training dataset has 0 rows — nothing to train on")
 
@@ -168,6 +166,41 @@ def train(job: JobConfig,
     if mesh is not None:
         bs = -(-bs // mesh.size) * mesh.size  # divisible per-device shards
 
+    # input-path tier selection: device-resident (dataset fits HBM budget)
+    # > staged blocks > per-batch host feed
+    ds_bytes = (train_ds.features.nbytes + train_ds.target.nbytes
+                + train_ds.weight.nbytes)
+    use_resident = (job.data.staged and job.data.drop_remainder
+                    and 0 < ds_bytes <= job.data.device_resident_bytes
+                    and train_ds.num_rows // bs > 0)
+    use_staged = job.data.staged and job.data.drop_remainder and not use_resident
+    resident_blocks = None
+    if use_resident:
+        from .step import make_device_epoch_step
+        device_epoch_step = make_device_epoch_step(job, mesh)
+        nb_total = train_ds.num_rows // bs
+
+        def stack(arr):
+            return arr[:nb_total * bs].reshape(nb_total, bs, *arr.shape[1:])
+        host_blocks = {"features": stack(train_ds.features),
+                       "target": stack(train_ds.target),
+                       "weight": stack(train_ds.weight)}
+        if mesh is not None:
+            resident_blocks = shard_lib.shard_blocks(host_blocks, mesh)
+        else:
+            resident_blocks = {k: jax.device_put(v)
+                               for k, v in host_blocks.items()}
+    if use_staged:
+        epoch_scan_step = make_epoch_scan_step(job, mesh)
+    elif not use_resident:
+        train_step = make_train_step(job, mesh)
+    eval_step = make_eval_step(job)
+
+    from . import profiler as prof_lib
+
+    profile_dir = os.environ.get("SHIFU_TPU_PROFILE_DIR")
+    timing_on = bool(os.environ.get("SHIFU_TPU_TIMING")) or job.train.log_every_steps > 0
+
     history: list[EpochMetrics] = []
     for epoch in range(start_epoch, job.train.epochs):
         t0 = time.perf_counter()
@@ -175,16 +208,54 @@ def train(job: JobConfig,
         # async dispatch keeps the chips busy (bench.py measures the same way)
         loss_acc = None
         loss_n = 0
-        for batch in pipe.batch_iterator(
-                train_ds, bs, shuffle=job.data.shuffle,
-                seed=job.data.shuffle_seed, epoch=epoch,
-                drop_remainder=job.data.drop_remainder):
-            if mesh is not None:
-                batch = shard_lib.shard_batch(batch, mesh)
-            state, step_metrics = train_step(state, batch)
-            loss = step_metrics["loss"]
-            loss_acc = loss if loss_acc is None else loss_acc + loss
-            loss_n += 1
+        timer = prof_lib.StepTimer()
+        timer.start()
+        trace_ctx = (prof_lib.trace(profile_dir)
+                     if profile_dir and epoch == start_epoch
+                     else prof_lib.maybe_trace(None))
+        with trace_ctx:
+            if use_resident:
+                nb_total = resident_blocks["features"].shape[0]
+                if job.data.shuffle:
+                    rng = np.random.default_rng(
+                        np.random.PCG64(job.data.shuffle_seed * 1_000_003 + epoch))
+                    order = rng.permutation(nb_total).astype(np.int32)
+                else:
+                    order = np.arange(nb_total, dtype=np.int32)
+                timer.mark_input_ready()
+                state, loss_acc = device_epoch_step(
+                    state, resident_blocks, jnp.asarray(order))
+                loss_n = nb_total
+                timer.mark_step_done()
+            elif use_staged:
+                host_blocks = pipe.staged_epoch_blocks(
+                    train_ds, bs, shuffle=job.data.shuffle,
+                    seed=job.data.shuffle_seed, epoch=epoch,
+                    block_batches=job.data.block_batches)
+                put_fn = ((lambda b: shard_lib.shard_blocks(b, mesh))
+                          if mesh is not None else None)
+                for blocks in pipe.prefetch_to_device(
+                        host_blocks, mesh, size=job.data.prefetch, put_fn=put_fn):
+                    timer.mark_input_ready()
+                    nb = blocks["features"].shape[0]
+                    state, loss_sum_blk = epoch_scan_step(state, blocks)
+                    loss_acc = (loss_sum_blk if loss_acc is None
+                                else loss_acc + loss_sum_blk)
+                    loss_n += nb
+                    timer.mark_step_done()
+            else:
+                host_batches = pipe.batch_iterator(
+                    train_ds, bs, shuffle=job.data.shuffle,
+                    seed=job.data.shuffle_seed, epoch=epoch,
+                    drop_remainder=job.data.drop_remainder)
+                for batch in pipe.prefetch_to_device(host_batches, mesh,
+                                                     size=job.data.prefetch):
+                    timer.mark_input_ready()
+                    state, step_metrics = train_step(state, batch)
+                    loss = step_metrics["loss"]
+                    loss_acc = loss if loss_acc is None else loss_acc + loss
+                    loss_n += 1
+                    timer.mark_step_done()
         if loss_n == 0:
             raise ValueError(
                 f"epoch {epoch} produced 0 batches "
@@ -210,6 +281,8 @@ def train(job: JobConfig,
         )
         history.append(m)
         console(m.console_line())
+        if timing_on:
+            console(timer.console_line())
 
         # save before the callback so external kills (timeout, fault
         # injection, preemption) never lose the completed epoch

