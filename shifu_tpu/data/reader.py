@@ -48,8 +48,15 @@ def parse_rows(text: bytes | str, delimiter: str = "|") -> np.ndarray:
     text = text.strip("\n")
     if not text:
         return np.zeros((0, 0), dtype=np.float32)
-    first_newline = text.find("\n")
-    first_line = text if first_newline < 0 else text[:first_newline]
+    # column count from the first non-blank line (a leading whitespace-only
+    # line is not a row and must not decide the width)
+    first_line = ""
+    for line in text.split("\n"):
+        if line.strip():
+            first_line = line
+            break
+    if not first_line:
+        return np.zeros((0, 0), dtype=np.float32)
     ncols = first_line.count(delimiter) + 1
     if _pd is not None:
         try:
@@ -87,8 +94,8 @@ def _fast_parse(text: str, delimiter: str) -> Optional[np.ndarray]:
 def _parse_ragged(text: str, delimiter: str, ncols: int) -> np.ndarray:
     rows = []
     for line in text.split("\n"):
-        if not line:
-            continue
+        if not line.strip():
+            continue  # blank lines (incl. whitespace-only) are not rows
         cells = line.split(delimiter)
         vals = np.full((ncols,), np.nan, dtype=np.float32)
         for i, c in enumerate(cells[:ncols]):
@@ -103,7 +110,18 @@ def _parse_ragged(text: str, delimiter: str, ncols: int) -> np.ndarray:
 
 
 def read_file(path: str, delimiter: str = "|") -> np.ndarray:
-    """Read one (possibly gzipped) pipe-delimited file into (N, C) float32."""
+    """Read one (possibly gzipped) pipe-delimited file into (N, C) float32.
+
+    Uses the native C++ parser (zlib + from_chars, multi-threaded —
+    data/native_parser.py) when buildable; the vectorized numpy path above is
+    the fallback.  Both produce identical arrays (tested).
+    """
+    from . import native_parser
+    if len(delimiter.encode()) == 1 and native_parser.available():
+        try:
+            return native_parser.parse_file(path, delimiter)
+        except RuntimeError:  # engine-internal failure: numpy tier serves
+            pass  # (IO errors — FileNotFoundError/OSError — propagate)
     with open_maybe_gzip(path) as f:
         raw = f.read()
     return parse_rows(raw, delimiter)
@@ -115,11 +133,20 @@ def count_rows(paths: Sequence[str]) -> int:
     Successor of the reference's TOTAL_TRAINING_DATA_NUMBER computation
     (yarn/util/HdfsUtils.java:143-175 getFileLineCount).
     """
+    from . import native_parser
+    use_native = native_parser.available()
     total = 0
     for p in paths:
+        if use_native:
+            try:
+                total += native_parser.count_rows(p)
+                continue
+            except RuntimeError:
+                pass  # engine-internal failure: stream-count in Python
         with open_maybe_gzip(p) as f:
-            for _ in f:
-                total += 1
+            for line in f:
+                if line.strip():  # non-blank data lines only (= parser rows)
+                    total += 1
     return total
 
 

@@ -104,13 +104,11 @@ class CategoricalEmbed(nn.Module):
             (self.layout.num_categorical, max_vocab, self.dim),
             dtype_of(self.param_dtype))
         table = table.astype(dtype_of(self.compute_dtype))
-        # gather per field: ids (B, Nc) -> (B, Nc, dim)
-        out = jnp.take_along_axis(
-            table[None, :, :, :],                       # (1, Nc, V, D)
-            ids.astype(jnp.int32)[:, :, None, None],    # (B, Nc, 1, 1)
-            axis=2,
-        )[:, :, 0, :]
-        return out
+        # gather per field: ids (B, Nc) -> (B, Nc, dim).  Routed through
+        # ops/pallas_embedding.embedding_lookup: XLA gather by default, the
+        # manual-DMA Pallas kernel under SHIFU_TPU_PALLAS=1.
+        from ..ops.pallas_embedding import embedding_lookup
+        return embedding_lookup(table, ids.astype(jnp.int32))
 
 
 class NumericEmbed(nn.Module):

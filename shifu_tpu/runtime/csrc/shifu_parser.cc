@@ -1,0 +1,425 @@
+// shifu_parser — native columnar parser for gzip pipe-delimited tabular data.
+//
+// The input-format successor of the reference's per-line Python loader
+// (reference: resources/ssgd_monitor.py:348-454 — gzip.readline + split('|')
+// + float() per cell) and of its row counter
+// (yarn/util/HdfsUtils.java:143-175 getFileLineCount).  That loader is the
+// documented throughput anti-pattern (SURVEY.md §7.3 #1): reaching
+// 10M samples/sec needs a C-speed parse, which this provides:
+//
+//   - zlib inflate for gzip (multi-member / concatenated files supported,
+//     matching `gzip -c a >> f; gzip -c b >> f` HDFS part files),
+//   - std::from_chars float parse (locale-free, no strtod malloc churn),
+//   - optional multi-threaded parse: the buffer splits at newline boundaries,
+//     threads write disjoint row ranges of one contiguous output.
+//
+// Semantics (bit-parity with shifu_tpu/data/reader.py:parse_rows):
+//   - column count = delimiter count in the first non-empty line + 1
+//   - non-numeric / missing cells -> NaN (imputed downstream)
+//   - extra cells beyond the column count are ignored
+//   - empty lines are skipped; trailing '\r' is tolerated
+//
+// C ABI (ctypes from Python; JNA/JNI from Java):
+//   shifu_parse_file / shifu_parse_buffer -> malloc'd [rows x cols] float32
+//   shifu_parser_free, shifu_count_rows, shifu_parser_version
+
+#include <zlib.h>
+
+#include <atomic>
+#include <cctype>
+#include <charconv>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <limits>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kVersion = 1;
+
+// ---------------------------------------------------------------- file I/O
+
+bool read_whole_file(const char* path, std::string* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (size < 0) {
+    std::fclose(f);
+    return false;
+  }
+  out->resize(static_cast<size_t>(size));
+  bool ok = size == 0 ||
+            std::fread(&(*out)[0], 1, static_cast<size_t>(size), f) ==
+                static_cast<size_t>(size);
+  std::fclose(f);
+  return ok;
+}
+
+bool is_gzip(const std::string& raw) {
+  return raw.size() >= 2 && static_cast<unsigned char>(raw[0]) == 0x1f &&
+         static_cast<unsigned char>(raw[1]) == 0x8b;
+}
+
+// Inflate a (possibly multi-member) gzip buffer.  inflateReset after each
+// Z_STREAM_END continues into the next concatenated member.
+bool gunzip(const std::string& raw, std::string* out) {
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  if (inflateInit2(&zs, 15 + 16) != Z_OK) return false;
+  out->clear();
+  out->reserve(raw.size() * 4);
+  std::vector<char> buf(1 << 20);
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(raw.data()));
+  zs.avail_in = static_cast<uInt>(raw.size());
+  int rc = Z_OK;
+  bool complete = false;  // last member must end in Z_STREAM_END: a stream
+                          // cut mid-member is corrupt, not "done" (parity
+                          // with gzip.open's EOFError on truncation)
+  while (zs.avail_in > 0) {
+    zs.next_out = reinterpret_cast<Bytef*>(buf.data());
+    zs.avail_out = static_cast<uInt>(buf.size());
+    rc = inflate(&zs, Z_NO_FLUSH);
+    if (rc != Z_OK && rc != Z_STREAM_END) break;
+    out->append(buf.data(), buf.size() - zs.avail_out);
+    if (rc == Z_STREAM_END) {
+      if (zs.avail_in == 0) {
+        complete = true;                    // clean end of last member
+        break;
+      }
+      // gzip.GzipFile parity for bytes after a member: all-zero padding is
+      // EOF (block-aligned writers), a new magic is a concatenated member,
+      // anything else is corruption.
+      const Bytef* rest = zs.next_in;
+      if (zs.avail_in < 2 || !(rest[0] == 0x1f && rest[1] == 0x8b)) {
+        bool all_zero = true;
+        for (uInt i = 0; i < zs.avail_in; ++i)
+          if (rest[i] != 0) { all_zero = false; break; }
+        complete = all_zero;
+        if (!all_zero) rc = Z_DATA_ERROR;
+        break;
+      }
+      if (inflateReset(&zs) != Z_OK) {      // next concatenated member
+        rc = Z_DATA_ERROR;
+        break;
+      }
+      rc = Z_OK;
+    } else if (zs.avail_in == 0) {
+      break;  // input exhausted mid-member: truncated
+    }
+  }
+  inflateEnd(&zs);
+  return complete;
+}
+
+// ------------------------------------------------------------------ parsing
+
+inline float parse_cell(const char* begin, const char* end) {
+  // trim spaces/CR the way float(str) tolerates them
+  while (begin < end && (*begin == ' ' || *begin == '\t')) ++begin;
+  while (end > begin &&
+         (end[-1] == ' ' || end[-1] == '\t' || end[-1] == '\r'))
+    --end;
+  if (begin < end && *begin == '+') ++begin;  // from_chars rejects leading '+'
+  float v;
+  auto res = std::from_chars(begin, end, v);
+  if (res.ptr != end) return std::numeric_limits<float>::quiet_NaN();
+  if (res.ec == std::errc::result_out_of_range) {
+    // float() semantics: overflow -> +/-inf, underflow -> +/-0 (a double
+    // strtod then narrowed to float does exactly that)
+    std::string cell(begin, end);
+    return static_cast<float>(std::strtod(cell.c_str(), nullptr));
+  }
+  if (res.ec != std::errc())
+    return std::numeric_limits<float>::quiet_NaN();
+  return v;
+}
+
+// A line is "blank" (skipped, parity with the Python tier's strip() checks)
+// when it contains only spaces/tabs/CR.
+inline bool is_blank_line(const char* p, const char* end) {
+  for (; p < end; ++p)
+    if (*p != ' ' && *p != '\t' && *p != '\r') return false;
+  return true;
+}
+
+// Parse lines in [begin, end) into out rows of `ncols`, return rows written.
+int64_t parse_span(const char* begin, const char* end, char delim,
+                   int64_t ncols, float* out) {
+  const float nanv = std::numeric_limits<float>::quiet_NaN();
+  int64_t row = 0;
+  const char* p = begin;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(end - p)));
+    const char* line_end = nl ? nl : end;
+    if (!is_blank_line(p, line_end)) {
+      float* dst = out + row * ncols;
+      int64_t col = 0;
+      const char* cell = p;
+      while (col < ncols) {
+        const char* cell_end = static_cast<const char*>(
+            std::memchr(cell, delim, static_cast<size_t>(line_end - cell)));
+        const char* ce = cell_end ? cell_end : line_end;
+        dst[col++] = parse_cell(cell, ce);
+        if (!cell_end) break;  // line exhausted
+        cell = cell_end + 1;
+      }
+      for (; col < ncols; ++col) dst[col] = nanv;  // short row -> NaN-pad
+      ++row;
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+  return row;
+}
+
+int64_t count_nonempty_lines(const char* begin, const char* end) {
+  int64_t n = 0;
+  const char* p = begin;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(end - p)));
+    const char* line_end = nl ? nl : end;
+    if (!is_blank_line(p, line_end)) ++n;
+    if (!nl) break;
+    p = nl + 1;
+  }
+  return n;
+}
+
+int parse_text(const char* data, size_t len, char delim, int num_threads,
+               float** out, int64_t* out_rows, int64_t* out_cols) {
+  const char* begin = data;
+  const char* end = data + len;
+  // determine column count from the first non-empty line
+  const char* p = begin;
+  const char* first_line_end = nullptr;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(end - p)));
+    const char* le = nl ? nl : end;
+    if (!is_blank_line(p, le)) {
+      first_line_end = le;
+      break;
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+  if (!first_line_end) {  // empty input
+    *out = nullptr;
+    *out_rows = 0;
+    *out_cols = 0;
+    return 0;
+  }
+  int64_t ncols = 1;
+  for (const char* c = p; c < first_line_end; ++c)
+    if (*c == delim) ++ncols;
+
+  // choose thread count and chunk boundaries (newline-aligned)
+  unsigned hw = std::thread::hardware_concurrency();
+  if (num_threads <= 0) num_threads = hw ? static_cast<int>(hw) : 1;
+  size_t min_chunk = 4 << 20;  // threads only pay off on multi-MB inputs
+  int t = static_cast<int>(std::min<size_t>(
+      static_cast<size_t>(num_threads), len / min_chunk + 1));
+  std::vector<const char*> bounds;
+  bounds.push_back(begin);
+  for (int i = 1; i < t; ++i) {
+    const char* target = begin + len * static_cast<size_t>(i) / t;
+    if (target <= bounds.back()) continue;
+    const char* nl = static_cast<const char*>(
+        std::memchr(target, '\n', static_cast<size_t>(end - target)));
+    const char* b = nl ? nl + 1 : end;
+    if (b > bounds.back() && b < end) bounds.push_back(b);
+  }
+  bounds.push_back(end);
+  const int chunks = static_cast<int>(bounds.size()) - 1;
+
+  // pass 1: rows per chunk (parallel), prefix-sum into offsets
+  std::vector<int64_t> chunk_rows(chunks, 0);
+  {
+    std::vector<std::thread> ths;
+    for (int i = 0; i < chunks; ++i)
+      ths.emplace_back([&, i] {
+        chunk_rows[i] = count_nonempty_lines(bounds[i], bounds[i + 1]);
+      });
+    for (auto& th : ths) th.join();
+  }
+  int64_t total = 0;
+  std::vector<int64_t> offsets(chunks, 0);
+  for (int i = 0; i < chunks; ++i) {
+    offsets[i] = total;
+    total += chunk_rows[i];
+  }
+  float* buf = static_cast<float*>(
+      std::malloc(static_cast<size_t>(total) * ncols * sizeof(float)));
+  if (!buf && total > 0) return 2;  // OOM
+
+  // pass 2: parse (parallel, disjoint output ranges)
+  std::atomic<int> bad{0};
+  {
+    std::vector<std::thread> ths;
+    for (int i = 0; i < chunks; ++i)
+      ths.emplace_back([&, i] {
+        int64_t n = parse_span(bounds[i], bounds[i + 1], delim, ncols,
+                               buf + offsets[i] * ncols);
+        if (n != chunk_rows[i]) bad.fetch_add(1);
+      });
+    for (auto& th : ths) th.join();
+  }
+  if (bad.load() != 0) {
+    std::free(buf);
+    return 3;  // count/parse mismatch (should not happen)
+  }
+  *out = buf;
+  *out_rows = total;
+  *out_cols = ncols;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int shifu_parser_version() { return kVersion; }
+
+void shifu_parser_free(float* p) { std::free(p); }
+
+// Parse an in-memory text buffer. Returns 0 on success; *out is malloc'd
+// [rows x cols] row-major float32, freed with shifu_parser_free.
+int shifu_parse_buffer(const char* data, int64_t len, char delim,
+                       int num_threads, float** out, int64_t* rows,
+                       int64_t* cols) {
+  if (!data || len < 0 || !out || !rows || !cols) return 1;
+  return parse_text(data, static_cast<size_t>(len), delim, num_threads, out,
+                    rows, cols);
+}
+
+// Read a file (gunzip by magic number), then parse.  Same contract as
+// shifu_parse_buffer.
+int shifu_parse_file(const char* path, char delim, int num_threads,
+                     float** out, int64_t* rows, int64_t* cols) {
+  if (!path || !out || !rows || !cols) return 1;
+  std::string raw;
+  if (!read_whole_file(path, &raw)) return 4;  // unreadable
+  if (is_gzip(raw)) {
+    std::string text;
+    if (!gunzip(raw, &text)) return 5;  // corrupt gzip
+    raw.swap(text);
+  }
+  return parse_text(raw.data(), raw.size(), delim, num_threads, out, rows,
+                    cols);
+}
+
+// Count data lines in a (possibly gzipped) file; -1 on error.  Successor of
+// HdfsUtils.getFileLineCount (yarn/util/HdfsUtils.java:143-175) — but counts
+// non-blank lines, matching what the parsers above will actually yield.
+// Streams in fixed-size chunks (constant memory regardless of file size).
+int64_t shifu_count_rows(const char* path) {
+  if (!path) return -1;
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+
+  // Carry-over state for line counting across chunk boundaries.
+  int64_t n = 0;
+  bool line_has_content = false;
+  auto feed = [&](const char* p, size_t len) {
+    for (size_t i = 0; i < len; ++i) {
+      const char c = p[i];
+      if (c == '\n') {
+        if (line_has_content) ++n;
+        line_has_content = false;
+      } else if (c != ' ' && c != '\t' && c != '\r') {
+        line_has_content = true;
+      }
+    }
+  };
+
+  std::vector<char> in(1 << 20);
+  size_t got = std::fread(in.data(), 1, in.size(), f);
+  const bool gz = got >= 2 && static_cast<unsigned char>(in[0]) == 0x1f &&
+                  static_cast<unsigned char>(in[1]) == 0x8b;
+  bool ok = true;
+  if (!gz) {
+    while (got > 0) {
+      feed(in.data(), got);
+      got = std::fread(in.data(), 1, in.size(), f);
+    }
+  } else {
+    z_stream zs;
+    std::memset(&zs, 0, sizeof(zs));
+    if (inflateInit2(&zs, 15 + 16) != Z_OK) {
+      std::fclose(f);
+      return -1;
+    }
+    std::vector<char> outbuf(1 << 20);
+    bool complete = false;
+    int rc = Z_OK;
+    while (ok && got > 0) {
+      zs.next_in = reinterpret_cast<Bytef*>(in.data());
+      zs.avail_in = static_cast<uInt>(got);
+      while (zs.avail_in > 0) {
+        zs.next_out = reinterpret_cast<Bytef*>(outbuf.data());
+        zs.avail_out = static_cast<uInt>(outbuf.size());
+        rc = inflate(&zs, Z_NO_FLUSH);
+        if (rc != Z_OK && rc != Z_STREAM_END) {
+          ok = false;
+          break;
+        }
+        feed(outbuf.data(), outbuf.size() - zs.avail_out);
+        if (rc == Z_STREAM_END) {
+          // refill so member-boundary logic sees the next bytes
+          if (zs.avail_in < 2) {
+            std::memmove(in.data(), zs.next_in, zs.avail_in);
+            size_t more = std::fread(in.data() + zs.avail_in, 1,
+                                     in.size() - zs.avail_in, f);
+            zs.next_in = reinterpret_cast<Bytef*>(in.data());
+            zs.avail_in += static_cast<uInt>(more);
+          }
+          if (zs.avail_in == 0) {
+            complete = true;
+            break;
+          }
+          const Bytef* rest = zs.next_in;
+          if (zs.avail_in < 2 || !(rest[0] == 0x1f && rest[1] == 0x8b)) {
+            // all-zero padding (incl. any remaining file bytes) is EOF
+            bool all_zero = true;
+            for (uInt i = 0; all_zero && i < zs.avail_in; ++i)
+              if (rest[i] != 0) all_zero = false;
+            while (all_zero) {
+              size_t more = std::fread(in.data(), 1, in.size(), f);
+              if (more == 0) break;
+              for (size_t i = 0; all_zero && i < more; ++i)
+                if (in[i] != 0) all_zero = false;
+            }
+            complete = all_zero;
+            ok = all_zero;
+            zs.avail_in = 0;
+            break;
+          }
+          if (inflateReset(&zs) != Z_OK) {
+            ok = false;
+            break;
+          }
+        }
+      }
+      if (complete || !ok) break;
+      got = std::fread(in.data(), 1, in.size(), f);
+      if (got == 0 && rc != Z_STREAM_END) ok = false;  // truncated mid-member
+    }
+    if (!complete && rc != Z_STREAM_END) ok = false;
+    inflateEnd(&zs);
+  }
+  std::fclose(f);
+  if (!ok) return -1;
+  if (line_has_content) ++n;  // final line without trailing newline
+  return n;
+}
+
+}  // extern "C"

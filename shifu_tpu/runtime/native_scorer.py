@@ -15,7 +15,6 @@ import ctypes
 import json
 import os
 import struct
-import subprocess
 from typing import Optional, Sequence
 
 import numpy as np
@@ -25,8 +24,6 @@ _ACT_IDS = {"linear": 0, None: 0, "": 0, "sigmoid": 1, "tanh": 2,
 
 _MAGIC = 0x55464853  # "SHFU"
 MODEL_BIN = "model.bin"
-_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc", "shifu_scorer.cc")
-_LIB_NAME = "libshifu_scorer.so"
 
 
 def pack_native(export_dir: str) -> str:
@@ -65,17 +62,8 @@ def pack_native(export_dir: str) -> str:
 
 def build_library(out_dir: Optional[str] = None, force: bool = False) -> str:
     """Compile the C++ engine into a shared library (cached); returns path."""
-    out_dir = out_dir or os.path.join(os.path.dirname(_SRC), "..", "_build")
-    out_dir = os.path.abspath(out_dir)
-    os.makedirs(out_dir, exist_ok=True)
-    lib_path = os.path.join(out_dir, _LIB_NAME)
-    if os.path.exists(lib_path) and not force and (
-            os.path.getmtime(lib_path) >= os.path.getmtime(_SRC)):
-        return lib_path
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           "-o", lib_path, _SRC]
-    subprocess.run(cmd, check=True, capture_output=True)
-    return lib_path
+    from .nativelib import build_library as _build
+    return _build("shifu_scorer.cc", out_dir=out_dir, force=force)
 
 
 class NativeScorer:

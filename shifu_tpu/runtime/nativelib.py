@@ -1,0 +1,49 @@
+"""Shared build/caching for the framework's native C++ components.
+
+One g++ invocation per source file, cached in `runtime/_build/` keyed by
+source mtime.  Used by the scoring engine (csrc/shifu_scorer.cc) and the
+data parser (csrc/shifu_parser.cc); both are dependency-free C ABI shared
+libraries bindable from Python (ctypes) and the JVM (JNA/JNI) — the authored
+native-code layer replacing the reference's consumed TF C++ runtime
+(shifu-tensorflow-eval/pom.xml:59-73).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+_BUILD = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+_lock = threading.Lock()
+
+
+def build_library(
+    source_name: str,
+    extra_flags: Sequence[str] = (),
+    out_dir: Optional[str] = None,
+    force: bool = False,
+) -> str:
+    """Compile `csrc/<source_name>` into a cached .so; returns its path.
+
+    Raises RuntimeError with the compiler's stderr on failure so callers can
+    fall back to pure-Python paths with a loggable reason.
+    """
+    src = os.path.join(_CSRC, source_name)
+    out_dir = os.path.abspath(out_dir or _BUILD)
+    os.makedirs(out_dir, exist_ok=True)
+    lib_path = os.path.join(
+        out_dir, "lib" + os.path.splitext(source_name)[0] + ".so")
+    with _lock:
+        if (os.path.exists(lib_path) and not force
+                and os.path.getmtime(lib_path) >= os.path.getmtime(src)):
+            return lib_path
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               "-o", lib_path, src, *extra_flags]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build failed ({' '.join(cmd)}):\n{proc.stderr}")
+    return lib_path

@@ -1,0 +1,186 @@
+"""Native C++ parser: bit-parity with the Python reader tier.
+
+The parser (runtime/csrc/shifu_parser.cc) replaces the reference's per-line
+Python loader (resources/ssgd_monitor.py:348-454).  These tests pin its
+semantics to reader.parse_rows: same shapes, same values, same NaN placement
+for bad/missing cells, gzip by magic number (incl. concatenated members).
+"""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.data import native_parser, reader
+
+pytestmark = pytest.mark.skipif(
+    not native_parser.available(),
+    reason=f"native parser unavailable: {native_parser.unavailable_reason()}")
+
+
+def _write(tmp_path, name, data: bytes):
+    p = os.path.join(tmp_path, name)
+    with open(p, "wb") as f:
+        f.write(data)
+    return p
+
+
+def test_plain_file_matches_python(tmp_path):
+    rng = np.random.default_rng(0)
+    arr = rng.standard_normal((257, 13)).astype(np.float32)
+    text = "\n".join("|".join(f"{v:.6g}" for v in row) for row in arr)
+    p = _write(tmp_path, "plain.txt", text.encode())
+    got = native_parser.parse_file(p)
+    want = reader.parse_rows(text)
+    np.testing.assert_array_equal(got, want)
+    assert got.dtype == np.float32 and got.shape == (257, 13)
+
+
+def test_gzip_and_multimember(tmp_path):
+    a = "1|2|3\n4|5|6\n"
+    b = "7|8|9\n"
+    single = _write(tmp_path, "a.gz", gzip.compress(a.encode()))
+    multi = _write(tmp_path, "m.gz",
+                   gzip.compress(a.encode()) + gzip.compress(b.encode()))
+    np.testing.assert_array_equal(
+        native_parser.parse_file(single),
+        np.array([[1, 2, 3], [4, 5, 6]], np.float32))
+    np.testing.assert_array_equal(
+        native_parser.parse_file(multi),
+        np.array([[1, 2, 3], [4, 5, 6], [7, 8, 9]], np.float32))
+
+
+def test_bad_cells_short_rows_empty_lines(tmp_path):
+    text = "1|x|3\n\n4|5\n+6|-7|8e0\n"
+    p = _write(tmp_path, "ragged.txt", text.encode())
+    got = native_parser.parse_file(p)
+    want = reader.parse_rows(text)
+    np.testing.assert_array_equal(got, want)
+    assert np.isnan(got[0, 1])          # non-numeric cell
+    assert np.isnan(got[1, 2])          # short row NaN-padded
+    assert got[2, 0] == 6.0             # leading '+' accepted like float()
+    assert got.shape == (3, 3)          # empty line skipped
+
+
+def test_crlf_and_extra_cells(tmp_path):
+    text = "1|2\r\n3|4|99\r\n"
+    p = _write(tmp_path, "crlf.txt", text.encode())
+    got = native_parser.parse_file(p)
+    np.testing.assert_array_equal(got, np.array([[1, 2], [3, 4]], np.float32))
+
+
+def test_parse_buffer_roundtrip():
+    text = b"0.5|1.5\n-0.25|nan\n"
+    got = native_parser.parse_buffer(text)
+    assert got.shape == (2, 2)
+    assert got[0, 0] == 0.5 and np.isnan(got[1, 1])
+
+
+def test_count_rows_matches_python(tmp_path):
+    text = "1|2\n\n3|4\n5|6"
+    plain = _write(tmp_path, "c.txt", text.encode())
+    gz = _write(tmp_path, "c.gz", gzip.compress(text.encode()))
+    assert native_parser.count_rows(plain) == 3
+    assert native_parser.count_rows(gz) == 3
+    assert reader.count_rows([plain, gz]) == 6
+
+
+def test_reader_read_file_uses_native(tmp_path):
+    """read_file routes through the native tier and equals the numpy tier."""
+    rng = np.random.default_rng(1)
+    arr = rng.standard_normal((64, 5)).astype(np.float32)
+    text = "\n".join("|".join(f"{v:.7g}" for v in row) for row in arr)
+    p = _write(tmp_path, "r.gz", gzip.compress(text.encode()))
+    got = reader.read_file(p)
+    want = reader.parse_rows(text)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_truncated_gzip_raises(tmp_path):
+    """A gzip stream cut mid-member is an error, not silent partial data."""
+    full = gzip.compress(("1|2\n" * 1000).encode())
+    p = _write(tmp_path, "trunc.gz", full[: len(full) // 2])
+    with pytest.raises(OSError):
+        native_parser.parse_file(p)
+    # reader tier surfaces an error too (numpy fallback raises EOFError)
+    with pytest.raises((OSError, EOFError)):
+        reader.read_file(p)
+
+
+def test_missing_file_raises_filenotfound(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        native_parser.parse_file(os.path.join(tmp_path, "nope.txt"))
+    with pytest.raises(FileNotFoundError):
+        reader.read_file(os.path.join(tmp_path, "nope.txt"))
+
+
+def test_whitespace_only_lines_skipped(tmp_path):
+    """' ' lines are blank in all tiers: parse rows == count_rows."""
+    text = "1|2\n \n3|4\n\t\n5|6"
+    p = _write(tmp_path, "ws.txt", text.encode())
+    got = native_parser.parse_file(p)
+    want = reader.parse_rows(text)
+    np.testing.assert_array_equal(got, want)
+    assert got.shape == (3, 2)
+    assert native_parser.count_rows(p) == 3 == reader.count_rows([p])
+
+
+def test_out_of_range_matches_float(tmp_path):
+    """Overflow -> +/-inf, underflow -> 0, like Python float()."""
+    text = "1e999|-1e999|1e-999|2"
+    got = native_parser.parse_buffer(text.encode())
+    want = reader.parse_rows(text)
+    np.testing.assert_array_equal(got, want)
+    assert got[0, 0] == np.inf and got[0, 1] == -np.inf and got[0, 2] == 0.0
+
+
+def test_multibyte_delimiter_falls_back(tmp_path):
+    with pytest.raises(ValueError):
+        native_parser.parse_buffer(b"1||2\n", delimiter="||")
+    p = _write(tmp_path, "mb.txt", b"1||2\n3||4\n")
+    got = reader.read_file(p, delimiter="||")  # numpy tier serves
+    np.testing.assert_array_equal(got, np.array([[1, 2], [3, 4]], np.float32))
+
+
+def test_zero_padded_gzip_tolerated(tmp_path):
+    """Block-aligned writers pad gzip files with zeros; both tiers read them
+    (gzip.GzipFile parity), while non-zero trailing garbage is an error."""
+    body = gzip.compress(b"1|2\n3|4\n")
+    padded = _write(tmp_path, "pad.gz", body + b"\x00" * 64)
+    want = np.array([[1, 2], [3, 4]], np.float32)
+    np.testing.assert_array_equal(native_parser.parse_file(padded), want)
+    assert native_parser.count_rows(padded) == 2
+    garbage = _write(tmp_path, "garb.gz", body + b"XYZW")
+    with pytest.raises(OSError):
+        native_parser.parse_file(garbage)
+
+
+def test_leading_blank_line_does_not_decide_width(tmp_path):
+    """A whitespace-only first line must not shrink the column count in
+    either tier."""
+    text = "  \n1|2\n3|4\n"
+    want = np.array([[1, 2], [3, 4]], np.float32)
+    np.testing.assert_array_equal(reader.parse_rows(text), want)
+    np.testing.assert_array_equal(
+        native_parser.parse_buffer(text.encode()), want)
+
+
+def test_count_rows_missing_file_contract(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        native_parser.count_rows(os.path.join(tmp_path, "nope.txt"))
+
+
+def test_count_rows_streaming_large(tmp_path):
+    """Streaming counter handles multi-chunk (>1MB) gzip files correctly."""
+    line = b"1.5|2.5|3.5\n"
+    n = 300_000  # ~3.6 MB decompressed, spans several 1MB chunks
+    gz = _write(tmp_path, "big.gz", gzip.compress(line * n))
+    assert native_parser.count_rows(gz) == n
+
+
+def test_empty_file(tmp_path):
+    p = _write(tmp_path, "e.txt", b"")
+    got = native_parser.parse_file(p)
+    assert got.shape[0] == 0
+    assert native_parser.count_rows(p) == 0

@@ -1,5 +1,6 @@
 """Pallas embedding-lookup kernel tests (interpret mode on the CPU mesh;
-the same kernel compiles natively on TPU — exercised by bench_pallas.py)."""
+on TPU the same kernel is opted into via SHIFU_TPU_PALLAS=1, which routes
+models/embedding.CategoricalEmbed through it)."""
 
 import numpy as np
 import pytest
