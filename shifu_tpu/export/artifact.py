@@ -13,10 +13,13 @@ directory that the eval side scores WITHOUT any TF/JAX runtime:
       weights.npz               # flat params, keys referenced by the program
       scoring.mlir              # StableHLO of the scoring fn (AOT/native path)
 
-The op-list program is the artifact's executable spec: a sequence of simple
-ops (dense / activation / sigmoid head) interpreted identically by the Python
-scorer (export/scorer.py) and the native C++ scorer (runtime/), so every
-scorer implementation scores bit-for-bit the same model.
+The op-list program (format v2, export/program.py) is the artifact's
+executable spec: an SSA-style op sequence over named buffers (dense,
+embedding lookup, FM interaction, layernorm, transformer block, ...) that
+lowers every ladder model — MLP, Wide&Deep, DeepFM, multi-task,
+FT-Transformer — and is executed identically (float32-roundoff parity) by
+the numpy interpreter (export/scorer.py) and the native C++ engine
+(runtime/csrc/shifu_scorer.cc).
 """
 
 from __future__ import annotations
@@ -51,32 +54,17 @@ def _flatten_params(params: Any) -> dict[str, np.ndarray]:
             for kp, leaf in flat}
 
 
-def build_program(spec: ModelSpec) -> Optional[list[dict[str, Any]]]:
-    """The op-list for sequential (MLP-family) models.
+def build_program(spec: ModelSpec, schema=None) -> Optional[list[dict[str, Any]]]:
+    """The op-list program for the artifact (format v2, export/program.py).
 
-    Each dense op references weight keys in weights.npz; the trailing sigmoid
-    reproduces the reference's sigmoid scoring head (ssgd_monitor.py:121).
-    Returns None for model types whose graph is not a dense chain — those
-    artifacts carry the full model spec instead and score through the
-    JAX-fallback scorer (export/scorer.py JaxScorer; still CPU-only, no TF).
+    Lowers every ladder model type — MLP, Wide&Deep, DeepFM, multi-task,
+    FT-Transformer — to the portable tensor program executed by the numpy
+    interpreter and the native C++ engine.  The trailing sigmoid reproduces
+    the reference's scoring head (ssgd_monitor.py:121).  Returns None only
+    for unknown model types (those score through JaxScorer).
     """
-    if spec.model_type != "mlp":
-        return None
-    program: list[dict[str, Any]] = []
-    for i, act in enumerate(spec.activations):
-        program.append({
-            "op": "dense",
-            "kernel": f"trunk/hidden_layer{i}/Dense_0/kernel",
-            "bias": f"trunk/hidden_layer{i}/Dense_0/bias",
-            "activation": act,
-        })
-    program.append({
-        "op": "dense",
-        "kernel": "head/shifu_output_0/Dense_0/kernel",
-        "bias": "head/shifu_output_0/Dense_0/bias",
-        "activation": "sigmoid",
-    })
-    return program
+    from .program import build_program_v2
+    return build_program_v2(spec, schema)
 
 
 def export_stablehlo(forward_fn, params, num_features: int, path: str,
@@ -110,10 +98,10 @@ def save_artifact(params: Any, job: JobConfig, export_dir: str,
     flat = _flatten_params(params)
     np.savez(os.path.join(export_dir, WEIGHTS), **flat)
 
-    program = build_program(job.model)
+    program = build_program(job.model, job.schema)
     if program is not None:
-        missing = [op[k] for op in program for k in ("kernel", "bias")
-                   if op.get(k) and op[k] not in flat]
+        from .program import weight_keys
+        missing = [k for k in weight_keys(program) if k not in flat]
         if missing:
             raise ValueError(f"program references missing weights: {missing}; "
                              f"have {sorted(flat)}")
@@ -121,6 +109,7 @@ def save_artifact(params: Any, job: JobConfig, export_dir: str,
     import dataclasses
     topology = {
         "format_version": FORMAT_VERSION,
+        "program_version": 2 if program is not None else None,
         "model_type": job.model.model_type,
         "num_features": job.schema.feature_count,
         "num_heads": job.model.num_heads,

@@ -20,6 +20,7 @@ import numpy as np
 from .artifact import SIDE_CAR, TOPOLOGY, WEIGHTS
 
 _LEAKY_ALPHA = 0.2  # keep in sync with ops/activations.py
+_LN_EPS = 1e-6      # flax nn.LayerNorm default
 
 
 def _act(name: str, x: np.ndarray) -> np.ndarray:
@@ -37,9 +38,112 @@ def _act(name: str, x: np.ndarray) -> np.ndarray:
         return np.maximum(x, 0.0)
     if name == "leakyrelu":
         return np.where(x >= 0, x, _LEAKY_ALPHA * x)
+    if name == "gelu":
+        # tanh approximation — flax nn.gelu default (approximate=True)
+        c = np.float32(np.sqrt(2.0 / np.pi))
+        return 0.5 * x * (1.0 + np.tanh(c * (x + 0.044715 * x * x * x)))
     if name in (None, "", "linear"):
         return x
     raise ValueError(f"unknown activation {name!r}")
+
+
+def _layernorm(x: np.ndarray, scale: np.ndarray, bias: np.ndarray) -> np.ndarray:
+    mean = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    return (x - mean) / np.sqrt(var + _LN_EPS) * scale + bias
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    m = x.max(axis=-1, keepdims=True)
+    e = np.exp(x - m)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def _transformer_block(op: dict, w: dict[str, np.ndarray], x: np.ndarray
+                       ) -> np.ndarray:
+    """Pre-LN MHA + residual, then pre-LN gelu-MLP + residual — the exact
+    forward of models/ft_transformer.py TransformerBlock (float32)."""
+    b, s, d = x.shape
+    h = int(op["num_heads"])
+    dh = d // h
+    y = _layernorm(x, w[op["ln_attn_scale"]], w[op["ln_attn_bias"]])
+    qkv = y @ w[op["qkv_kernel"]] + w[op["qkv_bias"]]
+    q, k, v = np.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
+    scores = (q @ k.transpose(0, 1, 3, 2)) * np.float32(1.0 / np.sqrt(dh))
+    attn = (_softmax(scores) @ v).transpose(0, 2, 1, 3).reshape(b, s, d)
+    x = x + attn @ w[op["proj_kernel"]] + w[op["proj_bias"]]
+    y = _layernorm(x, w[op["ln_mlp_scale"]], w[op["ln_mlp_bias"]])
+    y = _act("gelu", y @ w[op["mlp_in_kernel"]] + w[op["mlp_in_bias"]])
+    return x + y @ w[op["mlp_out_kernel"]] + w[op["mlp_out_bias"]]
+
+
+def run_program(program: list[dict], weights: dict[str, np.ndarray],
+                x: np.ndarray) -> np.ndarray:
+    """Execute an artifact op-list on (B, F) float32 rows.
+
+    Handles both format v1 (implicit dense chain, no src/out fields) and the
+    general v2 SSA form (export/program.py).  This interpreter and the native
+    C++ engine (runtime/csrc/shifu_scorer.cc) are semantically pinned to each
+    other by tests/test_native_scorer.py.
+    """
+    bufs: dict[str, np.ndarray] = {"input": x}
+    cur = x
+    for op in program:
+        kind = op["op"]
+        src = bufs[op["src"]] if "src" in op else cur
+        w = weights
+        if kind == "dense":
+            out = src @ w[op["kernel"]] + w[op["bias"]]
+            out = _act(op.get("activation"), out)
+        elif kind == "gather_cols":
+            out = src[:, np.asarray(op["positions"], dtype=np.int64)]
+        elif kind == "embed_lookup":
+            pos = np.asarray(op["positions"], dtype=np.int64)
+            vocab = np.asarray(op["vocabs"], dtype=np.int32)
+            ids = src[:, pos].astype(np.int32)
+            ids = np.clip(ids, 0, vocab - 1)              # (B, Nc)
+            table = w[op["table"]]                        # (Nc, maxV, D)
+            out = table[np.arange(len(pos))[None, :], ids]  # (B, Nc, D)
+        elif kind == "numeric_embed":
+            out = src[:, :, None] * w[op["weight"]][None] + w[op["bias"]][None]
+        elif kind == "concat":
+            out = np.concatenate([bufs[s] for s in op["srcs"]], axis=1)
+        elif kind == "flatten":
+            out = src.reshape(src.shape[0], -1)
+        elif kind == "sum_fields":
+            out = src.sum(axis=1)
+        elif kind == "add":
+            parts = [bufs[s] for s in op["srcs"]]
+            out = parts[0]
+            for p in parts[1:]:
+                out = out + p                              # (B,1) broadcasts
+        elif kind == "fm_pair":
+            sum_sq = np.square(src.sum(axis=1))
+            sq_sum = np.square(src).sum(axis=1)
+            out = 0.5 * (sum_sq - sq_sum).sum(axis=-1, keepdims=True)
+        elif kind == "activation":
+            out = _act(op.get("fn"), src)
+        elif kind == "cls_prepend":
+            token = np.broadcast_to(
+                w[op["token"]].reshape(1, 1, -1),
+                (src.shape[0], 1, src.shape[2]))
+            out = np.concatenate([token, src], axis=1)
+        elif kind == "layernorm":
+            out = _layernorm(src, w[op["scale"]], w[op["bias"]])
+        elif kind == "select_token":
+            out = src[:, int(op["index"]), :]
+        elif kind == "transformer_block":
+            out = _transformer_block(op, w, src)
+        else:
+            raise ValueError(f"unknown op {kind!r}")
+        out = np.asarray(out, dtype=np.float32)
+        if "out" in op:
+            bufs[op["out"]] = out
+        cur = out
+    return cur
 
 
 class Scorer:
@@ -74,13 +178,7 @@ class Scorer:
         if x.shape[1] != self.num_features:
             raise ValueError(
                 f"expected {self.num_features} features, got {x.shape[1]}")
-        for op in self.program:
-            if op["op"] == "dense":
-                x = x @ self.weights[op["kernel"]] + self.weights[op["bias"]]
-                x = _act(op.get("activation"), x)
-            else:
-                raise ValueError(f"unknown op {op['op']!r}")
-        return x
+        return run_program(self.program, self.weights, x)
 
     def compute(self, row: Sequence[float]) -> float:
         """Single-row double score in [0,1] — the reference's exact call shape

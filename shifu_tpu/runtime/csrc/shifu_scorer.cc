@@ -4,21 +4,19 @@
 // reference's use of the TensorFlow 1.4 C++ runtime over JNI
 // (reference: shifu-tensorflow-eval/pom.xml:59-73 libtensorflow_jni, loaded
 // by TensorflowModel.java:169 SavedModelBundle.load).  Where the reference
-// dragged in a full TF runtime to score a small MLP row-at-a-time, this is a
-// dependency-free C ABI library (~no runtime deps beyond libm) that executes
-// the artifact's op-list program: a chain of dense layers with fused
-// activations, matching export/scorer.py bit-for-bit in float32.
+// dragged in a full TF runtime, this is a dependency-free C ABI library
+// (no runtime deps beyond libm) that executes the artifact's op-list program
+// (export/program.py format v2) over named buffers, covering the full model
+// ladder — MLP, Wide&Deep, DeepFM, multi-task, FT-Transformer — and matching
+// the numpy interpreter (export/scorer.py run_program) to float32 roundoff.
 //
 // Model file format ("model.bin", little-endian, packed by
 // shifu_tpu/runtime/native_scorer.py:pack_native):
 //   magic   u32 = 0x55464853 ("SHFU")
-//   version u32 = 1
-//   num_features u32, num_heads u32, num_ops u32
-//   per op:
-//     activation u32 (0 linear, 1 sigmoid, 2 tanh, 3 relu, 4 leakyrelu)
-//     in_dim u32, out_dim u32
-//     kernel f32[in_dim*out_dim]  (row-major, [in][out])
-//     bias   f32[out_dim]
+//   version u32 = 2
+//   num_features u32, num_heads u32, num_buffers u32, num_ops u32
+//   per op: opcode u32, dst u32, src u32 (0xFFFFFFFF if unused), then
+//   op-specific fields/weights (see readers below).  Buffer 0 is the input.
 //
 // C ABI (bind from Java via JNA/JNI, from Python via ctypes):
 //   shifu_scorer_load / _free / _num_features / _num_heads /
@@ -34,7 +32,10 @@
 namespace {
 
 constexpr uint32_t kMagic = 0x55464853u;  // "SHFU"
-constexpr float kLeakyAlpha = 0.2f;       // TF 1.4 leaky_relu default (parity)
+constexpr uint32_t kVersion = 2;
+constexpr uint32_t kNoBuf = 0xFFFFFFFFu;
+constexpr float kLeakyAlpha = 0.2f;  // TF 1.4 leaky_relu default (parity)
+constexpr float kLnEps = 1e-6f;      // flax nn.LayerNorm default
 
 enum Activation : uint32_t {
   kLinear = 0,
@@ -42,25 +43,65 @@ enum Activation : uint32_t {
   kTanh = 2,
   kRelu = 3,
   kLeakyRelu = 4,
+  kGelu = 5,  // tanh approximation (flax nn.gelu default)
 };
 
-struct DenseOp {
-  uint32_t activation;
-  uint32_t in_dim;
-  uint32_t out_dim;
-  std::vector<float> kernel;  // [in][out]
-  std::vector<float> bias;    // [out]
+enum OpCode : uint32_t {
+  kDense = 0,
+  kGatherCols = 1,
+  kEmbedLookup = 2,
+  kNumericEmbed = 3,
+  kConcat = 4,
+  kFlatten = 5,
+  kSumFields = 6,
+  kAdd = 7,
+  kFmPair = 8,
+  kActivation = 9,
+  kClsPrepend = 10,
+  kLayerNorm = 11,
+  kSelectToken = 12,
+  kTransformerBlock = 13,
+};
+
+struct Op {
+  uint32_t code = 0;
+  uint32_t dst = 0;
+  uint32_t src = kNoBuf;
+  uint32_t act = 0;          // dense / activation
+  uint32_t a = 0, b = 0, c = 0;  // op-specific dims (in/out, fields/dim, ...)
+  std::vector<uint32_t> idx;     // positions / vocabs / src lists
+  std::vector<float> w0, w1;     // kernel/bias, weight/bias, scale/bias, token
+  std::vector<float> tw[12];     // transformer block weights (fixed order)
+};
+
+// Static per-buffer shape (batch dim implicit): rank 2 => (B, d1),
+// rank 3 => (B, d1, d2).
+struct Shape {
+  uint32_t rank = 0;
+  uint32_t d1 = 0;
+  uint32_t d2 = 0;
+  size_t per_row() const { return rank == 3 ? size_t(d1) * d2 : d1; }
 };
 
 struct Model {
   uint32_t num_features = 0;
   uint32_t num_heads = 0;
-  std::vector<DenseOp> ops;
-  uint32_t max_width = 0;
+  std::vector<Op> ops;
+  std::vector<Shape> shapes;  // per buffer, inferred at load
 };
 
 bool read_u32(FILE* f, uint32_t* out) {
   return std::fread(out, sizeof(uint32_t), 1, f) == 1;
+}
+
+bool read_f32s(FILE* f, std::vector<float>* out, size_t n) {
+  out->resize(n);
+  return std::fread(out->data(), sizeof(float), n, f) == n;
+}
+
+bool read_u32s(FILE* f, std::vector<uint32_t>* out, size_t n) {
+  out->resize(n);
+  return std::fread(out->data(), sizeof(uint32_t), n, f) == n;
 }
 
 float apply_act(uint32_t act, float x) {
@@ -72,26 +113,425 @@ float apply_act(uint32_t act, float x) {
     case kTanh: return std::tanh(x);
     case kRelu: return x > 0.0f ? x : 0.0f;
     case kLeakyRelu: return x >= 0.0f ? x : kLeakyAlpha * x;
+    case kGelu: {
+      const float kC = 0.7978845608028654f;  // sqrt(2/pi)
+      return 0.5f * x * (1.0f + std::tanh(kC * (x + 0.044715f * x * x * x)));
+    }
     default: return x;
   }
 }
 
-// y[b][out] = act(x[b][in] @ kernel[in][out] + bias[out])
-// Row-major kernel keeps the inner loop contiguous over `out` so the
-// compiler vectorizes it; batches iterate outermost.
-void dense_forward(const DenseOp& op, const float* x, float* y, int batch) {
-  const uint32_t in = op.in_dim, out = op.out_dim;
-  for (int b = 0; b < batch; ++b) {
-    const float* row = x + static_cast<size_t>(b) * in;
-    float* dst = y + static_cast<size_t>(b) * out;
-    std::memcpy(dst, op.bias.data(), out * sizeof(float));
-    for (uint32_t i = 0; i < in; ++i) {
-      const float v = row[i];
-      const float* krow = op.kernel.data() + static_cast<size_t>(i) * out;
-      for (uint32_t o = 0; o < out; ++o) dst[o] += v * krow[o];
+// y[m][n] = x[m][k] @ w[k][n] + bias[n]; row-major w keeps the inner loop
+// contiguous over n so the compiler vectorizes it.
+void matmul_bias(const float* x, const float* w, const float* bias, float* y,
+                 size_t m, size_t k, size_t n) {
+  for (size_t i = 0; i < m; ++i) {
+    const float* row = x + i * k;
+    float* dst = y + i * n;
+    if (bias) std::memcpy(dst, bias, n * sizeof(float));
+    else std::memset(dst, 0, n * sizeof(float));
+    for (size_t j = 0; j < k; ++j) {
+      const float v = row[j];
+      const float* wrow = w + j * n;
+      for (size_t o = 0; o < n; ++o) dst[o] += v * wrow[o];
     }
-    for (uint32_t o = 0; o < out; ++o) dst[o] = apply_act(op.activation, dst[o]);
   }
+}
+
+void layernorm_rows(const float* x, const float* scale, const float* bias,
+                    float* y, size_t rows, size_t d) {
+  for (size_t r = 0; r < rows; ++r) {
+    const float* src = x + r * d;
+    float* dst = y + r * d;
+    float mean = 0.0f;
+    for (size_t i = 0; i < d; ++i) mean += src[i];
+    mean /= d;
+    float var = 0.0f;
+    for (size_t i = 0; i < d; ++i) {
+      const float c = src[i] - mean;
+      var += c * c;
+    }
+    var /= d;
+    const float inv = 1.0f / std::sqrt(var + kLnEps);
+    for (size_t i = 0; i < d; ++i)
+      dst[i] = (src[i] - mean) * inv * scale[i] + bias[i];
+  }
+}
+
+void softmax_row(float* row, size_t n) {
+  float m = row[0];
+  for (size_t i = 1; i < n; ++i) m = row[i] > m ? row[i] : m;
+  float sum = 0.0f;
+  for (size_t i = 0; i < n; ++i) {
+    row[i] = std::exp(row[i] - m);
+    sum += row[i];
+  }
+  const float inv = 1.0f / sum;
+  for (size_t i = 0; i < n; ++i) row[i] *= inv;
+}
+
+// ---------------------------------------------------------------------------
+// load: parse ops and infer every buffer's static shape so compute is
+// allocation-plan-free.
+
+bool infer_shapes(Model* m) {
+  auto& s = m->shapes;
+  s[0] = {2, m->num_features, 0};
+  for (const Op& op : m->ops) {
+    const Shape in = op.src != kNoBuf ? s[op.src] : Shape{};
+    Shape out{};
+    switch (op.code) {
+      case kDense:
+        if (in.rank != 2 || in.d1 != op.a) return false;
+        out = {2, op.b, 0};
+        break;
+      case kGatherCols:
+        if (in.rank != 2) return false;
+        for (uint32_t p : op.idx)
+          if (p >= in.d1) return false;  // column index out of range
+        out = {2, static_cast<uint32_t>(op.idx.size()), 0};
+        break;
+      case kEmbedLookup:
+        if (in.rank != 2 || op.idx.size() != size_t(op.a) * 2) return false;
+        for (uint32_t fidx = 0; fidx < op.a; ++fidx) {
+          if (op.idx[fidx] >= in.d1) return false;         // position range
+          const uint32_t vocab = op.idx[op.a + fidx];
+          if (vocab < 1 || vocab > op.b) return false;     // 1 <= vocab <= maxv
+        }
+        out = {3, op.a, op.c};  // (fields, dim)
+        break;
+      case kNumericEmbed:
+        if (in.rank != 2 || in.d1 != op.a) return false;
+        out = {3, op.a, op.b};
+        break;
+      case kConcat: {
+        if (op.idx.empty()) return false;
+        const Shape first = s[op.idx[0]];
+        uint32_t total = 0;
+        for (uint32_t b : op.idx) {
+          if (s[b].rank != first.rank || s[b].d2 != first.d2) return false;
+          total += s[b].d1;
+        }
+        out = {first.rank, total, first.d2};
+        break;
+      }
+      case kFlatten:
+        if (in.rank != 3) return false;
+        out = {2, in.d1 * in.d2, 0};
+        break;
+      case kSumFields:
+        if (in.rank != 3) return false;
+        out = {2, in.d2, 0};
+        break;
+      case kAdd: {
+        if (op.idx.empty()) return false;
+        uint32_t d1 = 0;
+        for (uint32_t b : op.idx) {
+          if (s[b].rank != 2) return false;
+          d1 = s[b].d1 > d1 ? s[b].d1 : d1;
+        }
+        for (uint32_t b : op.idx)
+          if (s[b].d1 != d1 && s[b].d1 != 1) return false;  // (B,1) broadcast
+        out = {2, d1, 0};
+        break;
+      }
+      case kFmPair:
+        if (in.rank != 3) return false;
+        out = {2, 1, 0};
+        break;
+      case kActivation:
+        out = in;
+        break;
+      case kClsPrepend:
+        if (in.rank != 3 || in.d2 != op.a) return false;
+        out = {3, in.d1 + 1, in.d2};
+        break;
+      case kLayerNorm:
+        if (in.per_row() == 0 ||
+            (in.rank == 2 ? in.d1 : in.d2) != op.a) return false;
+        out = in;
+        break;
+      case kSelectToken:
+        if (in.rank != 3 || op.a >= in.d1) return false;
+        out = {2, in.d2, 0};
+        break;
+      case kTransformerBlock:
+        if (in.rank != 3 || in.d2 != op.a) return false;
+        if (op.b < 1 || op.a % op.b != 0) return false;  // heads must divide d
+        out = in;
+        break;
+      default:
+        return false;
+    }
+    s[op.dst] = out;
+  }
+  return true;
+}
+
+bool read_op(FILE* f, Op* op) {
+  if (!(read_u32(f, &op->code) && read_u32(f, &op->dst) &&
+        read_u32(f, &op->src)))
+    return false;
+  switch (op->code) {
+    case kDense:
+      return read_u32(f, &op->act) && read_u32(f, &op->a) &&
+             read_u32(f, &op->b) &&
+             read_f32s(f, &op->w0, size_t(op->a) * op->b) &&
+             read_f32s(f, &op->w1, op->b);
+    case kGatherCols: {
+      uint32_t n = 0;
+      return read_u32(f, &n) && read_u32s(f, &op->idx, n);
+    }
+    case kEmbedLookup: {
+      // a=fields, b=max_vocab, c=dim; idx = positions ++ vocabs
+      if (!(read_u32(f, &op->a) && read_u32(f, &op->b) && read_u32(f, &op->c)))
+        return false;
+      return read_u32s(f, &op->idx, size_t(op->a) * 2) &&
+             read_f32s(f, &op->w0, size_t(op->a) * op->b * op->c);
+    }
+    case kNumericEmbed:
+      // a=fields, b=dim
+      return read_u32(f, &op->a) && read_u32(f, &op->b) &&
+             read_f32s(f, &op->w0, size_t(op->a) * op->b) &&
+             read_f32s(f, &op->w1, size_t(op->a) * op->b);
+    case kConcat:
+    case kAdd: {
+      uint32_t n = 0;
+      return read_u32(f, &n) && read_u32s(f, &op->idx, n);
+    }
+    case kFlatten:
+    case kSumFields:
+    case kFmPair:
+      return true;
+    case kActivation:
+      return read_u32(f, &op->act);
+    case kClsPrepend:
+      // a=dim
+      return read_u32(f, &op->a) && read_f32s(f, &op->w0, op->a);
+    case kLayerNorm:
+      // a=dim
+      return read_u32(f, &op->a) && read_f32s(f, &op->w0, op->a) &&
+             read_f32s(f, &op->w1, op->a);
+    case kSelectToken:
+      return read_u32(f, &op->a);
+    case kTransformerBlock: {
+      // a=d, b=heads, c=mlp_hidden
+      if (!(read_u32(f, &op->a) && read_u32(f, &op->b) && read_u32(f, &op->c)))
+        return false;
+      const size_t d = op->a, mh = op->c;
+      const size_t sizes[12] = {d,         d,      d * 3 * d, 3 * d,
+                                d * d,     d,      d,         d,
+                                d * mh,    mh,     mh * d,    d};
+      for (int i = 0; i < 12; ++i)
+        if (!read_f32s(f, &op->tw[i], sizes[i])) return false;
+      return true;
+    }
+    default:
+      return false;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// execution
+
+void exec_transformer_block(const Op& op, const float* x, float* out,
+                            size_t batch, size_t s) {
+  const size_t d = op.a, heads = op.b, mh = op.c, dh = d / heads;
+  const float scale = 1.0f / std::sqrt(static_cast<float>(dh));
+  const size_t rows = batch * s;
+  std::vector<float> y(rows * d), qkv(rows * 3 * d), attn(rows * d);
+  std::vector<float> scores(s * s), mlp(rows * mh);
+
+  // pre-LN attention
+  layernorm_rows(x, op.tw[0].data(), op.tw[1].data(), y.data(), rows, d);
+  matmul_bias(y.data(), op.tw[2].data(), op.tw[3].data(), qkv.data(), rows, d,
+              3 * d);
+  // per (batch, head): scores = q k^T * scale; softmax; ctx = scores @ v
+  for (size_t bi = 0; bi < batch; ++bi) {
+    const float* q0 = qkv.data() + bi * s * 3 * d;
+    for (size_t h = 0; h < heads; ++h) {
+      const size_t qo = h * dh, ko = d + h * dh, vo = 2 * d + h * dh;
+      for (size_t i = 0; i < s; ++i) {
+        const float* qi = q0 + i * 3 * d + qo;
+        float* srow = scores.data() + i * s;
+        for (size_t j = 0; j < s; ++j) {
+          const float* kj = q0 + j * 3 * d + ko;
+          float acc = 0.0f;
+          for (size_t t = 0; t < dh; ++t) acc += qi[t] * kj[t];
+          srow[j] = acc * scale;
+        }
+        softmax_row(srow, s);
+        float* ctx = attn.data() + (bi * s + i) * d + h * dh;
+        std::memset(ctx, 0, dh * sizeof(float));
+        for (size_t j = 0; j < s; ++j) {
+          const float wij = srow[j];
+          const float* vj = q0 + j * 3 * d + vo;
+          for (size_t t = 0; t < dh; ++t) ctx[t] += wij * vj[t];
+        }
+      }
+    }
+  }
+  // proj + residual
+  matmul_bias(attn.data(), op.tw[4].data(), op.tw[5].data(), y.data(), rows, d,
+              d);
+  for (size_t i = 0; i < rows * d; ++i) out[i] = x[i] + y[i];
+
+  // pre-LN MLP + residual
+  layernorm_rows(out, op.tw[6].data(), op.tw[7].data(), y.data(), rows, d);
+  matmul_bias(y.data(), op.tw[8].data(), op.tw[9].data(), mlp.data(), rows, d,
+              mh);
+  for (size_t i = 0; i < rows * mh; ++i) mlp[i] = apply_act(kGelu, mlp[i]);
+  matmul_bias(mlp.data(), op.tw[10].data(), op.tw[11].data(), y.data(), rows,
+              mh, d);
+  for (size_t i = 0; i < rows * d; ++i) out[i] += y[i];
+}
+
+int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
+  std::vector<std::vector<float>> bufs(m.shapes.size());
+  bufs[0].assign(rows, rows + batch * m.num_features);
+  uint32_t last = 0;
+  for (const Op& op : m.ops) {
+    const Shape& os = m.shapes[op.dst];
+    std::vector<float>& dst = bufs[op.dst];
+    dst.resize(batch * os.per_row());
+    const float* src =
+        op.src != kNoBuf ? bufs[op.src].data() : nullptr;
+    const Shape in = op.src != kNoBuf ? m.shapes[op.src] : Shape{};
+    switch (op.code) {
+      case kDense:
+        matmul_bias(src, op.w0.data(), op.w1.data(), dst.data(), batch, op.a,
+                    op.b);
+        if (op.act != kLinear)
+          for (float& v : dst) v = apply_act(op.act, v);
+        break;
+      case kGatherCols:
+        for (size_t b = 0; b < batch; ++b)
+          for (size_t i = 0; i < op.idx.size(); ++i)
+            dst[b * os.d1 + i] = src[b * in.d1 + op.idx[i]];
+        break;
+      case kEmbedLookup: {
+        const uint32_t nf = op.a, maxv = op.b, dim = op.c;
+        const uint32_t* pos = op.idx.data();
+        const uint32_t* vocab = op.idx.data() + nf;
+        for (size_t b = 0; b < batch; ++b) {
+          for (uint32_t fidx = 0; fidx < nf; ++fidx) {
+            int32_t id = static_cast<int32_t>(src[b * in.d1 + pos[fidx]]);
+            if (id < 0) id = 0;
+            const int32_t hi = static_cast<int32_t>(vocab[fidx]) - 1;
+            if (id > hi) id = hi;
+            const float* trow =
+                op.w0.data() + (size_t(fidx) * maxv + id) * dim;
+            std::memcpy(dst.data() + (b * nf + fidx) * dim, trow,
+                        dim * sizeof(float));
+          }
+        }
+        break;
+      }
+      case kNumericEmbed: {
+        const uint32_t nf = op.a, dim = op.b;
+        for (size_t b = 0; b < batch; ++b)
+          for (uint32_t fidx = 0; fidx < nf; ++fidx) {
+            const float v = src[b * in.d1 + fidx];
+            float* drow = dst.data() + (b * nf + fidx) * dim;
+            const float* wrow = op.w0.data() + size_t(fidx) * dim;
+            const float* brow = op.w1.data() + size_t(fidx) * dim;
+            for (uint32_t t = 0; t < dim; ++t)
+              drow[t] = v * wrow[t] + brow[t];
+          }
+        break;
+      }
+      case kConcat: {
+        const size_t stride = os.per_row();
+        for (size_t b = 0; b < batch; ++b) {
+          size_t off = 0;
+          for (uint32_t sb : op.idx) {
+            const size_t n = m.shapes[sb].per_row();
+            std::memcpy(dst.data() + b * stride + off,
+                        bufs[sb].data() + b * n, n * sizeof(float));
+            off += n;
+          }
+        }
+        break;
+      }
+      case kFlatten:
+      case kActivation:
+        if (op.code == kFlatten) {
+          std::memcpy(dst.data(), src, dst.size() * sizeof(float));
+        } else {
+          const size_t n = dst.size();
+          for (size_t i = 0; i < n; ++i) dst[i] = apply_act(op.act, src[i]);
+        }
+        break;
+      case kSumFields:
+        for (size_t b = 0; b < batch; ++b) {
+          float* drow = dst.data() + b * in.d2;
+          std::memset(drow, 0, in.d2 * sizeof(float));
+          for (uint32_t fidx = 0; fidx < in.d1; ++fidx) {
+            const float* srow = src + (b * in.d1 + fidx) * in.d2;
+            for (uint32_t t = 0; t < in.d2; ++t) drow[t] += srow[t];
+          }
+        }
+        break;
+      case kAdd: {
+        const size_t d1 = os.d1;
+        std::memset(dst.data(), 0, dst.size() * sizeof(float));
+        for (uint32_t sb : op.idx) {
+          const Shape& ss = m.shapes[sb];
+          const float* p = bufs[sb].data();
+          for (size_t b = 0; b < batch; ++b)
+            for (size_t i = 0; i < d1; ++i)
+              dst[b * d1 + i] += p[b * ss.d1 + (ss.d1 == 1 ? 0 : i)];
+        }
+        break;
+      }
+      case kFmPair:
+        for (size_t b = 0; b < batch; ++b) {
+          float acc = 0.0f;
+          for (uint32_t t = 0; t < in.d2; ++t) {
+            float sum = 0.0f, sq = 0.0f;
+            for (uint32_t fidx = 0; fidx < in.d1; ++fidx) {
+              const float v = src[(b * in.d1 + fidx) * in.d2 + t];
+              sum += v;
+              sq += v * v;
+            }
+            acc += sum * sum - sq;
+          }
+          dst[b] = 0.5f * acc;
+        }
+        break;
+      case kClsPrepend:
+        for (size_t b = 0; b < batch; ++b) {
+          float* drow = dst.data() + b * os.d1 * os.d2;
+          std::memcpy(drow, op.w0.data(), os.d2 * sizeof(float));
+          std::memcpy(drow + os.d2, src + b * in.d1 * in.d2,
+                      size_t(in.d1) * in.d2 * sizeof(float));
+        }
+        break;
+      case kLayerNorm: {
+        const size_t d = op.a;
+        layernorm_rows(src, op.w0.data(), op.w1.data(), dst.data(),
+                       batch * in.per_row() / d, d);
+        break;
+      }
+      case kSelectToken:
+        for (size_t b = 0; b < batch; ++b)
+          std::memcpy(dst.data() + b * in.d2,
+                      src + (b * in.d1 + op.a) * in.d2,
+                      in.d2 * sizeof(float));
+        break;
+      case kTransformerBlock:
+        exec_transformer_block(op, src, dst.data(), batch, in.d1);
+        break;
+      default:
+        return 2;
+    }
+    last = op.dst;
+  }
+  const Shape& fs = m.shapes[last];
+  if (fs.rank != 2 || fs.d1 != m.num_heads) return 3;
+  std::memcpy(out, bufs[last].data(),
+              batch * m.num_heads * sizeof(float));
+  return 0;
 }
 
 }  // namespace
@@ -102,30 +542,27 @@ void* shifu_scorer_load(const char* path) {
   FILE* f = std::fopen(path, "rb");
   if (!f) return nullptr;
   auto model = new Model();
-  uint32_t magic = 0, version = 0, num_ops = 0;
+  uint32_t magic = 0, version = 0, num_bufs = 0, num_ops = 0;
   bool ok = read_u32(f, &magic) && magic == kMagic &&
-            read_u32(f, &version) && version == 1 &&
+            read_u32(f, &version) && version == kVersion &&
             read_u32(f, &model->num_features) &&
-            read_u32(f, &model->num_heads) && read_u32(f, &num_ops);
+            read_u32(f, &model->num_heads) && read_u32(f, &num_bufs) &&
+            read_u32(f, &num_ops) && num_bufs >= 1;
   if (ok) {
-    model->max_width = model->num_features;
     model->ops.resize(num_ops);
+    model->shapes.resize(num_bufs);
     for (uint32_t i = 0; ok && i < num_ops; ++i) {
-      DenseOp& op = model->ops[i];
-      ok = read_u32(f, &op.activation) && read_u32(f, &op.in_dim) &&
-           read_u32(f, &op.out_dim);
-      if (!ok) break;
-      op.kernel.resize(static_cast<size_t>(op.in_dim) * op.out_dim);
-      op.bias.resize(op.out_dim);
-      ok = std::fread(op.kernel.data(), sizeof(float), op.kernel.size(), f) ==
-               op.kernel.size() &&
-           std::fread(op.bias.data(), sizeof(float), op.bias.size(), f) ==
-               op.bias.size();
-      if (op.out_dim > model->max_width) model->max_width = op.out_dim;
-      if (op.in_dim > model->max_width) model->max_width = op.in_dim;
+      ok = read_op(f, &model->ops[i]) && model->ops[i].dst < num_bufs &&
+           (model->ops[i].src == kNoBuf || model->ops[i].src < num_bufs);
+      if (ok)
+        for (uint32_t sb : model->ops[i].idx)
+          if ((model->ops[i].code == kConcat || model->ops[i].code == kAdd) &&
+              sb >= num_bufs)
+            ok = false;
     }
   }
   std::fclose(f);
+  if (ok) ok = infer_shapes(model);
   if (!ok) {
     delete model;
     return nullptr;
@@ -148,26 +585,7 @@ int shifu_scorer_compute_batch(void* handle, const float* rows, int n,
                                float* out) {
   if (!handle || !rows || !out || n <= 0) return 1;
   const Model& m = *static_cast<Model*>(handle);
-  const size_t width = m.max_width;
-  std::vector<float> buf_a(static_cast<size_t>(n) * width);
-  std::vector<float> buf_b(static_cast<size_t>(n) * width);
-  // pack input into buf_a (contiguous at num_features stride)
-  std::memcpy(buf_a.data(), rows,
-              static_cast<size_t>(n) * m.num_features * sizeof(float));
-  const float* cur = buf_a.data();
-  float* nxt = buf_b.data();
-  uint32_t cur_dim = m.num_features;
-  for (const DenseOp& op : m.ops) {
-    if (op.in_dim != cur_dim) return 2;  // corrupt program
-    dense_forward(op, cur, nxt, n);
-    cur_dim = op.out_dim;
-    const float* tmp = cur;
-    cur = nxt;
-    nxt = const_cast<float*>(tmp);
-  }
-  if (cur_dim != m.num_heads) return 3;
-  std::memcpy(out, cur, static_cast<size_t>(n) * m.num_heads * sizeof(float));
-  return 0;
+  return exec_program(m, rows, static_cast<size_t>(n), out);
 }
 
 // Single-row double API, mirroring TensorflowModel.compute's double[] in /

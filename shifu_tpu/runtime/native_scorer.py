@@ -20,43 +20,129 @@ from typing import Optional, Sequence
 import numpy as np
 
 _ACT_IDS = {"linear": 0, None: 0, "": 0, "sigmoid": 1, "tanh": 2,
-            "relu": 3, "leakyrelu": 4}
+            "relu": 3, "leakyrelu": 4, "gelu": 5}
+
+_OP_CODES = {"dense": 0, "gather_cols": 1, "embed_lookup": 2,
+             "numeric_embed": 3, "concat": 4, "flatten": 5, "sum_fields": 6,
+             "add": 7, "fm_pair": 8, "activation": 9, "cls_prepend": 10,
+             "layernorm": 11, "select_token": 12, "transformer_block": 13}
 
 _MAGIC = 0x55464853  # "SHFU"
+_NO_BUF = 0xFFFFFFFF
 MODEL_BIN = "model.bin"
+
+# single source of truth for the 12-array serialization order; the C++
+# reader's sizes[12] table (shifu_scorer.cc read_op kTransformerBlock)
+# consumes them in this exact order
+from ..export.program import WEIGHT_FIELDS as _WEIGHT_FIELDS
+
+_TBLOCK_WEIGHTS = _WEIGHT_FIELDS["transformer_block"]
+
+
+def _act_id(name) -> int:
+    act = _ACT_IDS.get(name)
+    if act is None:
+        raise ValueError(f"unknown activation {name!r}")
+    return act
 
 
 def pack_native(export_dir: str) -> str:
-    """Pack topology.json + weights.npz into model.bin; returns its path."""
+    """Pack topology.json + weights.npz into model.bin (format v2, the binary
+    mirror of export/program.py's op list); returns its path."""
     with open(os.path.join(export_dir, "topology.json")) as f:
         topo = json.load(f)
-    if not topo.get("program"):
+    program = topo.get("program")
+    if not program:
         raise ValueError(
             f"artifact has no op-list program (model_type="
-            f"{topo.get('model_type')!r}); the native engine currently lowers "
-            "dense-chain models only — use the JAX-fallback scorer")
+            f"{topo.get('model_type')!r}); use the JAX-fallback scorer")
     with np.load(os.path.join(export_dir, "weights.npz")) as z:
         weights = {k: np.asarray(z[k], dtype=np.float32) for k in z.files}
 
-    out_path = os.path.join(export_dir, MODEL_BIN)
-    with open(out_path, "wb") as f:
-        program = topo["program"]
-        f.write(struct.pack("<5I", _MAGIC, 1, int(topo["num_features"]),
-                            int(topo["num_heads"]), len(program)))
-        for op in program:
-            if op["op"] != "dense":
-                raise ValueError(f"native pack: unsupported op {op['op']!r}")
-            kernel = weights[op["kernel"]]
-            bias = weights[op["bias"]]
+    # assign buffer ids; "input" is 0
+    buf_ids: dict[str, int] = {"input": 0}
+
+    def bid(name: str) -> int:
+        if name not in buf_ids:
+            buf_ids[name] = len(buf_ids)
+        return buf_ids[name]
+
+    records: list[bytes] = []
+    for op in program:
+        kind = op["op"]
+        code = _OP_CODES.get(kind)
+        if code is None:
+            raise ValueError(f"native pack: unsupported op {kind!r}")
+        # v1 artifacts: dense chain without src/out — thread implicitly
+        src = bid(op["src"]) if "src" in op else (prev_dst if records else 0)
+        dst = bid(op["out"]) if "out" in op else bid(f"__chain{len(records)}")
+        parts = [struct.pack("<3I", code, dst,
+                             _NO_BUF if kind in ("concat", "add") else src)]
+        if kind == "dense":
+            kernel, bias = weights[op["kernel"]], weights[op["bias"]]
             if kernel.ndim != 2 or bias.shape != (kernel.shape[1],):
                 raise ValueError(f"bad shapes for {op['kernel']}: "
                                  f"{kernel.shape} / {bias.shape}")
-            act = _ACT_IDS.get(op.get("activation"), None)
-            if act is None:
-                raise ValueError(f"unknown activation {op.get('activation')!r}")
-            f.write(struct.pack("<3I", act, kernel.shape[0], kernel.shape[1]))
-            f.write(np.ascontiguousarray(kernel).tobytes())
-            f.write(np.ascontiguousarray(bias).tobytes())
+            parts.append(struct.pack("<3I", _act_id(op.get("activation")),
+                                     kernel.shape[0], kernel.shape[1]))
+            parts.append(np.ascontiguousarray(kernel).tobytes())
+            parts.append(np.ascontiguousarray(bias).tobytes())
+        elif kind == "gather_cols":
+            pos = np.asarray(op["positions"], np.uint32)
+            parts.append(struct.pack("<I", len(pos)))
+            parts.append(pos.tobytes())
+        elif kind == "embed_lookup":
+            table = weights[op["table"]]  # (nf, max_vocab, dim)
+            nf, maxv, dim = table.shape
+            pos = np.asarray(op["positions"], np.uint32)
+            vocab = np.asarray(op["vocabs"], np.uint32)
+            if len(pos) != nf or len(vocab) != nf:
+                raise ValueError(f"embed_lookup field mismatch: table {nf} "
+                                 f"vs positions {len(pos)}/vocabs {len(vocab)}")
+            parts.append(struct.pack("<3I", nf, maxv, dim))
+            parts.append(pos.tobytes())
+            parts.append(vocab.tobytes())
+            parts.append(np.ascontiguousarray(table).tobytes())
+        elif kind == "numeric_embed":
+            w, b = weights[op["weight"]], weights[op["bias"]]
+            parts.append(struct.pack("<2I", w.shape[0], w.shape[1]))
+            parts.append(np.ascontiguousarray(w).tobytes())
+            parts.append(np.ascontiguousarray(b).tobytes())
+        elif kind in ("concat", "add"):
+            srcs = np.asarray([bid(s) for s in op["srcs"]], np.uint32)
+            parts.append(struct.pack("<I", len(srcs)))
+            parts.append(srcs.tobytes())
+        elif kind in ("flatten", "sum_fields", "fm_pair"):
+            pass
+        elif kind == "activation":
+            parts.append(struct.pack("<I", _act_id(op.get("fn"))))
+        elif kind == "cls_prepend":
+            token = weights[op["token"]].reshape(-1)
+            parts.append(struct.pack("<I", token.shape[0]))
+            parts.append(np.ascontiguousarray(token).tobytes())
+        elif kind == "layernorm":
+            scale, bias = weights[op["scale"]], weights[op["bias"]]
+            parts.append(struct.pack("<I", scale.shape[0]))
+            parts.append(np.ascontiguousarray(scale).tobytes())
+            parts.append(np.ascontiguousarray(bias).tobytes())
+        elif kind == "select_token":
+            parts.append(struct.pack("<I", int(op["index"])))
+        elif kind == "transformer_block":
+            d = weights[op["ln_attn_scale"]].shape[0]
+            mh = weights[op["mlp_in_kernel"]].shape[1]
+            parts.append(struct.pack("<3I", d, int(op["num_heads"]), mh))
+            for field in _TBLOCK_WEIGHTS:
+                parts.append(
+                    np.ascontiguousarray(weights[op[field]]).tobytes())
+        records.append(b"".join(parts))
+        prev_dst = dst
+
+    out_path = os.path.join(export_dir, MODEL_BIN)
+    with open(out_path, "wb") as f:
+        f.write(struct.pack("<6I", _MAGIC, 2, int(topo["num_features"]),
+                            int(topo["num_heads"]), len(buf_ids),
+                            len(records)))
+        f.write(b"".join(records))
     return out_path
 
 

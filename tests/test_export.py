@@ -130,10 +130,16 @@ def test_jax_fallback_scorer_roundtrip(tmp_path, model_type):
     out = str(tmp_path / "m")
     save_artifact(state.params, job, out, forward_fn=forward)
 
+    from shifu_tpu.export.scorer import Scorer
     scorer = load_scorer(out)
-    assert isinstance(scorer, JaxScorer)
+    assert isinstance(scorer, Scorer), \
+        "ladder models lower to the v2 op-list program"
     rows = synthetic.make_rows(32, schema, seed=4)[:, 1:9]
     want = np.asarray(jax.device_get(forward(state.params, rows.astype(np.float32))))
     got = scorer.compute_batch(rows)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
     assert 0.0 <= scorer.compute(rows[0]) <= 1.0
+    # the JAX fallback engine stays available and agrees with the op-list
+    jx = JaxScorer(out)
+    np.testing.assert_allclose(jx.compute_batch(rows), got,
+                               rtol=1e-5, atol=1e-6)

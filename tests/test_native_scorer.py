@@ -88,11 +88,75 @@ def test_native_single_row_double_contract(artifact_dir):
     nat.close()
 
 
+@pytest.mark.parametrize("model_type", ["wide_deep", "deepfm", "multitask",
+                                        "ft_transformer"])
+def test_native_full_ladder(tmp_path, model_type):
+    """Every ladder model lowers to the v2 op-list and scores natively at
+    float32-roundoff parity with both the numpy interpreter and the Flax
+    forward — the capability the reference bought with the entire TF C++
+    runtime (SavedModelBundle over JNI, TensorflowModel.java:169)."""
+    from shifu_tpu.config import JobConfig, ModelSpec
+    from shifu_tpu.data import reader, synthetic
+    from shifu_tpu.export.scorer import Scorer
+    from shifu_tpu.runtime import NativeScorer
+
+    schema = synthetic.make_schema(num_features=9, num_categorical=3,
+                                   vocab_size=11)
+    kwargs = dict(hidden_nodes=(8, 6), activations=("relu", "tanh"),
+                  embedding_dim=4, compute_dtype="float32")
+    if model_type == "multitask":
+        kwargs.update(num_heads=2, head_names=("fraud", "chargeback"))
+    if model_type == "ft_transformer":
+        kwargs.update(hidden_nodes=(8,), activations=("relu",), token_dim=8,
+                      num_attention_heads=2, num_layers=2)
+    job = JobConfig(schema=schema,
+                    model=ModelSpec(model_type=model_type, **kwargs)).validate()
+    state = init_state(job, schema.feature_count)
+    forward = make_forward_fn(job, state.apply_fn)
+    out = str(tmp_path / "model")
+    save_artifact(state.params, job, out, forward_fn=forward)
+
+    rows = synthetic.make_rows(64, schema, seed=7)
+    feats = np.asarray(reader.project_columns(rows, schema)["features"],
+                       np.float32)
+    want = np.asarray(jax.device_get(forward(state.params, feats)))
+
+    py = load_scorer(out)
+    assert isinstance(py, Scorer), "ladder model should get an op-list program"
+    nat = NativeScorer(out)
+    got_py = py.compute_batch(feats)
+    got_c = nat.compute_batch(feats)
+    np.testing.assert_allclose(got_py, want, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got_c, got_py, rtol=1e-5, atol=1e-6)
+    score = nat.compute(feats[0].astype(np.float64))
+    assert 0.0 <= score <= 1.0
+    nat.close()
+
+
 def test_native_corrupt_file(tmp_path):
     from shifu_tpu.runtime.native_scorer import build_library
     import ctypes
     bad = tmp_path / "model.bin"
     bad.write_bytes(b"NOTAMODEL")
+    lib = ctypes.CDLL(build_library())
+    lib.shifu_scorer_load.restype = ctypes.c_void_p
+    lib.shifu_scorer_load.argtypes = [ctypes.c_char_p]
+    assert lib.shifu_scorer_load(str(bad).encode()) is None
+
+
+def test_native_rejects_out_of_range_indices(tmp_path):
+    """The loader (not compute) must reject programs whose gather positions
+    point past the input width — model.bin is the trust boundary for JVM
+    callers."""
+    import ctypes
+    import struct
+    from shifu_tpu.runtime.native_scorer import build_library
+    bad = tmp_path / "model.bin"
+    # header: magic, v2, num_features=4, num_heads=1, num_buffers=2, num_ops=1
+    blob = struct.pack("<6I", 0x55464853, 2, 4, 1, 2, 1)
+    # gather_cols(code=1) dst=1 src=0, npos=1, positions=[99] (>= 4)
+    blob += struct.pack("<3I", 1, 1, 0) + struct.pack("<2I", 1, 99)
+    bad.write_bytes(blob)
     lib = ctypes.CDLL(build_library())
     lib.shifu_scorer_load.restype = ctypes.c_void_p
     lib.shifu_scorer_load.argtypes = [ctypes.c_char_p]
