@@ -1,0 +1,179 @@
+/*
+ * JVM binding for the shifu_tpu native scoring engine.
+ *
+ * Drop-in successor of the reference eval module's TensorflowModel
+ * (shifu-tensorflow-eval/src/main/java/ml/shifu/shifu/tensorflow/
+ * TensorflowModel.java): `init` loads the exported artifact (cf. :112-172),
+ * `compute` scores one row of doubles to a double in [0,1] (cf. :52-109).
+ * Where the reference bound the 200MB libtensorflow_jni 1.4 runtime
+ * (pom.xml:59-73), this binds the dependency-free libshifu_scorer.so
+ * (runtime/csrc/shifu_scorer.cc, C ABI) through java.lang.foreign (JDK 22+,
+ * no JNI glue, no native compilation step on the Java side), and adds the
+ * batch API the reference lacked.
+ *
+ * Build:  javac ml/shifu/shifu/tpu/ShifuTpuModel.java       (JDK 22+)
+ * Run:    java -Djava.library.path=<dir of libshifu_scorer.so> ...
+ *         (or pass the full .so path to the constructor)
+ *
+ * The artifact directory must contain model.bin, produced at export time by
+ * shifu_tpu.runtime.pack_native (the launcher CLI does this automatically
+ * after training).
+ */
+package ml.shifu.shifu.tpu;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.Linker;
+import java.lang.foreign.MemorySegment;
+import java.lang.foreign.SymbolLookup;
+import java.lang.foreign.ValueLayout;
+import java.lang.invoke.MethodHandle;
+import java.nio.file.Path;
+
+/** Scores rows against an exported shifu_tpu artifact on CPU, no ML runtime. */
+public final class ShifuTpuModel implements AutoCloseable {
+
+    private final Arena arena;
+    private final MemorySegment handle;
+    private final MethodHandle hCompute;
+    private final MethodHandle hComputeBatch;
+    private final MethodHandle hFree;
+    private final int numFeatures;
+    private final int numHeads;
+    private boolean closed = false;
+
+    /**
+     * @param libraryPath path to libshifu_scorer.so
+     * @param artifactDir exported artifact directory (contains model.bin)
+     */
+    public ShifuTpuModel(Path libraryPath, Path artifactDir) {
+        this.arena = Arena.ofShared();
+        Linker linker = Linker.nativeLinker();
+        SymbolLookup lib = SymbolLookup.libraryLookup(libraryPath, arena);
+
+        MethodHandle hLoad = linker.downcallHandle(
+                lib.find("shifu_scorer_load").orElseThrow(),
+                FunctionDescriptor.of(ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+        MethodHandle hNumFeatures = linker.downcallHandle(
+                lib.find("shifu_scorer_num_features").orElseThrow(),
+                FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS));
+        MethodHandle hNumHeads = linker.downcallHandle(
+                lib.find("shifu_scorer_num_heads").orElseThrow(),
+                FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS));
+        this.hCompute = linker.downcallHandle(
+                lib.find("shifu_scorer_compute").orElseThrow(),
+                FunctionDescriptor.of(ValueLayout.JAVA_DOUBLE,
+                        ValueLayout.ADDRESS, ValueLayout.ADDRESS));
+        this.hComputeBatch = linker.downcallHandle(
+                lib.find("shifu_scorer_compute_batch").orElseThrow(),
+                FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS,
+                        ValueLayout.ADDRESS, ValueLayout.JAVA_INT,
+                        ValueLayout.ADDRESS));
+        this.hFree = linker.downcallHandle(
+                lib.find("shifu_scorer_free").orElseThrow(),
+                FunctionDescriptor.ofVoid(ValueLayout.ADDRESS));
+
+        try {
+            MemorySegment path = arena.allocateFrom(
+                    artifactDir.resolve("model.bin").toString());
+            this.handle = (MemorySegment) hLoad.invokeExact(path);
+            if (this.handle.equals(MemorySegment.NULL)) {
+                throw new IllegalStateException(
+                        "failed to load model.bin from " + artifactDir);
+            }
+            this.numFeatures = (int) hNumFeatures.invokeExact(handle);
+            this.numHeads = (int) hNumHeads.invokeExact(handle);
+        } catch (RuntimeException e) {
+            throw e;
+        } catch (Throwable t) {
+            throw new IllegalStateException("native call failed", t);
+        }
+    }
+
+    public int getNumFeatures() {
+        return numFeatures;
+    }
+
+    public int getNumHeads() {
+        return numHeads;
+    }
+
+    /**
+     * Scores one row — the reference's exact call shape: double[] features in,
+     * single double score in [0,1] out (TensorflowModel.compute, :52-109).
+     */
+    public double compute(double[] row) {
+        checkOpen();
+        if (row.length != numFeatures) {
+            throw new IllegalArgumentException(
+                    "expected " + numFeatures + " features, got " + row.length);
+        }
+        try (Arena call = Arena.ofConfined()) {
+            MemorySegment seg = call.allocateFrom(ValueLayout.JAVA_DOUBLE, row);
+            double score = (double) hCompute.invokeExact(handle, seg);
+            if (score < 0.0) {
+                throw new IllegalStateException("native scorer error");
+            }
+            return score;
+        } catch (RuntimeException e) {
+            throw e;
+        } catch (Throwable t) {
+            throw new IllegalStateException("native call failed", t);
+        }
+    }
+
+    /** Batch scoring ([n][numFeatures] -> [n][numHeads]); new capability over
+     *  the reference's row-at-a-time-only API. */
+    public float[][] computeBatch(float[][] rows) {
+        checkOpen();
+        int n = rows.length;
+        try (Arena call = Arena.ofConfined()) {
+            MemorySegment in = call.allocate(
+                    ValueLayout.JAVA_FLOAT, (long) n * numFeatures);
+            for (int i = 0; i < n; i++) {
+                if (rows[i].length != numFeatures) {
+                    throw new IllegalArgumentException(
+                            "row " + i + ": expected " + numFeatures
+                                    + " features, got " + rows[i].length);
+                }
+                MemorySegment.copy(rows[i], 0, in, ValueLayout.JAVA_FLOAT,
+                        (long) i * numFeatures * Float.BYTES, numFeatures);
+            }
+            MemorySegment out = call.allocate(
+                    ValueLayout.JAVA_FLOAT, (long) n * numHeads);
+            int rc = (int) hComputeBatch.invokeExact(handle, in, n, out);
+            if (rc != 0) {
+                throw new IllegalStateException("native scorer error code " + rc);
+            }
+            float[][] scores = new float[n][numHeads];
+            for (int i = 0; i < n; i++) {
+                MemorySegment.copy(out, ValueLayout.JAVA_FLOAT,
+                        (long) i * numHeads * Float.BYTES, scores[i], 0, numHeads);
+            }
+            return scores;
+        } catch (RuntimeException e) {
+            throw e;
+        } catch (Throwable t) {
+            throw new IllegalStateException("native call failed", t);
+        }
+    }
+
+    @Override
+    public void close() {
+        if (!closed) {
+            closed = true;
+            try {
+                hFree.invokeExact(handle);
+            } catch (Throwable t) {
+                // best effort; the arena still reclaims the lookup below
+            }
+            arena.close();
+        }
+    }
+
+    private void checkOpen() {
+        if (closed) {
+            throw new IllegalStateException("model is closed");
+        }
+    }
+}

@@ -38,6 +38,7 @@ SIDE_CAR = "GenericModelConfig.json"
 TOPOLOGY = "topology.json"
 WEIGHTS = "weights.npz"
 STABLEHLO = "scoring.mlir"
+JAX_EXPORT = "scoring.jaxexport"
 
 
 def _key_name(entry: Any) -> str:
@@ -69,17 +70,35 @@ def build_program(spec: ModelSpec, schema=None) -> Optional[list[dict[str, Any]]
 
 def export_stablehlo(forward_fn, params, num_features: int, path: str,
                      batch: int = 1) -> bool:
-    """Serialize the scoring fn to StableHLO text (input for the AOT/native
-    compile path).  Best-effort: returns False when jax.export is unavailable."""
+    """Serialize the scoring fn to StableHLO text plus the binary jax.export
+    artifact (`scoring.jaxexport`, executable by export/scorer.py
+    StableHloScorer without the model class).  The batch dimension is
+    exported symbolically so one artifact serves any row count.
+    Best-effort: returns False when jax.export is unavailable."""
     try:
         from jax import export as jax_export
         import jax.numpy as jnp
 
         fn = lambda feats: forward_fn(params, feats)
-        exported = jax_export.export(jax.jit(fn))(
-            jax.ShapeDtypeStruct((batch, num_features), jnp.float32))
+        exported = None
+        try:  # symbolic batch: score any (N, F) without re-export
+            (dim,) = jax_export.symbolic_shape("batch")
+            shape = jax.ShapeDtypeStruct((dim, num_features), jnp.float32)
+            exported = jax_export.export(jax.jit(fn))(shape)
+        except Exception:
+            pass  # fall back to a concrete batch below
+        if exported is None:
+            shape = jax.ShapeDtypeStruct((batch, num_features), jnp.float32)
+            exported = jax_export.export(jax.jit(fn))(shape)
         with open(path, "w") as f:
             f.write(exported.mlir_module())
+        try:
+            blob = exported.serialize()
+            with open(os.path.join(os.path.dirname(path), JAX_EXPORT),
+                      "wb") as f:
+                f.write(blob)
+        except Exception:
+            pass  # text form still written; StableHloScorer tier unavailable
         return True
     except Exception:
         return False

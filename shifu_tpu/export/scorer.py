@@ -232,6 +232,45 @@ class JaxScorer:
         return float(self.compute_batch(np.asarray(row, dtype=np.float64))[0, 0])
 
 
+class StableHloScorer:
+    """Scores through the serialized jax.export artifact (`scoring.jaxexport`)
+    — the compiled-graph tier.  Unlike JaxScorer it does NOT rebuild the Flax
+    model from source, so artifacts stay scoreable even if the model classes
+    drift; unlike the op-list engines it runs the exact traced computation
+    XLA saw at export time.  Succeeds the reference's SavedModel+TF-runtime
+    pairing (TensorflowModel.java:169) with a versioned StableHLO module.
+
+    Dtype semantics: this tier replays the model's trained compute_dtype —
+    for bfloat16-trained models its scores carry bf16 rounding (~1e-3) and
+    are the bit-faithful mirror of the training forward, while the op-list
+    tiers (numpy Scorer / native C++) evaluate the same weights in float32.
+    For float32-trained models all tiers agree to float32 roundoff."""
+
+    def __init__(self, export_dir: str):
+        from jax import export as jax_export
+
+        from .artifact import JAX_EXPORT
+
+        with open(os.path.join(export_dir, TOPOLOGY)) as f:
+            self.topology = json.load(f)
+        self.num_features = int(self.topology["num_features"])
+        path = os.path.join(export_dir, JAX_EXPORT)
+        with open(path, "rb") as f:
+            self._exported = jax_export.deserialize(bytearray(f.read()))
+
+    def compute_batch(self, rows: np.ndarray) -> np.ndarray:
+        x = np.asarray(rows, dtype=np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.shape[1] != self.num_features:
+            raise ValueError(
+                f"expected {self.num_features} features, got {x.shape[1]}")
+        return np.asarray(self._exported.call(x))
+
+    def compute(self, row: Sequence[float]) -> float:
+        return float(self.compute_batch(np.asarray(row, dtype=np.float64))[0, 0])
+
+
 def _unflatten(flat: dict[str, np.ndarray]) -> dict:
     out: dict = {}
     for key, value in flat.items():

@@ -94,12 +94,19 @@ bool read_u32(FILE* f, uint32_t* out) {
   return std::fread(out, sizeof(uint32_t), 1, f) == 1;
 }
 
-bool read_f32s(FILE* f, std::vector<float>* out, size_t n) {
+// Hard cap on any single array read from an untrusted model.bin (256M
+// elements = 1GB of floats) — rejects length fields that a corrupt or
+// malicious file inflated, before any allocation happens.
+constexpr uint64_t kMaxArrayElems = uint64_t(1) << 28;
+
+bool read_f32s(FILE* f, std::vector<float>* out, uint64_t n) {
+  if (n > kMaxArrayElems) return false;
   out->resize(n);
   return std::fread(out->data(), sizeof(float), n, f) == n;
 }
 
-bool read_u32s(FILE* f, std::vector<uint32_t>* out, size_t n) {
+bool read_u32s(FILE* f, std::vector<uint32_t>* out, uint64_t n) {
+  if (n > kMaxArrayElems) return false;
   out->resize(n);
   return std::fread(out->data(), sizeof(uint32_t), n, f) == n;
 }
@@ -177,7 +184,18 @@ void softmax_row(float* row, size_t n) {
 bool infer_shapes(Model* m) {
   auto& s = m->shapes;
   s[0] = {2, m->num_features, 0};
+  // SSA discipline: every buffer is written exactly once and only read after
+  // it is defined — exec_program sizes buffers from these final shapes, so
+  // redefinition would let a crafted file write past an allocation.
+  std::vector<bool> defined(s.size(), false);
+  defined[0] = true;
   for (const Op& op : m->ops) {
+    if (op.dst == 0 || defined[op.dst]) return false;
+    if (op.src != kNoBuf && !defined[op.src]) return false;
+    if (op.code == kConcat || op.code == kAdd)
+      for (uint32_t sb : op.idx)
+        if (sb >= s.size() || !defined[sb]) return false;
+    defined[op.dst] = true;
     const Shape in = op.src != kNoBuf ? s[op.src] : Shape{};
     Shape out{};
     switch (op.code) {
@@ -276,7 +294,7 @@ bool read_op(FILE* f, Op* op) {
     case kDense:
       return read_u32(f, &op->act) && read_u32(f, &op->a) &&
              read_u32(f, &op->b) &&
-             read_f32s(f, &op->w0, size_t(op->a) * op->b) &&
+             read_f32s(f, &op->w0, uint64_t(op->a) * op->b) &&
              read_f32s(f, &op->w1, op->b);
     case kGatherCols: {
       uint32_t n = 0;
@@ -286,14 +304,20 @@ bool read_op(FILE* f, Op* op) {
       // a=fields, b=max_vocab, c=dim; idx = positions ++ vocabs
       if (!(read_u32(f, &op->a) && read_u32(f, &op->b) && read_u32(f, &op->c)))
         return false;
-      return read_u32s(f, &op->idx, size_t(op->a) * 2) &&
-             read_f32s(f, &op->w0, size_t(op->a) * op->b * op->c);
+      // staged overflow-safe product check (u32 operands, untrusted)
+      if (op->a > kMaxArrayElems || op->b > kMaxArrayElems ||
+          op->c > kMaxArrayElems)
+        return false;
+      const uint64_t rows = uint64_t(op->a) * op->b;
+      if (rows > kMaxArrayElems || rows * op->c > kMaxArrayElems) return false;
+      return read_u32s(f, &op->idx, uint64_t(op->a) * 2) &&
+             read_f32s(f, &op->w0, rows * op->c);
     }
     case kNumericEmbed:
       // a=fields, b=dim
       return read_u32(f, &op->a) && read_u32(f, &op->b) &&
-             read_f32s(f, &op->w0, size_t(op->a) * op->b) &&
-             read_f32s(f, &op->w1, size_t(op->a) * op->b);
+             read_f32s(f, &op->w0, uint64_t(op->a) * op->b) &&
+             read_f32s(f, &op->w1, uint64_t(op->a) * op->b);
     case kConcat:
     case kAdd: {
       uint32_t n = 0;
@@ -315,13 +339,15 @@ bool read_op(FILE* f, Op* op) {
     case kSelectToken:
       return read_u32(f, &op->a);
     case kTransformerBlock: {
-      // a=d, b=heads, c=mlp_hidden
+      // a=d, b=heads, c=mlp_hidden; dims bounded so d*3*d etc. cannot wrap
       if (!(read_u32(f, &op->a) && read_u32(f, &op->b) && read_u32(f, &op->c)))
         return false;
-      const size_t d = op->a, mh = op->c;
-      const size_t sizes[12] = {d,         d,      d * 3 * d, 3 * d,
-                                d * d,     d,      d,         d,
-                                d * mh,    mh,     mh * d,    d};
+      if (op->a == 0 || op->a > 65536 || op->c == 0 || op->c > 1 << 20)
+        return false;
+      const uint64_t d = op->a, mh = op->c;
+      const uint64_t sizes[12] = {d,         d,      d * 3 * d, 3 * d,
+                                  d * d,     d,      d,         d,
+                                  d * mh,    mh,     mh * d,    d};
       for (int i = 0; i < 12; ++i)
         if (!read_f32s(f, &op->tw[i], sizes[i])) return false;
       return true;
@@ -415,10 +441,20 @@ int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
         const uint32_t* vocab = op.idx.data() + nf;
         for (size_t b = 0; b < batch; ++b) {
           for (uint32_t fidx = 0; fidx < nf; ++fidx) {
-            int32_t id = static_cast<int32_t>(src[b * in.d1 + pos[fidx]]);
-            if (id < 0) id = 0;
+            // clamp in float BEFORE the int cast: float->int of NaN or
+            // out-of-range values is UB and architecture-dependent, and the
+            // numpy interpreter's astype+clip must be matched exactly
+            const float raw = src[b * in.d1 + pos[fidx]];
             const int32_t hi = static_cast<int32_t>(vocab[fidx]) - 1;
-            if (id > hi) id = hi;
+            int32_t id;
+            if (!(raw > 0.0f)) {  // NaN and <=0 land in bucket 0
+              id = 0;
+            } else if (raw >= static_cast<float>(vocab[fidx])) {
+              id = hi;
+            } else {
+              id = static_cast<int32_t>(raw);
+              if (id > hi) id = hi;
+            }
             const float* trow =
                 op.w0.data() + (size_t(fidx) * maxv + id) * dim;
             std::memcpy(dst.data() + (b * nf + fidx) * dim, trow,
@@ -538,7 +574,7 @@ int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
 
 extern "C" {
 
-void* shifu_scorer_load(const char* path) {
+void* shifu_scorer_load(const char* path) try {
   FILE* f = std::fopen(path, "rb");
   if (!f) return nullptr;
   auto model = new Model();
@@ -568,6 +604,10 @@ void* shifu_scorer_load(const char* path) {
     return nullptr;
   }
   return model;
+} catch (...) {
+  // no exception may cross the C ABI (JVM/ctypes hosts): corrupt files that
+  // provoke bad_alloc etc. report as load failure, not process death
+  return nullptr;
 }
 
 void shifu_scorer_free(void* handle) { delete static_cast<Model*>(handle); }
@@ -582,10 +622,12 @@ int shifu_scorer_num_heads(void* handle) {
 
 // rows: [n][num_features] float32; out: [n][num_heads]. Returns 0 on success.
 int shifu_scorer_compute_batch(void* handle, const float* rows, int n,
-                               float* out) {
+                               float* out) try {
   if (!handle || !rows || !out || n <= 0) return 1;
   const Model& m = *static_cast<Model*>(handle);
   return exec_program(m, rows, static_cast<size_t>(n), out);
+} catch (...) {
+  return 4;  // allocation failure etc. — never unwind across the C ABI
 }
 
 // Single-row double API, mirroring TensorflowModel.compute's double[] in /

@@ -157,7 +157,7 @@ class NativeScorer:
 
     def __init__(self, export_dir: str, lib_path: Optional[str] = None):
         bin_path = os.path.join(export_dir, MODEL_BIN)
-        if not os.path.exists(bin_path):
+        if not self._is_current(bin_path):
             pack_native(export_dir)
         self._lib = ctypes.CDLL(lib_path or build_library())
         self._lib.shifu_scorer_load.restype = ctypes.c_void_p
@@ -177,6 +177,18 @@ class NativeScorer:
             raise RuntimeError(f"failed to load native model: {bin_path}")
         self.num_features = self._lib.shifu_scorer_num_features(self._handle)
         self.num_heads = self._lib.shifu_scorer_num_heads(self._handle)
+
+    @staticmethod
+    def _is_current(bin_path: str) -> bool:
+        """True when model.bin exists with the current format version —
+        artifacts packed by an older release are repacked from topology.json
+        + weights.npz rather than failing to load."""
+        try:
+            with open(bin_path, "rb") as f:
+                magic, version = struct.unpack("<2I", f.read(8))
+            return magic == _MAGIC and version == 2
+        except Exception:
+            return False
 
     def compute_batch(self, rows: np.ndarray) -> np.ndarray:
         x = np.ascontiguousarray(rows, dtype=np.float32)

@@ -91,6 +91,23 @@ def test_stablehlo_emitted(exported):
     assert "stablehlo" in text or "mhlo" in text or "func" in text
 
 
+def test_stablehlo_scorer_tier(exported):
+    """The serialized jax.export artifact scores without the model class, for
+    any batch size (symbolic batch dim), matching the training forward."""
+    job, state, forward, out_dir = exported
+    from shifu_tpu.export.scorer import StableHloScorer
+    if not os.path.exists(os.path.join(out_dir, "scoring.jaxexport")):
+        pytest.skip("jax.export serialization unavailable")
+    scorer = StableHloScorer(out_dir)
+    rng = np.random.default_rng(5)
+    for n in (1, 7, 64):
+        rows = rng.standard_normal((n, 12)).astype(np.float32)
+        want = np.asarray(jax.device_get(forward(state.params, rows)))
+        np.testing.assert_allclose(scorer.compute_batch(rows), want,
+                                   rtol=1e-5, atol=1e-6)
+    assert 0.0 <= scorer.compute(rng.standard_normal(12)) <= 1.0
+
+
 def test_train_then_export_end_to_end(tmp_path, small_job, small_data):
     """Full reference workflow: train -> export -> score (the chief worker's
     job, ssgd_monitor.py:302-345)."""

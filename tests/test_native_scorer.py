@@ -161,3 +161,43 @@ def test_native_rejects_out_of_range_indices(tmp_path):
     lib.shifu_scorer_load.restype = ctypes.c_void_p
     lib.shifu_scorer_load.argtypes = [ctypes.c_char_p]
     assert lib.shifu_scorer_load(str(bad).encode()) is None
+
+
+def test_native_rejects_buffer_redefinition(tmp_path):
+    """SSA discipline: a program that writes the same buffer twice must be
+    rejected at load — exec sizes buffers from final shapes, so redefinition
+    with a different shape would be a heap overflow."""
+    import ctypes
+    import struct
+    from shifu_tpu.runtime.native_scorer import build_library
+    bad = tmp_path / "model.bin"
+    blob = struct.pack("<6I", 0x55464853, 2, 4, 1, 2, 2)
+    # two gather_cols ops both writing buffer 1 (valid positions)
+    op = struct.pack("<3I", 1, 1, 0) + struct.pack("<2I", 1, 0)
+    bad.write_bytes(blob + op + op)
+    lib = ctypes.CDLL(build_library())
+    lib.shifu_scorer_load.restype = ctypes.c_void_p
+    lib.shifu_scorer_load.argtypes = [ctypes.c_char_p]
+    assert lib.shifu_scorer_load(str(bad).encode()) is None
+
+
+def test_native_rejects_giant_length_fields(tmp_path):
+    """Inflated u32 length fields (a would-be 16GB allocation / overflowing
+    size product) must fail the load cleanly, not crash the host."""
+    import ctypes
+    import struct
+    from shifu_tpu.runtime.native_scorer import build_library
+    lib = ctypes.CDLL(build_library())
+    lib.shifu_scorer_load.restype = ctypes.c_void_p
+    lib.shifu_scorer_load.argtypes = [ctypes.c_char_p]
+    header = struct.pack("<6I", 0x55464853, 2, 4, 1, 2, 1)
+    # gather_cols with npos=0xFFFFFFFF
+    bad1 = tmp_path / "m1.bin"
+    bad1.write_bytes(header + struct.pack("<3I", 1, 1, 0)
+                     + struct.pack("<I", 0xFFFFFFFF))
+    assert lib.shifu_scorer_load(str(bad1).encode()) is None
+    # embed_lookup whose a*b*c product wraps 64-bit to a tiny number
+    bad2 = tmp_path / "m2.bin"
+    bad2.write_bytes(header + struct.pack("<3I", 2, 1, 0)
+                     + struct.pack("<3I", 4, 2**31, 2**31))
+    assert lib.shifu_scorer_load(str(bad2).encode()) is None
