@@ -48,6 +48,8 @@ public final class ShifuTpuModel implements AutoCloseable {
      */
     public ShifuTpuModel(Path libraryPath, Path artifactDir) {
         this.arena = Arena.ofShared();
+        boolean ok = false;
+        try {
         Linker linker = Linker.nativeLinker();
         SymbolLookup lib = SymbolLookup.libraryLookup(libraryPath, arena);
 
@@ -88,6 +90,14 @@ public final class ShifuTpuModel implements AutoCloseable {
         } catch (Throwable t) {
             throw new IllegalStateException("native call failed", t);
         }
+        ok = true;
+        } finally {
+            // a throwing constructor must not leak the shared arena (it owns
+            // the dlopen'd library mapping; GC never reclaims it)
+            if (!ok) {
+                arena.close();
+            }
+        }
     }
 
     public int getNumFeatures() {
@@ -127,6 +137,9 @@ public final class ShifuTpuModel implements AutoCloseable {
     public float[][] computeBatch(float[][] rows) {
         checkOpen();
         int n = rows.length;
+        if (n == 0) {
+            return new float[0][];
+        }
         try (Arena call = Arena.ofConfined()) {
             MemorySegment in = call.allocate(
                     ValueLayout.JAVA_FLOAT, (long) n * numFeatures);

@@ -283,10 +283,18 @@ def _unflatten(flat: dict[str, np.ndarray]) -> dict:
 
 
 def load_scorer(export_dir: str):
-    """Scorer for an artifact: op-list interpreter when the program exists,
-    JAX fallback otherwise."""
+    """Scorer for an artifact, best tier first: op-list interpreter when the
+    program exists, the serialized compiled graph (StableHloScorer — no model
+    classes needed) when present, JaxScorer (model rebuild) as last resort."""
+    from .artifact import JAX_EXPORT
+
     with open(os.path.join(export_dir, TOPOLOGY)) as f:
         topo = json.load(f)
     if topo.get("program"):
         return Scorer(export_dir)
+    if os.path.exists(os.path.join(export_dir, JAX_EXPORT)):
+        try:
+            return StableHloScorer(export_dir)
+        except Exception:
+            pass  # deserialization unavailable in this jax — rebuild instead
     return JaxScorer(export_dir)

@@ -225,12 +225,13 @@ bool infer_shapes(Model* m) {
       case kConcat: {
         if (op.idx.empty()) return false;
         const Shape first = s[op.idx[0]];
-        uint32_t total = 0;
-        for (uint32_t b : op.idx) {
+        uint64_t total = 0;  // u64 + cap: u32 accumulation could wrap to a
+        for (uint32_t b : op.idx) {  // tiny alloc that exec then overflows
           if (s[b].rank != first.rank || s[b].d2 != first.d2) return false;
           total += s[b].d1;
         }
-        out = {first.rank, total, first.d2};
+        if (total > kMaxArrayElems) return false;
+        out = {first.rank, static_cast<uint32_t>(total), first.d2};
         break;
       }
       case kFlatten:

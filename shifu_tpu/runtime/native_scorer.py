@@ -28,6 +28,7 @@ _OP_CODES = {"dense": 0, "gather_cols": 1, "embed_lookup": 2,
              "layernorm": 11, "select_token": 12, "transformer_block": 13}
 
 _MAGIC = 0x55464853  # "SHFU"
+_VERSION = 2  # model.bin format — must match kVersion in shifu_scorer.cc
 _NO_BUF = 0xFFFFFFFF
 MODEL_BIN = "model.bin"
 
@@ -139,7 +140,7 @@ def pack_native(export_dir: str) -> str:
 
     out_path = os.path.join(export_dir, MODEL_BIN)
     with open(out_path, "wb") as f:
-        f.write(struct.pack("<6I", _MAGIC, 2, int(topo["num_features"]),
+        f.write(struct.pack("<6I", _MAGIC, _VERSION, int(topo["num_features"]),
                             int(topo["num_heads"]), len(buf_ids),
                             len(records)))
         f.write(b"".join(records))
@@ -186,7 +187,7 @@ class NativeScorer:
         try:
             with open(bin_path, "rb") as f:
                 magic, version = struct.unpack("<2I", f.read(8))
-            return magic == _MAGIC and version == 2
+            return magic == _MAGIC and version == _VERSION
         except Exception:
             return False
 
