@@ -156,6 +156,12 @@ class ModelSpec:
     token_dim: int = 64
     mlp_ratio: int = 4
     dropout_rate: float = 0.0
+    # sequence/context parallelism for the attention blocks: "local" (every
+    # device holds the full token axis), "ring" (ppermute K/V rotation —
+    # ops/attention.ring_attention), or "ulysses" (all-to-all head scatter —
+    # ops/attention.ulysses_attention).  Takes effect when the training mesh
+    # has a `seq` axis of size > 1; scoring/export always runs local.
+    attention_impl: str = "local"
     # numerics
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
@@ -171,6 +177,10 @@ class ModelSpec:
                 raise ConfigError(f"unknown activation {a!r}")
         if self.num_heads != len(self.head_names):
             raise ConfigError("num_heads must match len(head_names)")
+        if self.attention_impl not in ("local", "ring", "ulysses"):
+            raise ConfigError(
+                f"unknown attention_impl {self.attention_impl!r}; "
+                "expected local|ring|ulysses")
 
 
 # ---------------------------------------------------------------------------

@@ -171,7 +171,7 @@ def run_train(args) -> int:
         board.close()
         return EXIT_FAIL
 
-    forward = make_forward_fn(job, result.state.apply_fn)
+    forward = make_forward_fn(job)  # meshless rebuild: single-host export graph
     export_dir = save_artifact(result.state.params, job,
                                job.runtime.final_model_path, forward_fn=forward)
     try:

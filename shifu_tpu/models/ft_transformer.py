@@ -14,20 +14,31 @@ sequence-parallel via ops/attention.ring_attention (feature-token counts
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh
 
 from ..config.schema import ModelSpec
-from ..ops.attention import mha
+from ..ops.attention import mha, ring_attention, ulysses_attention
 from ..ops.initializers import xavier_uniform
+from ..parallel.mesh import SEQ_AXIS
 from .base import ShifuDense, dtype_of
 from .embedding import (CategoricalEmbed, FieldLayout, NumericEmbed,
                         split_features)
 
 
+def _seq_parallel_size(mesh: Optional[Mesh]) -> int:
+    if mesh is None or SEQ_AXIS not in mesh.shape:
+        return 1
+    return int(mesh.shape[SEQ_AXIS])
+
+
 class TransformerBlock(nn.Module):
     spec: ModelSpec
+    mesh: Optional[Mesh] = None  # enables ring/ulysses when it has a seq axis
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
@@ -47,7 +58,21 @@ class TransformerBlock(nn.Module):
         q = q.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
         k = k.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
         v = v.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
-        attn = mha(q, k, v).transpose(0, 2, 1, 3).reshape(b, s, d)
+        n_sp = _seq_parallel_size(self.mesh)
+        if self.spec.attention_impl != "local" and n_sp > 1:
+            # sequence/context parallelism over the token axis; same math as
+            # mha (tests/test_attention.py), collectives over ICI
+            if s % n_sp != 0:
+                raise ValueError(
+                    f"attention_impl={self.spec.attention_impl!r} needs the "
+                    f"token count ({s}) divisible by the seq mesh axis "
+                    f"({n_sp}); pad features or adjust the mesh")
+            sp = (ring_attention if self.spec.attention_impl == "ring"
+                  else ulysses_attention)
+            attn = sp(q, k, v, self.mesh)
+        else:
+            attn = mha(q, k, v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
         attn = nn.Dense(d, kernel_init=xavier_uniform, dtype=cdt,
                         param_dtype=dtype_of(self.spec.param_dtype),
                         name="proj")(attn)
@@ -72,6 +97,7 @@ class TransformerBlock(nn.Module):
 class FTTransformer(nn.Module):
     spec: ModelSpec
     layout: FieldLayout
+    mesh: Optional[Mesh] = None  # for sequence-parallel attention_impl
 
     @nn.compact
     def __call__(self, features: jax.Array, *, train: bool = False) -> jax.Array:
@@ -98,7 +124,8 @@ class FTTransformer(nn.Module):
         x = jnp.concatenate([cls, x.astype(cdt)], axis=1)
 
         for i in range(self.spec.num_layers):
-            x = TransformerBlock(spec=self.spec, name=f"block_{i}")(x, train=train)
+            x = TransformerBlock(spec=self.spec, mesh=self.mesh,
+                                 name=f"block_{i}")(x, train=train)
 
         cls_out = nn.LayerNorm(dtype=cdt, name="ln_final")(x[:, 0, :])
         return ShifuDense(features=self.spec.num_heads, activation=None,

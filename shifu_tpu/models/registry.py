@@ -22,43 +22,52 @@ def register(name: str):
     return deco
 
 
-def build_model(spec: ModelSpec, schema: DataSchema) -> nn.Module:
+def build_model(spec: ModelSpec, schema: DataSchema, mesh=None) -> nn.Module:
+    """`mesh` (jax.sharding.Mesh) is forwarded to models that can exploit it
+    (FT-Transformer sequence-parallel attention); builders that take only
+    (spec, schema) ignore it.  Scoring/export paths pass no mesh and get the
+    single-host local-attention graph."""
     try:
         builder = _BUILDERS[spec.model_type]
     except KeyError:
         raise KeyError(
             f"unknown model_type {spec.model_type!r}; available: {sorted(_BUILDERS)}") from None
-    return builder(spec, schema)
+    return builder(spec, schema, mesh=mesh)
 
 
 @register("mlp")
-def _build_mlp(spec: ModelSpec, schema: DataSchema) -> nn.Module:
+def _build_mlp(spec: ModelSpec, schema: DataSchema,
+               mesh=None) -> nn.Module:
     from .mlp import ShifuMLP
     return ShifuMLP(spec=spec)
 
 
 @register("wide_deep")
-def _build_wide_deep(spec: ModelSpec, schema: DataSchema) -> nn.Module:
+def _build_wide_deep(spec: ModelSpec, schema: DataSchema,
+                     mesh=None) -> nn.Module:
     from .embedding import field_layout
     from .wide_deep import WideDeep
     return WideDeep(spec=spec, layout=field_layout(schema))
 
 
 @register("deepfm")
-def _build_deepfm(spec: ModelSpec, schema: DataSchema) -> nn.Module:
+def _build_deepfm(spec: ModelSpec, schema: DataSchema,
+                  mesh=None) -> nn.Module:
     from .deepfm import DeepFM
     from .embedding import field_layout
     return DeepFM(spec=spec, layout=field_layout(schema))
 
 
 @register("multitask")
-def _build_multitask(spec: ModelSpec, schema: DataSchema) -> nn.Module:
+def _build_multitask(spec: ModelSpec, schema: DataSchema,
+                     mesh=None) -> nn.Module:
     from .multitask import MultiTask
     return MultiTask(spec=spec)
 
 
 @register("ft_transformer")
-def _build_ft_transformer(spec: ModelSpec, schema: DataSchema) -> nn.Module:
+def _build_ft_transformer(spec: ModelSpec, schema: DataSchema,
+                          mesh=None) -> nn.Module:
     from .embedding import field_layout
     from .ft_transformer import FTTransformer
-    return FTTransformer(spec=spec, layout=field_layout(schema))
+    return FTTransformer(spec=spec, layout=field_layout(schema), mesh=mesh)

@@ -234,10 +234,13 @@ bool infer_shapes(Model* m) {
         out = {first.rank, static_cast<uint32_t>(total), first.d2};
         break;
       }
-      case kFlatten:
+      case kFlatten: {
         if (in.rank != 3) return false;
-        out = {2, in.d1 * in.d2, 0};
+        const uint64_t flat = uint64_t(in.d1) * in.d2;  // u32 mul could wrap
+        if (flat > kMaxArrayElems) return false;
+        out = {2, static_cast<uint32_t>(flat), 0};
         break;
+      }
       case kSumFields:
         if (in.rank != 3) return false;
         out = {2, in.d2, 0};
@@ -282,6 +285,11 @@ bool infer_shapes(Model* m) {
       default:
         return false;
     }
+    // universal allocation bound: no buffer's per-row element count may
+    // exceed the cap, whatever op produced it (rank-3 concat could pass a
+    // d1-only check while d1*d2 overflows downstream resizes)
+    if (uint64_t(out.d1) * (out.rank == 3 ? out.d2 : 1) > kMaxArrayElems)
+      return false;
     s[op.dst] = out;
   }
   return true;

@@ -62,7 +62,7 @@ class TrainResult:
 def init_state(job: JobConfig, num_features: int,
                mesh: Optional[Mesh] = None) -> TrainState:
     """Build model + optimizer and initialize (optionally mesh-placed) state."""
-    model = build_model(job.model, job.schema)
+    model = build_model(job.model, job.schema, mesh)
     tx = build_optimizer(job.train.optimizer)
     rng = jax.random.PRNGKey(job.train.seed)
     dummy = jnp.zeros((1, num_features), jnp.float32)

@@ -141,8 +141,17 @@ def make_eval_step(job: JobConfig) -> Callable[[TrainState, Batch], jax.Array]:
     return jax.jit(score)
 
 
-def make_forward_fn(job: JobConfig, apply_fn) -> Callable[[Any, jax.Array], jax.Array]:
-    """Pure (params, features) -> scores fn for export/AOT paths."""
+def make_forward_fn(job: JobConfig,
+                    apply_fn=None) -> Callable[[Any, jax.Array], jax.Array]:
+    """Pure (params, features) -> scores fn for export/AOT paths.
+
+    With apply_fn=None the model is rebuilt WITHOUT a mesh, which is what
+    export wants: a training apply_fn may embed sequence-parallel shard_map
+    collectives (ModelSpec.attention_impl), and the scoring artifact must be
+    a single-host graph."""
+    if apply_fn is None:
+        from ..models.registry import build_model
+        apply_fn = build_model(job.model, job.schema).apply
 
     def forward(params, features: jax.Array) -> jax.Array:
         return jax.nn.sigmoid(apply_fn({"params": params}, features))
