@@ -109,6 +109,43 @@ def main() -> None:
     float(m["loss"])
     dispatch_per_chip = steps * batch_size / (time.perf_counter() - t0) / n_chips
 
+    extras = {}
+    try:  # eval-side throughput: numpy op-list scorer on the same model
+        import tempfile
+
+        from shifu_tpu.export import load_scorer, save_artifact
+
+        export_dir = tempfile.mkdtemp(prefix="bench_artifact_")
+        # st, not state: the initial state's buffers were donated away
+        save_artifact(jax.device_get(st.params), job, export_dir)
+        scorer = load_scorer(export_dir)
+        score_rows = rng.standard_normal((8192, num_features)).astype(np.float32)
+        scorer.compute_batch(score_rows)  # warm
+        t0 = time.perf_counter()
+        reps = 10
+        for _ in range(reps):
+            scorer.compute_batch(score_rows)
+        extras["score_rows_per_sec_numpy"] = round(
+            reps * len(score_rows) / (time.perf_counter() - t0), 1)
+    except Exception:
+        pass
+
+    try:  # input-side throughput: gzip|psv parse (native tier when available)
+        import tempfile
+
+        from shifu_tpu.data import reader, synthetic
+
+        tmp = tempfile.mkdtemp(prefix="bench_parse_")
+        p_schema = synthetic.make_schema(num_features=num_features)
+        p_rows = synthetic.make_rows(100_000, p_schema, seed=1)
+        paths = synthetic.write_files(p_rows, tmp, num_files=4)
+        reader.read_file(paths[0])  # warm (builds the native parser once)
+        t0 = time.perf_counter()
+        total = sum(reader.read_file(p).shape[0] for p in paths)
+        extras["parse_rows_per_sec"] = round(total / (time.perf_counter() - t0), 1)
+    except Exception:
+        pass
+
     print(json.dumps({
         "metric": "tabular_train_samples_per_sec_per_chip",
         "value": round(resident_per_chip, 1),
@@ -118,6 +155,7 @@ def main() -> None:
         "n_chips": n_chips,
         "model": "mlp_3x100_bf16_weighted_mse_adadelta",
         "global_batch": batch_size,
+        **extras,
     }))
 
 

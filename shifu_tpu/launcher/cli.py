@@ -146,7 +146,23 @@ def run_train(args) -> int:
     n_devices = len(jax.devices())
     if args.devices:
         n_devices = min(n_devices, args.devices)
-    mesh = data_parallel_mesh(n_devices) if n_devices > 1 else None
+    mesh_cfg = job.runtime.mesh
+    if mesh_cfg.model > 1 or mesh_cfg.seq > 1:
+        # explicit multi-axis topology from config (tp / sequence parallelism)
+        from ..parallel import make_mesh
+        need = mesh_cfg.data * mesh_cfg.model * mesh_cfg.seq
+        if need > n_devices:
+            board(f"mesh {mesh_cfg} needs {need} devices, have {n_devices}")
+            board.close()
+            return EXIT_FAIL
+        mesh = make_mesh(mesh_cfg, jax.devices()[:need])
+    else:
+        mesh = data_parallel_mesh(n_devices) if n_devices > 1 else None
+    if job.model.attention_impl != "local" and (
+            mesh is None or mesh.shape.get("seq", 1) <= 1):
+        board(f"warning: attention_impl={job.model.attention_impl!r} needs a "
+              "mesh with a seq axis > 1 (runtime.mesh.seq); falling back to "
+              "local attention")
 
     board(f"shifu_tpu train: {job.runtime.app_name} devices={n_devices} "
           f"model={job.model.model_type} epochs={job.train.epochs} "
@@ -180,11 +196,27 @@ def run_train(args) -> int:
     except Exception as e:  # native pack is best-effort at train time
         board(f"native pack skipped: {e}")
     board(f"model exported to {export_dir}")
+    _write_metrics_jsonl(result, os.path.join(out_dir, "metrics.jsonl"))
     if result.history:
         last = result.history[-1]
         board(f"final: valid_error={last.valid_error:.6f} valid_auc={last.valid_auc:.4f}")
     board.close()
     return EXIT_OK
+
+
+def _write_metrics_jsonl(result, path: str) -> None:
+    """Structured per-epoch metrics next to the human console board — the
+    machine-readable successor of the reference's Java-serialized
+    TrainingIntermediateResult znodes (core/TrainingIntermediateResult.java:
+    97-102; SURVEY.md section 5.5 flagged Java serialization as a quirk)."""
+    import dataclasses
+    import json
+    try:
+        with open(path, "w") as f:
+            for m in result.history:
+                f.write(json.dumps(dataclasses.asdict(m)) + "\n")
+    except OSError:
+        pass  # metrics sink is best-effort; the board already has the lines
 
 
 def _maybe_inject_fault(metrics, board) -> None:

@@ -24,9 +24,10 @@ def register(name: str):
 
 def build_model(spec: ModelSpec, schema: DataSchema, mesh=None) -> nn.Module:
     """`mesh` (jax.sharding.Mesh) is forwarded to models that can exploit it
-    (FT-Transformer sequence-parallel attention); builders that take only
-    (spec, schema) ignore it.  Scoring/export paths pass no mesh and get the
-    single-host local-attention graph."""
+    (FT-Transformer sequence-parallel attention).  Every registered builder
+    must accept (spec, schema, mesh=None) and may ignore the mesh.  Scoring/
+    export paths pass no mesh and get the single-host local-attention
+    graph."""
     try:
         builder = _BUILDERS[spec.model_type]
     except KeyError:

@@ -77,13 +77,23 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
-    spec = P(None, None, seq_axis, None)
+    spec = _sp_spec(mesh, seq_axis)
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=seq_axis, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )
     return fn(q, k, v)
+
+
+def _sp_spec(mesh: Mesh, seq_axis: str) -> P:
+    """Partition spec for sequence-parallel q/k/v: sequence on `seq_axis`
+    AND batch on `data` when the mesh has one — omitting the data axis would
+    make shard_map all-gather the batch and recompute attention identically
+    on every data replica (n_data x FLOPs/memory for nothing)."""
+    from ..parallel.mesh import DATA_AXIS
+    batch_axis = DATA_AXIS if DATA_AXIS in mesh.shape else None
+    return P(batch_axis, None, seq_axis, None)
 
 
 def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -123,7 +133,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             f"'{seq_axis}' mesh axis ({n}); use ring_attention otherwise")
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
-    spec = P(None, None, seq_axis, None)
+    spec = _sp_spec(mesh, seq_axis)
     fn = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=seq_axis, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -65,7 +65,10 @@ def init_state(job: JobConfig, num_features: int,
     model = build_model(job.model, job.schema, mesh)
     tx = build_optimizer(job.train.optimizer)
     rng = jax.random.PRNGKey(job.train.seed)
-    dummy = jnp.zeros((1, num_features), jnp.float32)
+    # init batch must divide the data axis: a mesh-aware model (sequence-
+    # parallel attention) shard_maps the batch dimension even at init
+    init_batch = int(mesh.shape.get("data", 1)) if mesh is not None else 1
+    dummy = jnp.zeros((init_batch, num_features), jnp.float32)
     variables = model.init(rng, dummy)
     state = TrainState.create(apply_fn=model.apply, params=variables["params"], tx=tx)
     if mesh is not None:

@@ -42,8 +42,9 @@ def test_ring_attention_matches_mha(eight_devices, seq_devices):
     out_full = mha(q, k, v)
     np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_full),
                                rtol=2e-5, atol=2e-6)
-    # output keeps the sequence sharding
-    assert out_ring.sharding.spec == P(None, None, "seq", None)
+    # output keeps the sequence sharding (batch rides the data axis so data
+    # replicas never recompute attention)
+    assert out_ring.sharding.spec == P("data", None, "seq", None)
 
 
 def test_ring_attention_long_sequence_bf16(eight_devices):
@@ -70,7 +71,7 @@ def test_ulysses_attention_matches_mha(eight_devices, seq_devices):
     out_full = mha(q, k, v)
     np.testing.assert_allclose(np.asarray(out_u), np.asarray(out_full),
                                rtol=2e-5, atol=2e-6)
-    assert out_u.sharding.spec == P(None, None, "seq", None)
+    assert out_u.sharding.spec == P("data", None, "seq", None)
 
 
 def test_ulysses_rejects_indivisible_heads(eight_devices):

@@ -70,6 +70,11 @@ def test_train_cli_end_to_end(job_dir):
     final = out / "final_model"
     for f in ("GenericModelConfig.json", "topology.json", "weights.npz", "model.bin"):
         assert (final / f).exists(), f
+    # structured per-epoch metrics next to the board
+    import json
+    lines = [json.loads(l) for l in (out / "metrics.jsonl").read_text().splitlines()]
+    assert len(lines) >= 2
+    assert {"epoch", "train_error", "valid_error", "valid_auc"} <= set(lines[0])
 
 
 def test_score_cli(job_dir):
