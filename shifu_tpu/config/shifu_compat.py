@@ -215,10 +215,12 @@ def parse_model_config(model_config: dict[str, Any]) -> tuple[ModelSpec, TrainCo
         embedding_dim=int(params.get("EmbeddingDim", 16)),
         num_heads=num_heads,
         head_names=tuple(head_names),
-        num_layers=int(params.get("NumTransformerLayers", 3)),
+        num_layers=int(params.get("NumTransformerLayers",
+                                  params.get("NumLayers", 3))),
         num_attention_heads=int(params.get("NumAttentionHeads", 8)),
         token_dim=int(params.get("TokenDim", 64)),
         dropout_rate=float(params.get("DropoutRate", 0.0)),
+        attention_impl=str(params.get("AttentionImpl", "local")).lower(),
     )
 
     lr = float(params.get("LearningRate", 0.003))  # reference fallback 0.003 (ssgd_monitor.py:136)

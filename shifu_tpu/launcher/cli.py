@@ -147,24 +147,30 @@ def run_train(args) -> int:
     if args.devices:
         n_devices = min(n_devices, args.devices)
     mesh_cfg = job.runtime.mesh
-    if mesh_cfg.model > 1 or mesh_cfg.seq > 1:
-        # explicit multi-axis topology from config (tp / sequence parallelism)
+    need = mesh_cfg.data * mesh_cfg.model * mesh_cfg.seq
+    if need > 1:
+        # explicit topology from config (shifu.mesh.* — dp size, tp and/or
+        # sequence parallelism); all-axes-1 means "unset" and defaults to
+        # data parallelism over every visible device
         from ..parallel import make_mesh
-        need = mesh_cfg.data * mesh_cfg.model * mesh_cfg.seq
         if need > n_devices:
             board(f"mesh {mesh_cfg} needs {need} devices, have {n_devices}")
             board.close()
             return EXIT_FAIL
         mesh = make_mesh(mesh_cfg, jax.devices()[:need])
+        devices_in_use = need
     else:
         mesh = data_parallel_mesh(n_devices) if n_devices > 1 else None
+        devices_in_use = n_devices
     if job.model.attention_impl != "local" and (
             mesh is None or mesh.shape.get("seq", 1) <= 1):
         board(f"warning: attention_impl={job.model.attention_impl!r} needs a "
               "mesh with a seq axis > 1 (runtime.mesh.seq); falling back to "
               "local attention")
 
-    board(f"shifu_tpu train: {job.runtime.app_name} devices={n_devices} "
+    board(f"shifu_tpu train: {job.runtime.app_name} "
+          f"devices={devices_in_use}/{n_devices} "
+          f"mesh={dict(mesh.shape) if mesh is not None else None} "
           f"model={job.model.model_type} epochs={job.train.epochs} "
           f"batch={job.data.batch_size}")
 
@@ -211,10 +217,19 @@ def _write_metrics_jsonl(result, path: str) -> None:
     97-102; SURVEY.md section 5.5 flagged Java serialization as a quirk)."""
     import dataclasses
     import json
+    import math
+
+    def _clean(v):
+        # NaN/Inf are not valid JSON; strict JSONL consumers need null
+        if isinstance(v, float) and not math.isfinite(v):
+            return None
+        return v
+
     try:
         with open(path, "w") as f:
             for m in result.history:
-                f.write(json.dumps(dataclasses.asdict(m)) + "\n")
+                rec = {k: _clean(v) for k, v in dataclasses.asdict(m).items()}
+                f.write(json.dumps(rec, allow_nan=False) + "\n")
     except OSError:
         pass  # metrics sink is best-effort; the board already has the lines
 

@@ -29,6 +29,11 @@ KEY_BATCH_SIZE = "shifu.application.batch-size"
 KEY_MAX_RESTARTS = "shifu.application.max-restarts"
 KEY_HEARTBEAT_INTERVAL = "shifu.task.heartbeat-interval-ms"
 KEY_MAX_MISSED_HEARTBEATS = "shifu.task.max-missed-heartbeats"
+# device mesh topology (successor of shifu.{ps,worker}.instances container
+# counts: the logical axes the one SPMD program shards over)
+KEY_MESH_DATA = "shifu.mesh.data"
+KEY_MESH_MODEL = "shifu.mesh.model"
+KEY_MESH_SEQ = "shifu.mesh.seq"
 
 
 def parse_configuration_xml(path: str) -> dict[str, str]:
@@ -119,6 +124,12 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         rt_kw["checkpoint"] = ck
     if KEY_MAX_RESTARTS in conf:
         rt_kw["max_restarts"] = int(conf[KEY_MAX_RESTARTS])
+    if KEY_MESH_DATA in conf or KEY_MESH_MODEL in conf or KEY_MESH_SEQ in conf:
+        rt_kw["mesh"] = dataclasses.replace(
+            runtime.mesh,
+            data=int(conf.get(KEY_MESH_DATA, runtime.mesh.data)),
+            model=int(conf.get(KEY_MESH_MODEL, runtime.mesh.model)),
+            seq=int(conf.get(KEY_MESH_SEQ, runtime.mesh.seq)))
     if rt_kw:
         runtime = dataclasses.replace(runtime, **rt_kw)
 

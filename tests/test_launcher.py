@@ -173,3 +173,48 @@ def test_globalconfig_xml_overrides(job_dir):
     assert job["train"]["epochs"] == 1
     assert job["data"]["batch_size"] == 128
     assert "Epoch 1:" not in r.stdout
+
+
+def test_mesh_from_globalconfig_sequence_parallel(job_dir):
+    """shifu.mesh.* XML keys drive the device mesh: a data x seq topology
+    trains an FT-Transformer with ring attention through the CLI — the full
+    operator path for the sequence-parallel capability."""
+    from shifu_tpu.data import synthetic
+    from shifu_tpu.utils import xmlconfig
+    # 15 features + CLS = 16 tokens, divisible by the seq axis (2)
+    schema = synthetic.make_schema(num_features=15)
+    rows = synthetic.make_rows(1500, schema, seed=5, noise=0.3)
+    synthetic.write_files(rows, str(job_dir / "normalized15"), num_files=4)
+    columns = [{"columnNum": 0, "columnName": "target", "columnFlag": "Target"}]
+    for i in range(1, 16):
+        columns.append({"columnNum": i, "columnName": f"f{i}",
+                        "columnType": "N", "finalSelect": True})
+    (job_dir / "ColumnConfig.json").write_text(json.dumps(columns))
+    mc = dict(MODEL_CONFIG)
+    mc["train"] = dict(MODEL_CONFIG["train"],
+                       numTrainEpochs=1,
+                       params=dict(MODEL_CONFIG["train"]["params"],
+                                   ModelType="ft_transformer", TokenDim=8,
+                                   NumAttentionHeads=2, NumLayers=1,
+                                   AttentionImpl="ring"))
+    (job_dir / "ModelConfig.json").write_text(json.dumps(mc))
+    xml = job_dir / "global.xml"
+    xmlconfig.write_configuration_xml({
+        "shifu.mesh.data": "2",
+        "shifu.mesh.seq": "2",
+        "shifu.application.batch-size": "64",
+    }, str(xml))
+    out = job_dir / "out_sp"
+    r = _run_cli(["train",
+                  "--modelconfig", str(job_dir / "ModelConfig.json"),
+                  "--columnconfig", str(job_dir / "ColumnConfig.json"),
+                  "--data", str(job_dir / "normalized15"),
+                  "--globalconfig", str(xml),
+                  "--output", str(out)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    job = json.loads((out / "job-config.json").read_text())
+    mesh = job["runtime"]["mesh"]
+    assert (mesh["data"], mesh["model"], mesh["seq"]) == (2, 1, 2)
+    assert job["model"]["attention_impl"] == "ring"
+    assert "falling back to local attention" not in r.stdout
+    assert "Epoch 0:" in r.stdout
