@@ -37,8 +37,8 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
         try:
             from ..runtime.nativelib import build_library
-            lib = ctypes.CDLL(build_library("shifu_parser.cc",
-                                            extra_flags=["-lz", "-lpthread"]))
+            lib = ctypes.CDLL(build_library(
+                "shifu_parser.cc", extra_flags=["-lz", "-lpthread", "-ldl"]))
         except Exception as e:  # no g++/zlib: numpy path serves instead
             _lib_err = str(e)
             return None

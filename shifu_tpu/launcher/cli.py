@@ -258,30 +258,44 @@ def _maybe_inject_fault(metrics, board) -> None:
         os._exit(17)
 
 
-def run_score(args) -> int:
+def _load_scorer(model_dir: str, native: bool):
+    if native:
+        from ..runtime import NativeScorer
+        return NativeScorer(model_dir)
+    from ..export import load_scorer
+    return load_scorer(model_dir)
+
+
+def _project_features(rows, model_dir: str, scorer):
+    """Select the artifact's feature columns from raw normalized rows.
+
+    The artifact's own `topology.json` selected_indices are the authority
+    (the ColumnConfig on disk may have drifted since training — e.g. variable
+    selection re-run); full-width inputs pass through, and NaNs impute to 0
+    the way training did (data/reader.py project_columns)."""
     import numpy as np
 
+    n_feat = getattr(scorer, "num_features", None) or rows.shape[1]
+    if rows.shape[1] != n_feat:
+        sel = None
+        try:
+            with open(os.path.join(model_dir, "topology.json")) as f:
+                sel = json.load(f).get("selected_indices")
+        except (OSError, ValueError):
+            pass
+        if sel and rows.shape[1] > max(sel):
+            rows = rows[:, sel]
+        else:
+            rows = rows[:, :n_feat]
+    return np.nan_to_num(rows, nan=0.0)
+
+
+def run_score(args) -> int:
     from ..data import reader
 
     rows = reader.read_file(args.input)
-    if args.native:
-        from ..runtime import NativeScorer
-        scorer = NativeScorer(args.model)
-    else:
-        from ..export import load_scorer
-        scorer = load_scorer(args.model)
-    n_feat = scorer.num_features if hasattr(scorer, "num_features") else rows.shape[1]
-    if rows.shape[1] == n_feat:
-        feats = rows
-    else:
-        # full normalized rows: project the artifact's selected feature columns
-        with open(os.path.join(args.model, "topology.json")) as f:
-            sel = json.load(f).get("selected_indices")
-        if sel and rows.shape[1] > max(sel):
-            feats = np.nan_to_num(rows[:, sel], nan=0.0)
-        else:
-            feats = rows[:, :n_feat]
-    scores = scorer.compute_batch(feats)
+    scorer = _load_scorer(args.model, args.native)
+    scores = scorer.compute_batch(_project_features(rows, args.model, scorer))
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     for s in scores:
         out.write("|".join(f"{v:.6f}" for v in s) + "\n")
@@ -337,26 +351,38 @@ def run_eval(args) -> int:
     if not paths:
         print("eval: no data files found", file=sys.stderr)
         return EXIT_FAIL
-    rows = np.concatenate([reader.read_file(p) for p in sorted(paths)], axis=0)
-    cols = reader.project_columns(rows, schema)
+    scorer = _load_scorer(args.model, args.native)
+    # project per file (empty part files contribute nothing; memory is bounded
+    # by the useful columns, not the full raw width of the whole eval set)
+    feats_l, target_l, weight_l = [], [], []
+    for p in sorted(paths):
+        raw = reader.read_file(p)
+        if raw.shape[0] == 0:
+            continue
+        cols = reader.project_columns(raw, schema)
+        feats_l.append(_project_features(raw, args.model, scorer))
+        target_l.append(cols["target"])
+        weight_l.append(cols["weight"])
+    if not feats_l:
+        print("eval: no data rows found", file=sys.stderr)
+        return EXIT_FAIL
+    scores = scorer.compute_batch(np.concatenate(feats_l, axis=0))
 
-    if args.native:
-        from ..runtime import NativeScorer
-        scorer = NativeScorer(args.model)
-    else:
-        from ..export import load_scorer
-        scorer = load_scorer(args.model)
-    scores = scorer.compute_batch(cols["features"])
+    labels = np.concatenate(target_l, axis=0)[:, 0]
+    weights = np.concatenate(weight_l, axis=0)[:, 0]
 
-    labels = cols["target"][:, 0]
-    weights = cols["weight"][:, 0]
+    def _round_finite(v: float, nd: int = 6):
+        # NaN (e.g. single-class AUC) is not valid JSON; emit null instead
+        import math
+        return round(float(v), nd) if math.isfinite(float(v)) else None
+
     summary = {
-        "rows": int(rows.shape[0]),
-        "auc": round(float(auc(scores[:, 0], labels, weights)), 6),
-        "weighted_error": round(
-            float(weighted_error(scores[:, 0], labels, weights)), 6),
-        "mean_score": round(float(scores[:, 0].mean()), 6),
-        "positive_rate": round(float((labels > 0.5).mean()), 6),
+        "rows": int(labels.shape[0]),
+        "auc": _round_finite(auc(scores[:, 0], labels, weights)),
+        "weighted_error": _round_finite(
+            weighted_error(scores[:, 0], labels, weights)),
+        "mean_score": _round_finite(scores[:, 0].mean()),
+        "positive_rate": _round_finite((labels > 0.5).mean()),
     }
     if args.scores_output:
         with open(args.scores_output, "w") as f:

@@ -23,6 +23,7 @@
 //   shifu_parse_file / shifu_parse_buffer -> malloc'd [rows x cols] float32
 //   shifu_parser_free, shifu_count_rows, shifu_parser_version
 
+#include <dlfcn.h>
 #include <zlib.h>
 
 #include <atomic>
@@ -40,7 +41,7 @@
 
 namespace {
 
-constexpr int kVersion = 1;
+constexpr int kVersion = 2;
 
 // ---------------------------------------------------------------- file I/O
 
@@ -67,9 +68,108 @@ bool is_gzip(const std::string& raw) {
          static_cast<unsigned char>(raw[1]) == 0x8b;
 }
 
-// Inflate a (possibly multi-member) gzip buffer.  inflateReset after each
-// Z_STREAM_END continues into the next concatenated member.
+// ---------------------------------------------------------- libdeflate tier
+// libdeflate decompresses gzip 2-3x faster than zlib's inflate but only
+// works whole-buffer.  It is loaded lazily via dlopen so the parser builds
+// and runs (on the zlib path below) when the library is absent.
+
+struct LibDeflateApi {
+  void* (*alloc_decompressor)();
+  void (*free_decompressor)(void*);
+  // libdeflate_gzip_decompress_ex: one gzip member per call; reports how many
+  // input/output bytes it consumed/produced so members can be looped.
+  int (*gzip_decompress_ex)(void*, const void*, size_t, void*, size_t,
+                            size_t*, size_t*);
+};
+
+const LibDeflateApi* libdeflate_api() {
+  static const LibDeflateApi* api = []() -> const LibDeflateApi* {
+    void* h = dlopen("libdeflate.so.0", RTLD_NOW | RTLD_LOCAL);
+    if (!h) h = dlopen("libdeflate.so", RTLD_NOW | RTLD_LOCAL);
+    if (!h) return nullptr;
+    static LibDeflateApi a;
+    a.alloc_decompressor = reinterpret_cast<void* (*)()>(
+        dlsym(h, "libdeflate_alloc_decompressor"));
+    a.free_decompressor = reinterpret_cast<void (*)(void*)>(
+        dlsym(h, "libdeflate_free_decompressor"));
+    a.gzip_decompress_ex =
+        reinterpret_cast<int (*)(void*, const void*, size_t, void*, size_t,
+                                 size_t*, size_t*)>(
+            dlsym(h, "libdeflate_gzip_decompress_ex"));
+    if (!a.alloc_decompressor || !a.free_decompressor ||
+        !a.gzip_decompress_ex) {
+      dlclose(h);
+      return nullptr;
+    }
+    return &a;
+  }();
+  return api;
+}
+
+// Whole-buffer gzip decompress via libdeflate, looping concatenated members.
+// Same semantics as the zlib path: all-zero trailing padding is EOF, any
+// other trailing junk or a truncated member is an error.
+bool gunzip_libdeflate(const LibDeflateApi* api, const std::string& raw,
+                       std::string* out) {
+  void* d = api->alloc_decompressor();
+  if (!d) return false;
+  // Seed capacity from the gzip ISIZE trailer (last member's uncompressed
+  // size mod 2^32) — exact for the common single-member file, so no
+  // re-decompression retries; the 4x heuristic covers multi-member files
+  // and zero-padded trailers (whose last 4 bytes are 0).
+  size_t cap = raw.size() * 4 + (1 << 20);
+  if (raw.size() >= 18) {
+    const unsigned char* t =
+        reinterpret_cast<const unsigned char*>(raw.data()) + raw.size() - 4;
+    const size_t isize = static_cast<size_t>(t[0]) | (size_t{t[1]} << 8) |
+                         (size_t{t[2]} << 16) | (size_t{t[3]} << 24);
+    if (isize + (1 << 12) > cap) cap = isize + (1 << 12);
+  }
+  out->resize(cap);
+  size_t written = 0, pos = 0;
+  bool ok = true;
+  while (pos < raw.size()) {
+    if (raw[pos] == 0) {  // block-aligned writers pad with NULs: EOF if all 0
+      bool all_zero = true;
+      for (size_t i = pos; i < raw.size(); ++i)
+        if (raw[i] != 0) { all_zero = false; break; }
+      ok = all_zero;
+      break;
+    }
+    if (raw.size() - pos < 2 ||
+        static_cast<unsigned char>(raw[pos]) != 0x1f ||
+        static_cast<unsigned char>(raw[pos + 1]) != 0x8b) {
+      ok = false;  // trailing junk that is neither padding nor a member
+      break;
+    }
+    size_t in_used = 0, out_used = 0;
+    int rc = api->gzip_decompress_ex(d, raw.data() + pos, raw.size() - pos,
+                                     &(*out)[written], cap - written,
+                                     &in_used, &out_used);
+    if (rc == 3) {  // LIBDEFLATE_INSUFFICIENT_SPACE: grow and retry member
+      cap = cap * 4 + (1 << 20);
+      out->resize(cap);
+      continue;
+    }
+    if (rc != 0) {  // BAD_DATA / SHORT_OUTPUT: corrupt or truncated
+      ok = false;
+      break;
+    }
+    written += out_used;
+    pos += in_used;
+  }
+  api->free_decompressor(d);
+  if (!ok) return false;
+  out->resize(written);
+  return true;
+}
+
+// Inflate a (possibly multi-member) gzip buffer.  Uses libdeflate when the
+// shared library is present, else zlib (inflateReset after each Z_STREAM_END
+// continues into the next concatenated member).
 bool gunzip(const std::string& raw, std::string* out) {
+  if (const LibDeflateApi* api = libdeflate_api())
+    return gunzip_libdeflate(api, raw, out);
   z_stream zs;
   std::memset(&zs, 0, sizeof(zs));
   if (inflateInit2(&zs, 15 + 16) != Z_OK) return false;
@@ -120,14 +220,17 @@ bool gunzip(const std::string& raw, std::string* out) {
 
 // ------------------------------------------------------------------ parsing
 
-inline float parse_cell(const char* begin, const char* end) {
-  // trim spaces/CR the way float(str) tolerates them
-  while (begin < end && (*begin == ' ' || *begin == '\t')) ++begin;
-  while (end > begin &&
-         (end[-1] == ' ' || end[-1] == '\t' || end[-1] == '\r'))
-    --end;
+// Slow/general cell parse via from_chars (handles exponents, inf/nan,
+// long-digit strings).  parse_cell below fast-paths the dominant shape of
+// normalized tabular data — [-]digits[.digits] with few significant digits —
+// at ~3x the speed.  BOTH paths parse to a correctly-rounded double first and
+// narrow to float, exactly like the numpy/pandas fallback tier (float64
+// strtod narrowed to float32) — one rounding rule everywhere keeps the
+// tested bit-parity between the native and Python readers even on decimal
+// strings that land on float halfway points.
+inline float parse_cell_slow(const char* begin, const char* end) {
   if (begin < end && *begin == '+') ++begin;  // from_chars rejects leading '+'
-  float v;
+  double v;
   auto res = std::from_chars(begin, end, v);
   if (res.ptr != end) return std::numeric_limits<float>::quiet_NaN();
   if (res.ec == std::errc::result_out_of_range) {
@@ -138,7 +241,52 @@ inline float parse_cell(const char* begin, const char* end) {
   }
   if (res.ec != std::errc())
     return std::numeric_limits<float>::quiet_NaN();
-  return v;
+  return static_cast<float>(v);
+}
+
+inline float parse_cell(const char* begin, const char* end) {
+  // trim spaces/CR the way float(str) tolerates them
+  while (begin < end && (*begin == ' ' || *begin == '\t')) ++begin;
+  while (end > begin &&
+         (end[-1] == ' ' || end[-1] == '\t' || end[-1] == '\r'))
+    --end;
+  // fast path: [-]digits[.digits], <= 15 significant digits.  mant is exact
+  // in double (< 2^53) and 10^frac is exact for frac <= 15 (positive powers
+  // of ten are exact through 1e22), so mant / 10^frac incurs exactly one
+  // rounding — i.e. the correctly-rounded double, identical to strtod /
+  // from_chars<double> — then the same double->float narrow as the slow
+  // path and the Python tier.  (A multiply by the inexact 1e-frac would
+  // double-round and diverge on float halfway points.)
+  const char* p = begin;
+  bool neg = false;
+  if (p < end && (*p == '-' || *p == '+')) {
+    neg = (*p == '-');
+    ++p;
+  }
+  uint64_t mant = 0;
+  int digits = 0, frac = 0;
+  bool dot = false, fast = (p < end);
+  for (; p < end; ++p) {
+    const char c = *p;
+    if (c >= '0' && c <= '9') {
+      if (++digits > 15) { fast = false; break; }
+      mant = mant * 10 + static_cast<uint64_t>(c - '0');
+      if (dot) ++frac;
+    } else if (c == '.' && !dot) {
+      dot = true;
+    } else {
+      fast = false;  // exponent, inf/nan text, junk -> general parser
+      break;
+    }
+  }
+  if (fast && digits > 0) {
+    static const double kPow10[16] = {
+        1e0, 1e1, 1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
+        1e8, 1e9, 1e10, 1e11, 1e12, 1e13, 1e14, 1e15};
+    const double v = static_cast<double>(mant) / kPow10[frac];
+    return static_cast<float>(neg ? -v : v);
+  }
+  return parse_cell_slow(begin, end);
 }
 
 // A line is "blank" (skipped, parity with the Python tier's strip() checks)
