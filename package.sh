@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Build the distributable shifu-tpu wheel + sdist into dist/.
+#
+# Successor of the reference's /package-shifu.sh, which mvn-built the two
+# Maven modules and injected their jars into Shifu's tar.gz distribution
+# (reference: package-shifu.sh:1-53).  Here the whole framework is one
+# Python package (with its C++ sources bundled as package data and compiled
+# on first use), so packaging is a single wheel build; drop the wheel into
+# a Shifu distribution's python path — or `pip install` it — to enable the
+# TPU train/eval backend.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+if python -c "import build" 2>/dev/null; then
+    python -m build --wheel --sdist --no-isolation
+else
+    # minimal environments: wheel via pip (no network, no build isolation)
+    python -m pip wheel . -w dist/ --no-deps --no-build-isolation
+fi
+ls -l dist/

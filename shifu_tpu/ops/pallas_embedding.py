@@ -66,7 +66,7 @@ def _pallas_lookup(table: jax.Array, ids: jax.Array,
         num_scalar_prefetch=1,           # ids (SMEM)
         grid=(b // rows_per_step,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),   # table stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),      # table stays in HBM
         ],
         out_specs=pl.BlockSpec(
             (rows_per_step, nc, dim),
