@@ -1,0 +1,81 @@
+"""Worker process for the true multi-process distributed integration test.
+
+Launched (2x) by tests/test_multiprocess_distributed.py with the
+SHIFU_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID env contract — the same
+contract a real multi-host TPU deployment uses (parallel/distributed.py).
+Each process owns 2 virtual CPU devices; the global mesh spans 4 devices
+across both processes, and gradients all-reduce over gloo — the CPU
+stand-in for the reference's cross-worker gRPC PS aggregation
+(resources/ssgd_monitor.py:136-166) and for ICI collectives on a real slice.
+
+Prints one RESULT line: RESULT {"process": i, "loss": ..., "chief": ...}
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    print("RESULT-SKIP no gloo cpu collectives in this jax build", flush=True)
+    sys.exit(0)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from shifu_tpu.parallel import distributed
+
+
+def main() -> None:
+    assert distributed.initialize(), "env contract must trigger distributed init"
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+    assert jax.local_device_count() == 2, jax.local_device_count()
+
+    import numpy as np
+
+    from shifu_tpu.config import (DataConfig, JobConfig, ModelSpec,
+                                  OptimizerConfig, TrainConfig)
+    from shifu_tpu.data import reader, synthetic
+    from shifu_tpu.parallel import make_mesh, shard_batch
+    from shifu_tpu.config.schema import MeshConfig
+    from shifu_tpu.train import init_state, make_train_step
+
+    schema = synthetic.make_schema(num_features=8)
+    job = JobConfig(
+        schema=schema,
+        data=DataConfig(batch_size=64),
+        model=ModelSpec(model_type="mlp", hidden_nodes=(8,),
+                        activations=("relu",), compute_dtype="float32"),
+        train=TrainConfig(epochs=1, loss="weighted_mse",
+                          optimizer=OptimizerConfig(name="adadelta",
+                                                    learning_rate=0.1)),
+    ).validate()
+
+    mesh = make_mesh(MeshConfig(data=4), jax.devices())
+    state = init_state(job, schema.feature_count, mesh)
+
+    # identical rows on every process: device_put slices out local shards
+    rows = synthetic.make_rows(job.data.batch_size, schema, seed=0)
+    batch = shard_batch(reader.project_columns(rows, schema), mesh)
+
+    step = make_train_step(job, mesh, donate=False)
+    state, metrics = step(state, batch)
+    loss = float(jax.device_get(metrics["loss"]))
+    assert np.isfinite(loss), loss
+
+    distributed.barrier()
+    print("RESULT " + json.dumps({
+        "process": jax.process_index(),
+        "loss": loss,
+        "chief": distributed.is_chief(),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
