@@ -156,11 +156,13 @@ class ModelSpec:
     token_dim: int = 64
     mlp_ratio: int = 4
     dropout_rate: float = 0.0
-    # sequence/context parallelism for the attention blocks: "local" (every
+    # attention implementation for the transformer blocks: "local" (every
     # device holds the full token axis), "ring" (ppermute K/V rotation —
-    # ops/attention.ring_attention), or "ulysses" (all-to-all head scatter —
-    # ops/attention.ulysses_attention).  Takes effect when the training mesh
-    # has a `seq` axis of size > 1; scoring/export always runs local.
+    # ops/attention.ring_attention), "ulysses" (all-to-all head scatter —
+    # ops/attention.ulysses_attention), or "flash" (blockwise Pallas kernel,
+    # O(S) memory — ops/pallas_attention.flash_attention).  ring/ulysses take
+    # effect when the training mesh has a `seq` axis of size > 1; flash is a
+    # per-device kernel choice; scoring/export always runs local.
     attention_impl: str = "local"
     # numerics
     param_dtype: str = "float32"
@@ -177,10 +179,10 @@ class ModelSpec:
                 raise ConfigError(f"unknown activation {a!r}")
         if self.num_heads != len(self.head_names):
             raise ConfigError("num_heads must match len(head_names)")
-        if self.attention_impl not in ("local", "ring", "ulysses"):
+        if self.attention_impl not in ("local", "ring", "ulysses", "flash"):
             raise ConfigError(
                 f"unknown attention_impl {self.attention_impl!r}; "
-                "expected local|ring|ulysses")
+                "expected local|ring|ulysses|flash")
 
 
 # ---------------------------------------------------------------------------

@@ -176,11 +176,16 @@ def run_train(args) -> int:
     else:
         mesh = data_parallel_mesh(n_devices) if n_devices > 1 else None
         devices_in_use = n_devices
-    if job.model.attention_impl != "local" and (
+    if job.model.attention_impl in ("ring", "ulysses") and (
             mesh is None or mesh.shape.get("seq", 1) <= 1):
         board(f"warning: attention_impl={job.model.attention_impl!r} needs a "
               "mesh with a seq axis > 1 (runtime.mesh.seq); falling back to "
               "local attention")
+    if job.model.attention_impl == "flash" and (
+            mesh is not None and mesh.shape.get("seq", 1) > 1):
+        board("warning: attention_impl='flash' is a per-device kernel and "
+              "ignores the mesh seq axis; use 'ring' or 'ulysses' for "
+              "sequence parallelism")
 
     board(f"shifu_tpu train: {job.runtime.app_name} "
           f"devices={devices_in_use}/{n_devices} "

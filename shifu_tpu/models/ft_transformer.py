@@ -23,6 +23,7 @@ from jax.sharding import Mesh
 
 from ..config.schema import ModelSpec
 from ..ops.attention import mha, ring_attention, ulysses_attention
+from ..ops.pallas_attention import flash_attention
 from ..ops.initializers import xavier_uniform
 from ..parallel.mesh import SEQ_AXIS
 from .base import ShifuDense, dtype_of
@@ -59,7 +60,11 @@ class TransformerBlock(nn.Module):
         k = k.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
         v = v.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
         n_sp = _seq_parallel_size(self.mesh)
-        if self.spec.attention_impl != "local" and n_sp > 1:
+        if self.spec.attention_impl == "flash":
+            # blockwise Pallas kernel (O(S) memory per device); orthogonal to
+            # the mesh — with a seq axis use ring/ulysses instead
+            attn = flash_attention(q, k, v)
+        elif self.spec.attention_impl != "local" and n_sp > 1:
             # sequence/context parallelism over the token axis; same math as
             # mha (tests/test_attention.py), collectives over ICI
             if s % n_sp != 0:

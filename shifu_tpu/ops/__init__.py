@@ -2,6 +2,7 @@ from .activations import get_activation, leaky_relu
 from .initializers import bias_init, xavier_bias, xavier_uniform
 from .losses import bce, get_loss, l2_penalty, multitask_loss, weighted_bce, weighted_mse
 from .metrics import auc, weighted_error
+from .pallas_attention import flash_attention
 
 __all__ = [
     "get_activation",
@@ -17,4 +18,5 @@ __all__ = [
     "weighted_mse",
     "auc",
     "weighted_error",
+    "flash_attention",
 ]

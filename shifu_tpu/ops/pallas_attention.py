@@ -1,0 +1,268 @@
+"""Pallas TPU kernel: blockwise flash attention (forward + backward).
+
+Hot-op kernel for the FT-Transformer ladder rung (models/ft_transformer.py)
+and the long-context path: the reference has no attention at all (SURVEY.md
+section 5.7), so this is a new TPU-native capability, not a port.
+
+Kernel design (TPU-first):
+- Forward: grid (B, H, S/Bq).  Each grid step holds one (Bq, D) query block
+  in VMEM and streams (Bk, D) key/value blocks from the per-(b,h) K/V VMEM
+  block, accumulating a numerically-stable streaming softmax (running max m,
+  normalizer l) in float32.  The (S, S) score matrix never materializes —
+  O(S) memory per head, scores tile onto the MXU as (Bq, Bk) matmuls.
+  The log-sum-exp L = m + log(l) is written as a second output (residual for
+  the backward pass, flash-attention style).
+- Backward: the canonical two-kernel flash backward.  `dq` kernel re-walks
+  K/V blocks per query block; `dk`/`dv` kernel re-walks query blocks per K/V
+  block; both recompute p = exp(s - L) from the saved log-sum-exp instead of
+  storing probabilities.  D = rowsum(dO * O) is a cheap elementwise XLA op
+  computed outside the kernels.
+- Sequence lengths that are not multiples of the block size are zero-padded
+  by the wrapper; padded key columns are masked to -1e30 before the softmax
+  (exact zeros after exp), padded query rows are sliced off the outputs and
+  contribute exactly zero to dk/dv (their dO is zero-padded).
+
+CPU/testing: like ops/pallas_embedding.py, the kernels run `interpret=True`
+off-TPU so the same code path is unit-tested on the CPU backend
+(tests/test_pallas_attention.py validates forward and gradients against the
+XLA reference ops/attention.mha).  On the tunneled TPU dev platform Pallas
+cannot compile (hangs at lowering), so TPU execution is opt-in via
+SHIFU_TPU_PALLAS=1; `flash_attention` otherwise routes to `mha`.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .attention import mha
+from .pallas_common import pallas_opt_in
+
+_NEG_BIG = -1e30  # -inf would make fully-masked rows produce NaN (exp(inf-inf))
+
+
+def _pad_seq(x: jax.Array, s_pad: int) -> jax.Array:
+    s = x.shape[2]
+    if s == s_pad:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - s), (0, 0)))
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale: float,
+                s_real: int, block_k: int):
+    """One (Bq, D) query block vs all key blocks of this (b, h)."""
+    qf = q_ref[0, 0].astype(jnp.float32)                     # (Bq, D)
+    bq, d = qf.shape
+    s_pad = k_ref.shape[2]
+    nk = s_pad // block_k
+
+    def step(j, carry):
+        o, m, l = carry
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qf, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # (Bq, Bk)
+        col = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        s = jnp.where(col < s_real, s, _NEG_BIG)
+        blk_max = jnp.max(s, axis=-1, keepdims=True)          # (Bq, 1)
+        new_m = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m)                                # (Bq, Bk)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o, new_m, l
+
+    o0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, nk, step, (o0, m0, l0))
+    l = jnp.maximum(l, 1e-30)  # fully-padded query rows (sliced off later)
+    o_ref[0, 0] = (o / l).astype(o_ref.dtype)
+    l_ref[0, 0] = (m + jnp.log(l))[:, 0]                      # log-sum-exp
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dres_ref, dq_ref, *,
+               scale: float, s_real: int, block_k: int):
+    qf = q_ref[0, 0].astype(jnp.float32)                      # (Bq, D)
+    dof = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]                              # (Bq, 1)
+    dres = dres_ref[0, 0][:, None]
+    bq, d = qf.shape
+    nk = k_ref.shape[2] // block_k
+
+    def step(j, dq):
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qf, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        col = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        s = jnp.where(col < s_real, s, _NEG_BIG)
+        p = jnp.exp(s - lse)                                  # (Bq, Bk)
+        dp = jax.lax.dot_general(
+            dof, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (Bq, Bk)
+        ds = p * (dp - dres)
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nk, step, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dres_ref,
+                dk_ref, dv_ref, *, scale: float, s_real: int, block_q: int):
+    k_blk = k_ref[0, 0].astype(jnp.float32)                   # (Bk, D)
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    bk, d = k_blk.shape
+    j = pl.program_id(2)
+    col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)  # (1, Bk)
+    nq = q_ref.shape[2] // block_q
+
+    def step(i, carry):
+        dk, dv = carry
+        qf = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dof = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        dres = dres_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(
+            qf, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # (Bq, Bk)
+        s = jnp.where(col < s_real, s, _NEG_BIG)
+        p = jnp.exp(s - lse)
+        dv = dv + jax.lax.dot_general(                        # p^T @ dO
+            p, dof, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            dof, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dres)
+        dk = dk + jax.lax.dot_general(                        # ds^T @ q
+            ds, qf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, nq, step, (z, z))
+    dk_ref[0, 0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _plan(s: int, block_q: int, block_k: int) -> tuple:
+    """(bq, bk, s_pad): clamp blocks to the sequence length and pad S to a
+    common multiple of BOTH block sizes — s_pad must divide evenly into
+    query-grid steps AND key-loop steps or blocks silently go missing."""
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    step = math.lcm(bq, bk)
+    s_pad = -(-s // step) * step
+    return bq, bk, s_pad
+
+
+def _flash_fwd_impl(q, k, v, scale, interpret, block_q, block_k):
+    b, h, s, d = q.shape
+    bq, bk, s_pad = _plan(s, block_q, block_k)
+    qp, kp, vp = (_pad_seq(x, s_pad) for x in (q, k, v))
+
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0))
+    kvspec = pl.BlockSpec((1, 1, s_pad, d), lambda b_, h_, i: (b_, h_, 0, 0))
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, s_real=s, block_k=bk),
+        grid=(b, h, s_pad // bq),
+        in_specs=[qspec, kvspec, kvspec],
+        out_specs=[qspec,
+                   pl.BlockSpec((1, 1, bq), lambda b_, h_, i: (b_, h_, i))],
+        out_shape=[jax.ShapeDtypeStruct((b, h, s_pad, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, s_pad), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :, :s, :], lse
+
+
+def _flash_bwd_impl(q, k, v, out, lse, g, scale, interpret, block_q, block_k):
+    b, h, s, d = q.shape
+    bq, bk, s_pad = _plan(s, block_q, block_k)
+    qp, kp, vp, op, gp = (_pad_seq(x, s_pad) for x in (q, k, v, out, g))
+    lsep = (lse if lse.shape[2] == s_pad else
+            jnp.pad(lse, ((0, 0), (0, 0), (0, s_pad - s))))
+    # D_i = rowsum(dO_i * O_i): elementwise, XLA fuses it; zero on padded rows
+    dres = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32), axis=-1)
+
+    full = pl.BlockSpec((1, 1, s_pad, d), lambda b_, h_, i: (b_, h_, 0, 0))
+    fullv = pl.BlockSpec((1, 1, s_pad), lambda b_, h_, i: (b_, h_, 0))
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0))
+    qvec = pl.BlockSpec((1, 1, bq), lambda b_, h_, i: (b_, h_, i))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, s_real=s, block_k=bk),
+        grid=(b, h, s_pad // bq),
+        in_specs=[qspec, full, full, qspec, qvec, qvec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b, h, s_pad, d), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp, gp, lsep, dres)
+
+    kspec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j: (b_, h_, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, s_real=s, block_q=bq),
+        grid=(b, h, s_pad // bk),
+        in_specs=[full, kspec, kspec, full, fullv, fullv],
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, s_pad, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, s_pad, d), v.dtype)],
+        interpret=interpret,
+    )(qp, kp, vp, gp, lsep, dres)
+    return (dq[:, :, :s, :], dk[:, :, :s, :], dv[:, :, :s, :])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, interpret, block_q, block_k):
+    out, _ = _flash_fwd_impl(q, k, v, scale, interpret, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, interpret, block_q, block_k):
+    out, lse = _flash_fwd_impl(q, k, v, scale, interpret, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, interpret, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    return _flash_bwd_impl(q, k, v, out, lse, g, scale, interpret,
+                           block_q, block_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    scale: Optional[float] = None,
+                    use_pallas: Optional[bool] = None,
+                    block_q: int = 128, block_k: int = 128) -> jax.Array:
+    """Blockwise flash attention.  q,k,v: (B, H, S, D) -> (B, H, S, D).
+
+    Same math as ops/attention.mha (float32 streaming softmax), O(S) memory
+    per head instead of O(S^2).  Differentiable (flash backward kernels).
+
+    use_pallas: None = auto (SHIFU_TPU_PALLAS=1 opt-in, like
+    ops/pallas_embedding.py); True forces the kernels (interpret mode
+    off-TPU); False routes to the XLA reference `mha`.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    on_tpu = jax.default_backend() == "tpu"
+    if use_pallas is None:
+        use_pallas = pallas_opt_in()
+    if not use_pallas:
+        return mha(q, k, v, scale=scale)
+    return _flash(q, k, v, scale, not on_tpu, block_q, block_k)

@@ -1,0 +1,19 @@
+"""Shared gating for the opt-in Pallas kernels.
+
+The tunneled TPU dev platform cannot compile Pallas (hangs at lowering), so
+kernels default OFF and engage only when SHIFU_TPU_PALLAS is set truthy.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pallas_opt_in() -> bool:
+    """True when the user opted into the Pallas kernels.
+
+    "0", "false", "" and unset all mean off — so SHIFU_TPU_PALLAS=0
+    explicitly disables (a bare bool(getenv) would read "0" as on).
+    """
+    return os.environ.get("SHIFU_TPU_PALLAS", "").lower() not in (
+        "", "0", "false", "no")

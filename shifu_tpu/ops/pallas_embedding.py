@@ -100,7 +100,7 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
 
 
 def _forward(table, ids, use_pallas):
-    import os
+    from .pallas_common import pallas_opt_in
 
     on_tpu = jax.default_backend() == "tpu"
     if use_pallas is None:
@@ -108,7 +108,7 @@ def _forward(table, ids, use_pallas):
         # mode on CPU, but the tunneled TPU platform this framework is
         # developed against cannot compile Pallas kernels (hangs at lowering),
         # so native-TPU validation is deferred to real-slice runs.
-        use_pallas = bool(os.environ.get("SHIFU_TPU_PALLAS")) and pltpu is not None
+        use_pallas = pallas_opt_in() and pltpu is not None
     if use_pallas and pltpu is not None:
         return _pallas_lookup(table, ids.astype(jnp.int32), interpret=not on_tpu)
     return _xla_lookup(table, ids.astype(jnp.int32))
