@@ -112,6 +112,12 @@ class DataConfig:
     # bytes of HBM, transfer it once and reorder batches on device each epoch
     # (zero steady-state H2D).  0 disables.
     device_resident_bytes: int = 2 << 30
+    # parse-once columnar cache directory (data/cache.py); None defers to the
+    # SHIFU_TPU_DATA_CACHE env var, empty-or-unset means no cache.
+    cache_dir: str | None = None
+    # file-level read parallelism for load_datasets; 0 = one thread per file
+    # capped at cpu_count.
+    read_threads: int = 0
 
     def validate(self) -> None:
         if not (0.0 <= self.valid_ratio < 1.0):

@@ -1,3 +1,4 @@
+from .cache import read_file_cached, resolve_cache_dir
 from .pipeline import TabularDataset, batch_iterator, load_datasets, num_batches, pad_to_batch
 from .reader import (
     count_rows,
@@ -6,6 +7,7 @@ from .reader import (
     parse_rows,
     project_columns,
     read_file,
+    read_files,
     shard_paths,
 )
 from .split import bagging_mask, row_uniform, train_valid_mask
@@ -22,6 +24,9 @@ __all__ = [
     "parse_rows",
     "project_columns",
     "read_file",
+    "read_files",
+    "read_file_cached",
+    "resolve_cache_dir",
     "shard_paths",
     "bagging_mask",
     "row_uniform",

@@ -59,11 +59,15 @@ def load_datasets(
     feats, targs, weights, masks_v = [], [], [], []
     # global row ids must be stable across hosts: derive from (file idx, row idx);
     # shard by index so duplicate path strings still get distinct ids
-    for file_idx, path in enumerate(paths):
-        if file_idx % num_hosts != host_index:
-            continue
-        rows = reader.read_file(path, data.delimiter)
+    mine = [(i, p) for i, p in enumerate(paths) if i % num_hosts == host_index]
+    parsed = reader.read_files(
+        [p for _, p in mine], data.delimiter,
+        cache_dir=data.cache_dir,
+        num_threads=(data.read_threads or None))
+    for pos, (file_idx, path) in enumerate(mine):
+        rows, parsed[pos] = parsed[pos], None  # release raw matrix after projection
         cols = reader.project_columns(rows, schema)
+        del rows
         n = cols["features"].shape[0]
         row_ids = (np.uint64(file_idx) << np.uint64(40)) + np.arange(n, dtype=np.uint64)
         _, valid_mask = split.train_valid_mask(row_ids, data.valid_ratio, data.split_seed)

@@ -46,6 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="limit device count (0 = all)")
     t.add_argument("--epochs", type=int, default=0, help="override epochs")
     t.add_argument("--batch-size", type=int, default=0, help="override batch size")
+    t.add_argument("--cache-dir", default=None,
+                   help="parse-once columnar data cache dir (also via "
+                        "SHIFU_TPU_DATA_CACHE)")
     t.add_argument("--timeout", type=int, default=0,
                    help="job timeout seconds (0 = none)")
     t.add_argument("--supervise", action="store_true",
@@ -101,6 +104,8 @@ def _assemble_job(args) -> "JobConfig":
     data = job.data
     if args.batch_size:
         data = dataclasses.replace(data, batch_size=args.batch_size)
+    if getattr(args, "cache_dir", None):
+        data = dataclasses.replace(data, cache_dir=args.cache_dir)
     runtime = job.runtime
     if args.timeout:
         runtime = dataclasses.replace(runtime, timeout_seconds=args.timeout)
@@ -143,7 +148,8 @@ def run_train(args) -> int:
         if args.globalconfig:
             child_args += ["--globalconfig", args.globalconfig]
         for flag, val in (("--devices", args.devices), ("--epochs", args.epochs),
-                          ("--batch-size", args.batch_size), ("--timeout", args.timeout)):
+                          ("--batch-size", args.batch_size), ("--timeout", args.timeout),
+                          ("--cache-dir", getattr(args, "cache_dir", None))):
             if val:
                 child_args += [flag, str(val)]
         return supervise(child_args, max_restarts=max_restarts,

@@ -1,0 +1,139 @@
+"""Columnar parse-once cache (shifu_tpu/data/cache.py) + parallel reads.
+
+The cache is SURVEY.md §7.3 #1's "pre-parsed intermediate": first read
+parses, later reads np.load at IO bandwidth.  Correctness contract: a cache
+hit returns bit-identical arrays to a fresh parse, stale/corrupt entries are
+never served, and every failure path falls back to parsing.
+"""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.data import read_file, read_file_cached, read_files
+from shifu_tpu.data import cache as cache_mod
+from shifu_tpu.data import synthetic
+
+
+def _write_gz(path, rows):
+    text = "\n".join("|".join(f"{v:.6g}" for v in r) for r in rows) + "\n"
+    with gzip.open(path, "wt") as f:
+        f.write(text)
+
+
+@pytest.fixture
+def data_file(tmp_path):
+    rng = np.random.default_rng(0)
+    rows = rng.standard_normal((64, 7)).astype(np.float32)
+    p = str(tmp_path / "part-0000.gz")
+    _write_gz(p, rows)
+    return p
+
+
+def test_cache_miss_then_hit_identical(data_file, tmp_path):
+    cdir = str(tmp_path / "cache")
+    fresh = read_file(data_file)
+    first = read_file_cached(data_file, cache_dir=cdir)   # miss: parses+writes
+    entries = [f for f in os.listdir(cdir) if f.endswith(".npy")]
+    assert len(entries) == 1
+    second = read_file_cached(data_file, cache_dir=cdir)  # hit: np.load
+    np.testing.assert_array_equal(first, fresh)
+    np.testing.assert_array_equal(second, fresh)
+
+
+def test_cache_off_without_dir(data_file, monkeypatch):
+    monkeypatch.delenv(cache_mod.ENV_CACHE_DIR, raising=False)
+    arr = read_file_cached(data_file)  # no cache_dir anywhere: plain parse
+    assert arr.shape == (64, 7)
+
+
+def test_cache_env_var_enables(data_file, tmp_path, monkeypatch):
+    cdir = str(tmp_path / "envcache")
+    monkeypatch.setenv(cache_mod.ENV_CACHE_DIR, cdir)
+    read_file_cached(data_file)
+    assert any(f.endswith(".npy") for f in os.listdir(cdir))
+
+
+def test_modified_source_invalidates(data_file, tmp_path):
+    cdir = str(tmp_path / "cache")
+    read_file_cached(data_file, cache_dir=cdir)
+    rows2 = np.full((8, 7), 3.25, np.float32)
+    _write_gz(data_file, rows2)
+    os.utime(data_file, ns=(1, 1))  # force a distinct mtime even on coarse clocks
+    arr = read_file_cached(data_file, cache_dir=cdir)
+    np.testing.assert_array_equal(arr, rows2)
+    # superseded entry pruned: one .npy remains
+    assert len([f for f in os.listdir(cdir) if f.endswith(".npy")]) == 1
+
+
+def test_corrupt_entry_falls_back_to_parse(data_file, tmp_path):
+    cdir = str(tmp_path / "cache")
+    read_file_cached(data_file, cache_dir=cdir)
+    (entry,) = [f for f in os.listdir(cdir) if f.endswith(".npy")]
+    with open(os.path.join(cdir, entry), "wb") as f:
+        f.write(b"not an npy file")
+    arr = read_file_cached(data_file, cache_dir=cdir)
+    np.testing.assert_array_equal(arr, read_file(data_file))
+
+
+def test_unwritable_cache_dir_still_reads(data_file, tmp_path):
+    cdir = tmp_path / "ro"
+    cdir.mkdir()
+    os.chmod(cdir, 0o500)
+    try:
+        arr = read_file_cached(data_file, cache_dir=str(cdir))
+        assert arr.shape == (64, 7)
+    finally:
+        os.chmod(cdir, 0o700)
+
+
+def test_mmap_hit_is_readonly_view(data_file, tmp_path):
+    cdir = str(tmp_path / "cache")
+    read_file_cached(data_file, cache_dir=cdir)
+    arr = read_file_cached(data_file, cache_dir=cdir, mmap=True)
+    assert isinstance(arr, np.memmap)
+    np.testing.assert_array_equal(np.asarray(arr), read_file(data_file))
+    with pytest.raises(ValueError):
+        arr[0, 0] = 1.0
+
+
+def test_missing_file_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        read_file_cached(str(tmp_path / "nope.gz"), cache_dir=str(tmp_path / "c"))
+
+
+def test_read_files_parallel_order_and_cache(tmp_path):
+    schema = synthetic.make_schema(num_features=5)
+    rows = synthetic.make_rows(1000, schema, seed=3)
+    paths = synthetic.write_files(rows, str(tmp_path / "d"), num_files=4)
+    seq = [read_file(p) for p in paths]
+    par = read_files(paths, num_threads=4, cache_dir=str(tmp_path / "cache"))
+    for a, b in zip(seq, par):
+        np.testing.assert_array_equal(a, b)
+    # second pass serves from cache, same arrays
+    par2 = read_files(paths, num_threads=4, cache_dir=str(tmp_path / "cache"))
+    for a, b in zip(seq, par2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_load_datasets_with_cache_matches_uncached(tmp_path):
+    from shifu_tpu.config import DataConfig
+    from shifu_tpu.data import load_datasets
+
+    schema = synthetic.make_schema(num_features=6)
+    rows = synthetic.make_rows(500, schema, seed=5)
+    paths = synthetic.write_files(rows, str(tmp_path / "d"), num_files=3)
+    base = DataConfig(paths=tuple(paths), batch_size=32)
+    cached = DataConfig(paths=tuple(paths), batch_size=32,
+                        cache_dir=str(tmp_path / "cache"), read_threads=3)
+    t0, v0 = load_datasets(schema, base)
+    t1, v1 = load_datasets(schema, cached)   # populates cache
+    t2, v2 = load_datasets(schema, cached)   # serves from cache
+    for a, b in ((t0, t1), (t0, t2)):
+        np.testing.assert_array_equal(a.features, b.features)
+        np.testing.assert_array_equal(a.target, b.target)
+        np.testing.assert_array_equal(a.weight, b.weight)
+    np.testing.assert_array_equal(v0.features, v1.features)
+    np.testing.assert_array_equal(v0.features, v2.features)

@@ -66,6 +66,17 @@ def test_flash_under_jit_and_vmap_composition():
     np.testing.assert_allclose(np.asarray(f(q, k, v)),
                                np.asarray(mha(q, k, v)),
                                rtol=2e-5, atol=2e-6)
+    # vmap over an extra leading axis: the interpret-mode pallas_call +
+    # custom_vjp pair must batch, not just jit
+    Q = jnp.stack([q, q * 0.5])
+    K = jnp.stack([k, k])
+    V = jnp.stack([v, v * 2.0])
+    vf = jax.vmap(lambda q, k, v: flash_attention(
+        q, k, v, use_pallas=True, block_q=32, block_k=32))
+    vref = jax.vmap(lambda q, k, v: mha(q, k, v))
+    np.testing.assert_allclose(np.asarray(vf(Q, K, V)),
+                               np.asarray(vref(Q, K, V)),
+                               rtol=2e-5, atol=2e-6)
 
 
 @pytest.mark.parametrize("bq,bk", [(96, 64), (64, 96), (32, 48)])
