@@ -49,12 +49,30 @@ def _sha1(text: str) -> str:
     return hashlib.sha1(text.encode()).hexdigest()
 
 
-def cache_entry_name(path: str, delimiter: str) -> str:
-    """Deterministic cache file name for `path`'s current on-disk state."""
-    st = os.stat(path)
-    path_part = _sha1(os.path.abspath(path))[:16]
+def cache_entry_name(path: str, delimiter: str) -> Optional[str]:
+    """Deterministic cache file name for `path`'s current state, or None when
+    the file is uncacheable.
+
+    Local paths key on os.stat; remote URIs (hdfs/gs/s3/file) key on the
+    filesystem's (size, mtime) metadata — so the cache also turns remote
+    ingest into a local mmap-speed read after the first fetch.  A filesystem
+    that reports no size or mtime returns None: keying on a constant would
+    serve stale entries after an in-place overwrite, so such files are simply
+    never cached.
+    """
+    from . import fsio
+
+    if fsio.is_remote(path):
+        size, mtime_ns = fsio.file_info(path)
+        if size is None or mtime_ns is None:
+            return None
+        path_part = _sha1(path)[:16]
+    else:
+        st = os.stat(path)
+        size, mtime_ns = st.st_size, st.st_mtime_ns
+        path_part = _sha1(os.path.abspath(path))[:16]
     meta_part = _sha1(
-        f"{st.st_size}:{st.st_mtime_ns}:{delimiter}:{CACHE_FORMAT_VERSION}")[:16]
+        f"{size}:{mtime_ns}:{delimiter}:{CACHE_FORMAT_VERSION}")[:16]
     return f"{path_part}-{meta_part}.npy"
 
 
@@ -63,6 +81,7 @@ def read_file_cached(
     delimiter: str = "|",
     cache_dir: Optional[str] = None,
     mmap: bool = False,
+    parser_threads: Optional[int] = None,
 ) -> np.ndarray:
     """`reader.read_file` with a parse-once cache in front.
 
@@ -74,9 +93,11 @@ def read_file_cached(
 
     cache_dir = resolve_cache_dir(cache_dir)
     if cache_dir is None:
-        return reader.read_file(path, delimiter)
+        return reader.read_file(path, delimiter, parser_threads=parser_threads)
 
     name = cache_entry_name(path, delimiter)  # stats the source: IO errors propagate
+    if name is None:  # no trustworthy (size, mtime) key: don't cache
+        return reader.read_file(path, delimiter, parser_threads=parser_threads)
     entry = os.path.join(cache_dir, name)
     if os.path.exists(entry):
         try:
@@ -90,7 +111,7 @@ def read_file_cached(
         except OSError:
             pass
 
-    arr = reader.read_file(path, delimiter)
+    arr = reader.read_file(path, delimiter, parser_threads=parser_threads)
     _write_entry(cache_dir, name, arr)
     if mmap:
         try:

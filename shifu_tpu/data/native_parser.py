@@ -94,11 +94,15 @@ def _delim_byte(delimiter: str) -> bytes:
     return b
 
 
-def parse_file(path: str, delimiter: str = "|") -> np.ndarray:
+def parse_file(path: str, delimiter: str = "|",
+               threads: Optional[int] = None) -> np.ndarray:
     """Parse a (possibly gzipped) delimited file into (N, C) float32.
 
-    Raises FileNotFoundError/OSError for IO problems (matching the Python
-    tier), ValueError for multi-byte delimiters, RuntimeError otherwise.
+    `threads` overrides the intra-file parse parallelism (None = env var /
+    hardware_concurrency; callers doing file-level threading pass 1 to avoid
+    cores^2 oversubscription).  Raises FileNotFoundError/OSError for IO
+    problems (matching the Python tier), ValueError for multi-byte
+    delimiters, RuntimeError otherwise.
     """
     lib = _load()
     if lib is None:
@@ -108,7 +112,8 @@ def parse_file(path: str, delimiter: str = "|") -> np.ndarray:
     rows_p = ctypes.c_int64(0)
     cols_p = ctypes.c_int64(0)
     rc = lib.shifu_parse_file(
-        path.encode(), delim, _num_threads(),
+        path.encode(), delim,
+        _num_threads() if threads is None else int(threads),
         ctypes.byref(out_pp), ctypes.byref(rows_p), ctypes.byref(cols_p))
     if rc == 4:
         if not os.path.exists(path):
@@ -121,7 +126,8 @@ def parse_file(path: str, delimiter: str = "|") -> np.ndarray:
     return _take(lib, out_pp, rows_p, cols_p)
 
 
-def parse_buffer(text: bytes, delimiter: str = "|") -> np.ndarray:
+def parse_buffer(text: bytes, delimiter: str = "|",
+                 threads: Optional[int] = None) -> np.ndarray:
     """Parse an in-memory delimited text buffer into (N, C) float32."""
     lib = _load()
     if lib is None:
@@ -131,7 +137,8 @@ def parse_buffer(text: bytes, delimiter: str = "|") -> np.ndarray:
     rows_p = ctypes.c_int64(0)
     cols_p = ctypes.c_int64(0)
     rc = lib.shifu_parse_buffer(
-        text, len(text), delim, _num_threads(),
+        text, len(text), delim,
+        _num_threads() if threads is None else int(threads),
         ctypes.byref(out_pp), ctypes.byref(rows_p), ctypes.byref(cols_p))
     if rc != 0:
         raise RuntimeError(f"shifu_parse_buffer failed rc={rc}")

@@ -11,6 +11,7 @@ so a restart resumes with identical batch order.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Iterator, Optional, Sequence
 
 import numpy as np
@@ -56,21 +57,36 @@ def load_datasets(
     for p in data.paths:
         paths.extend(reader.list_data_files(p))
 
-    feats, targs, weights, masks_v = [], [], [], []
     # global row ids must be stable across hosts: derive from (file idx, row idx);
     # shard by index so duplicate path strings still get distinct ids
     mine = [(i, p) for i, p in enumerate(paths) if i % num_hosts == host_index]
-    parsed = reader.read_files(
-        [p for _, p in mine], data.delimiter,
-        cache_dir=data.cache_dir,
-        num_threads=(data.read_threads or None))
-    for pos, (file_idx, path) in enumerate(mine):
-        rows, parsed[pos] = parsed[pos], None  # release raw matrix after projection
+    num_threads = data.read_threads or min(len(mine), os.cpu_count() or 1)
+    threaded = num_threads > 1 and len(mine) > 1
+
+    def load_one(item: tuple[int, str]):
+        """Parse + project + split ONE file; the raw (N, C) matrix dies here,
+        so peak memory is (in-flight raw files) + (projected columns), never
+        all raw matrices at once."""
+        from .cache import read_file_cached
+        file_idx, path = item
+        rows = read_file_cached(
+            path, data.delimiter, cache_dir=data.cache_dir,
+            parser_threads=1 if threaded else None)
         cols = reader.project_columns(rows, schema)
-        del rows
         n = cols["features"].shape[0]
         row_ids = (np.uint64(file_idx) << np.uint64(40)) + np.arange(n, dtype=np.uint64)
         _, valid_mask = split.train_valid_mask(row_ids, data.valid_ratio, data.split_seed)
+        return cols, valid_mask
+
+    if threaded:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=num_threads) as pool:
+            results = list(pool.map(load_one, mine))  # map preserves file order
+    else:
+        results = [load_one(m) for m in mine]
+
+    feats, targs, weights, masks_v = [], [], [], []
+    for cols, valid_mask in results:
         feats.append(cols["features"])
         targs.append(cols["target"])
         weights.append(cols["weight"])

@@ -109,17 +109,48 @@ def _parse_ragged(text: str, delimiter: str, ncols: int) -> np.ndarray:
     return np.stack(rows)
 
 
-def read_file(path: str, delimiter: str = "|") -> np.ndarray:
+def _fetch_decompressed(path: str) -> bytes:
+    """Remote fetch + gzip-magic decompress (the one place both live)."""
+    from . import fsio
+    raw = fsio.read_bytes(path)
+    if raw[:2] == b"\x1f\x8b":
+        raw = gzip.decompress(raw)
+    return raw
+
+
+def _parse_bytes(raw: bytes, delimiter: str,
+                 parser_threads: Optional[int] = None) -> np.ndarray:
+    """Tier selection for an in-memory buffer: native C++ parse when
+    available, vectorized numpy otherwise (identical outputs, tested)."""
+    from . import native_parser
+    if len(delimiter.encode()) == 1 and native_parser.available():
+        try:
+            return native_parser.parse_buffer(raw, delimiter,
+                                              threads=parser_threads)
+        except RuntimeError:
+            pass
+    return parse_rows(raw, delimiter)
+
+
+def read_file(path: str, delimiter: str = "|",
+              parser_threads: Optional[int] = None) -> np.ndarray:
     """Read one (possibly gzipped) pipe-delimited file into (N, C) float32.
 
     Uses the native C++ parser (zlib + from_chars, multi-threaded —
     data/native_parser.py) when buildable; the vectorized numpy path above is
-    the fallback.  Both produce identical arrays (tested).
+    the fallback.  Both produce identical arrays (tested).  hdfs:// gs://
+    s3:// file:// URIs fetch through pyarrow.fs (data/fsio.py) and parse with
+    the same tiers.  `parser_threads` caps intra-file parse threads (file-
+    level threading passes 1 so parallelism stays ~cores, not cores^2).
     """
-    from . import native_parser
+    from . import fsio, native_parser
+    if fsio.is_remote(path):
+        return _parse_bytes(_fetch_decompressed(path), delimiter,
+                            parser_threads)
     if len(delimiter.encode()) == 1 and native_parser.available():
         try:
-            return native_parser.parse_file(path, delimiter)
+            return native_parser.parse_file(path, delimiter,
+                                            threads=parser_threads)
         except RuntimeError:  # engine-internal failure: numpy tier serves
             pass  # (IO errors — FileNotFoundError/OSError — propagate)
     with open_maybe_gzip(path) as f:
@@ -139,17 +170,26 @@ def read_files(
     numpy/pandas C parsing) runs outside the GIL, so file-level threading
     scales ingest with cores — the multi-host analog of the reference giving
     each worker its own file shard (yarn/appmaster/TrainingDataSet.java:65-82),
-    applied *within* a host.  With `cache_dir`, each file goes through the
-    parse-once columnar cache (data/cache.py).
+    applied *within* a host.  When file-level threading is active, each parse
+    runs single-threaded internally (parallelism ~cores, not cores^2).  With
+    `cache_dir`, each file goes through the parse-once columnar cache
+    (data/cache.py).
+
+    Note this returns every raw matrix at once; memory-conscious consumers
+    that reduce per file (e.g. load_datasets' projection) should thread the
+    reduction themselves rather than call this.
     """
     from .cache import read_file_cached
 
-    def one(p: str) -> np.ndarray:
-        return read_file_cached(p, delimiter, cache_dir=cache_dir)
-
     if num_threads is None:
         num_threads = min(len(paths), os.cpu_count() or 1)
-    if num_threads <= 1 or len(paths) <= 1:
+    threaded = num_threads > 1 and len(paths) > 1
+
+    def one(p: str) -> np.ndarray:
+        return read_file_cached(p, delimiter, cache_dir=cache_dir,
+                                parser_threads=1 if threaded else None)
+
+    if not threaded:
         return [one(p) for p in paths]
     from concurrent.futures import ThreadPoolExecutor
     with ThreadPoolExecutor(max_workers=num_threads) as pool:
@@ -162,10 +202,14 @@ def count_rows(paths: Sequence[str]) -> int:
     Successor of the reference's TOTAL_TRAINING_DATA_NUMBER computation
     (yarn/util/HdfsUtils.java:143-175 getFileLineCount).
     """
-    from . import native_parser
+    from . import fsio, native_parser
     use_native = native_parser.available()
     total = 0
     for p in paths:
+        if fsio.is_remote(p):
+            raw = _fetch_decompressed(p)
+            total += sum(1 for line in raw.split(b"\n") if line.strip())
+            continue
         if use_native:
             try:
                 total += native_parser.count_rows(p)
@@ -183,8 +227,12 @@ def list_data_files(root: str) -> list[str]:
     """List data files under a directory, skipping '.'/'_' prefixed names.
 
     Mirrors the reference's HDFS listing filter
-    (yarn/appmaster/TrainingDataSet.java:69-71).
+    (yarn/appmaster/TrainingDataSet.java:69-71).  hdfs:// gs:// s3:// file://
+    URIs list through pyarrow.fs with the same filter (data/fsio.py).
     """
+    from . import fsio
+    if fsio.is_remote(root):
+        return fsio.list_files(root)
     if os.path.isfile(root):
         return [root]
     out = []
