@@ -118,6 +118,11 @@ class DataConfig:
     # file-level read parallelism for load_datasets; 0 = one thread per file
     # capped at cpu_count.
     read_threads: int = 0
+    # out-of-core mode: consolidate the host shard into on-disk projected
+    # arrays once (requires cache_dir) and train from read-only memmaps —
+    # host shards larger than RAM stream through the staged tier
+    # (data/outofcore.py).
+    out_of_core: bool = False
 
     def validate(self) -> None:
         if not (0.0 <= self.valid_ratio < 1.0):

@@ -49,10 +49,13 @@ _BUCKET_SCHEMES = ("gs", "gcs", "s3", "mock")
 
 
 def _derive_fs_path(scheme: str, rest: str) -> str:
+    # from_uri percent-decodes the path component; match it so a cache hit
+    # yields exactly the path from_uri would have produced
+    from urllib.parse import unquote
     if scheme in _BUCKET_SCHEMES:
-        return rest
+        return unquote(rest)
     slash = rest.find("/")
-    return rest[slash:] if slash >= 0 else "/"
+    return unquote(rest[slash:]) if slash >= 0 else "/"
 
 
 def _filesystem(path: str) -> Tuple["object", str]:
@@ -93,9 +96,8 @@ def file_info(path: str) -> Tuple[Optional[int], Optional[int]]:
     substitute a constant (a constant key would serve stale data after an
     in-place overwrite).
     """
+    filesystem, fs_path = _filesystem(path)  # guards the pyarrow import
     from pyarrow import fs as pafs
-
-    filesystem, fs_path = _filesystem(path)
     info = filesystem.get_file_info(fs_path)
     if info.type == pafs.FileType.NotFound:
         raise FileNotFoundError(f"no such data file: {path}")
@@ -106,9 +108,8 @@ def file_info(path: str) -> Tuple[Optional[int], Optional[int]]:
 
 def read_bytes(path: str) -> bytes:
     """Fetch a remote file's raw bytes (gzip detection happens downstream)."""
+    filesystem, fs_path = _filesystem(path)  # guards the pyarrow import
     from pyarrow import fs as pafs
-
-    filesystem, fs_path = _filesystem(path)
     try:
         with filesystem.open_input_stream(fs_path) as stream:
             return stream.read()
@@ -123,15 +124,64 @@ def read_bytes(path: str) -> bytes:
         raise
 
 
+def count_data_lines(path: str, chunk_bytes: int = 1 << 20) -> int:
+    """Count non-blank lines of a (possibly gzipped) remote file, streaming —
+    constant memory regardless of file size (the local analog streams too,
+    reader.count_rows)."""
+    import zlib
+
+    filesystem, fs_path = _filesystem(path)
+    from pyarrow import fs as pafs
+
+    count = 0
+    line_has_content = False
+
+    def feed(data: bytes) -> None:
+        # count newline-terminated non-blank lines; carry blank/content state
+        # across chunk borders
+        nonlocal count, line_has_content
+        parts = data.split(b"\n")
+        for piece in parts[:-1]:
+            if line_has_content or piece.strip():
+                count += 1
+            line_has_content = False
+        if parts[-1].strip():
+            line_has_content = True
+
+    decomp = None
+    first = True
+    try:
+        stream = filesystem.open_input_stream(fs_path)
+    except Exception as e:
+        info = filesystem.get_file_info(fs_path)
+        if info.type == pafs.FileType.NotFound:
+            raise FileNotFoundError(f"no such data file: {path}") from e
+        raise
+    with stream:
+        while True:
+            chunk = stream.read(chunk_bytes)
+            if not chunk:
+                break
+            if first:
+                first = False
+                if bytes(chunk[:2]) == b"\x1f\x8b":
+                    decomp = zlib.decompressobj(wbits=31)  # gzip wrapper
+            feed(decomp.decompress(bytes(chunk)) if decomp else bytes(chunk))
+    if decomp:
+        feed(decomp.flush())
+    if line_has_content:
+        count += 1  # final unterminated line
+    return count
+
+
 def list_files(root: str) -> list[str]:
     """List data files under a remote directory (or [root] for a file),
     skipping '.'/'_' prefixed names — the same filter as the local lister and
     the reference's HDFS listing (yarn/appmaster/TrainingDataSet.java:69-71).
     Returned paths keep the original scheme so downstream reads route back
     through pyarrow."""
+    filesystem, fs_path = _filesystem(root)  # guards the pyarrow import
     from pyarrow import fs as pafs
-
-    filesystem, fs_path = _filesystem(root)
     info = filesystem.get_file_info(fs_path)
     if info.type == pafs.FileType.NotFound:
         raise FileNotFoundError(f"no such data path: {root}")

@@ -1,0 +1,242 @@
+"""Out-of-core datasets: train host shards bigger than RAM.
+
+The reference loads every row into Python lists, capping the dataset at
+worker memory (resources/ssgd_monitor.py:354-361, 10 GB default containers).
+Here a host shard that exceeds RAM is consolidated ONCE into on-disk
+projected arrays (features/target/weight, train and valid pre-split) and
+memory-mapped thereafter: `TabularDataset` holds read-only `np.memmap`s, the
+staged-blocks tier gathers whole batches from them (sequential page-ins), and
+the prefetch thread overlaps that disk IO with device compute.  Steady-state
+epochs therefore stream from local disk at page-cache speed with no parse,
+no decompress, and no RAM-resident copy of the dataset.
+
+Layout per consolidated entry (directory named by a content key):
+    meta.json              row counts + the build inputs (debuggability)
+    train_features.npy     (Ntr, F) float32   written via open_memmap
+    train_target.npy       (Ntr, H)
+    train_weight.npy       (Ntr, 1)
+    valid_features.npy     (Nva, F)
+    valid_target.npy       (Nva, H)
+    valid_weight.npy       (Nva, 1)
+
+The content key covers each source file's per-file cache identity
+(path+size+mtime, data/cache.py), the column projection, split config, write
+permutation seed, and host shard — any change rebuilds.  Builds are atomic
+(tmp dir + os.replace), so a killed build never leaves a servable half-entry.
+
+Row-order note: the in-RAM loader applies a one-time global row permutation
+to the training partition; scattering rows across a disk file would be random
+IO, so here the write permutes at *chunk* granularity across files (plus
+within-chunk row shuffles), and the per-epoch batch-order shuffle of the
+staged tier sits on top — the standard out-of-core approximation to global
+shuffling.  Validation rows are written in file order, matching the in-RAM
+loader exactly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+from ..config.schema import DataConfig, DataSchema
+from . import cache as cache_mod
+from . import reader, split
+
+OUT_OF_CORE_VERSION = 1
+
+# rows per write chunk: big enough for near-sequential IO, small enough that
+# a chunk is a trivial RAM footprint (256k rows x 1000 cols x 4B = 1 GB max;
+# typical tabular widths are far less)
+_CHUNK_ROWS = 262_144
+
+
+def _entry_key(schema: DataSchema, data: DataConfig, my_files: list[tuple[int, str]]) -> str:
+    h = hashlib.sha1()
+    h.update(f"v{OUT_OF_CORE_VERSION}".encode())
+    for file_idx, path in my_files:
+        # per-file cache identity = content identity (size+mtime+delimiter)
+        name = cache_mod.cache_entry_name(path, data.delimiter)
+        if name is None:  # no trustworthy metadata: consolidation unsafe
+            raise ValueError(
+                f"cannot build out-of-core dataset: {path} has no (size, "
+                f"mtime) metadata to key the consolidated cache on")
+        h.update(f"{file_idx}:{name};".encode())
+    h.update(json.dumps({
+        "sel": list(schema.selected_indices),
+        "tgt": list(schema.all_target_indices),
+        "wgt": schema.weight_index,
+        "valid_ratio": data.valid_ratio,
+        "split_seed": data.split_seed,
+        "shuffle_seed": data.shuffle_seed,
+    }, sort_keys=True).encode())
+    return h.hexdigest()[:24]
+
+
+_PARTS = ("features", "target", "weight")
+
+
+def _open_split(entry_dir: str, prefix: str):
+    return tuple(
+        np.load(os.path.join(entry_dir, f"{prefix}_{part}.npy"), mmap_mode="r")
+        for part in _PARTS)
+
+
+def load_datasets_out_of_core(
+    schema: DataSchema,
+    data: DataConfig,
+    host_index: int = 0,
+    num_hosts: int = 1,
+):
+    """(train, valid) TabularDatasets backed by read-only memmaps.
+
+    Requires a cache directory (DataConfig.cache_dir or SHIFU_TPU_DATA_CACHE)
+    — the consolidated arrays have to live somewhere durable.
+    """
+    from .pipeline import TabularDataset  # avoid import cycle
+
+    cache_dir = cache_mod.resolve_cache_dir(data.cache_dir)
+    if cache_dir is None:
+        raise ValueError(
+            "out-of-core datasets need a cache directory: set "
+            "DataConfig.cache_dir or SHIFU_TPU_DATA_CACHE")
+
+    paths: list[str] = []
+    for p in data.paths:
+        paths.extend(reader.list_data_files(p))
+    mine = [(i, p) for i, p in enumerate(paths) if i % num_hosts == host_index]
+
+    key = _entry_key(schema, data, mine)
+    entry_dir = os.path.join(
+        cache_dir, f"dataset-{key}-h{host_index}of{num_hosts}")
+    if not os.path.exists(os.path.join(entry_dir, "meta.json")):
+        _build_entry(entry_dir, schema, data, mine, host_index, num_hosts)
+
+    train = TabularDataset(*_open_split(entry_dir, "train"))
+    valid = TabularDataset(*_open_split(entry_dir, "valid"))
+    return train, valid
+
+
+def _file_masks(mine, data: DataConfig):
+    """Pass 1: per-file (row_count, valid_mask) without keeping any rows.
+
+    Raises when a per-file cache entry could not be written (non-memmap
+    return): pass 2 reads each file once per chunk, which is only sane when
+    those reads are mmap hits — degrading to a full re-parse per chunk would
+    multiply parse cost by the chunk count with no warning.
+    """
+    counts, masks = [], []
+    for file_idx, path in mine:
+        # the raw matrix is mmap-served on the second touch (pass 2)
+        rows = cache_mod.read_file_cached(path, data.delimiter,
+                                          cache_dir=data.cache_dir, mmap=True)
+        if not isinstance(rows, np.memmap):
+            raise OSError(
+                f"out-of-core build needs a writable cache with space for "
+                f"the parsed copy of every source file, but caching "
+                f"{path!r} failed (cache_dir full or unwritable?)")
+        n = rows.shape[0]
+        row_ids = (np.uint64(file_idx) << np.uint64(40)) + np.arange(n, dtype=np.uint64)
+        _, valid_mask = split.train_valid_mask(row_ids, data.valid_ratio, data.split_seed)
+        counts.append(n)
+        masks.append(valid_mask)
+        del rows
+    return counts, masks
+
+
+def _build_entry(entry_dir, schema: DataSchema, data: DataConfig, mine,
+                 host_index: int, num_hosts: int) -> None:
+    counts, masks = _file_masks(mine, data)
+    n_valid = int(sum(int(m.sum()) for m in masks))
+    n_train = int(sum(counts)) - n_valid
+    f_dim = len(schema.selected_indices)
+    t_dim = len(schema.all_target_indices)
+
+    # chunk write plan: (file pos, row start, row stop) per chunk, order
+    # permuted across the whole shard for train decorrelation
+    chunks = []
+    for pos, n in enumerate(counts):
+        for start in range(0, n, _CHUNK_ROWS):
+            chunks.append((pos, start, min(start + _CHUNK_ROWS, n)))
+    rng = np.random.default_rng(np.random.PCG64(data.shuffle_seed ^ 0xD15C))
+    chunk_order = rng.permutation(len(chunks))
+
+    parent = os.path.dirname(entry_dir) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp_dir = tempfile.mkdtemp(dir=parent, prefix=".building-")
+    try:
+        def alloc(prefix, n_rows, dim):
+            return np.lib.format.open_memmap(
+                os.path.join(tmp_dir, prefix), mode="w+",
+                dtype=np.float32, shape=(n_rows, dim))
+
+        out = {
+            "train": (alloc("train_features.npy", n_train, f_dim),
+                      alloc("train_target.npy", n_train, t_dim),
+                      alloc("train_weight.npy", n_train, 1)),
+            "valid": (alloc("valid_features.npy", n_valid, f_dim),
+                      alloc("valid_target.npy", n_valid, t_dim),
+                      alloc("valid_weight.npy", n_valid, 1)),
+        }
+        # valid rows keep file order (== in-RAM loader); compute each file's
+        # valid write offset up front
+        valid_offsets = np.concatenate(
+            [[0], np.cumsum([int(m.sum()) for m in masks])])
+        train_cursor = 0
+        for ci in chunk_order:
+            pos, start, stop = chunks[ci]
+            _, path = mine[pos]
+            rows = cache_mod.read_file_cached(path, data.delimiter,
+                                              cache_dir=data.cache_dir, mmap=True)
+            cols = reader.project_columns(np.asarray(rows[start:stop]), schema)
+            del rows
+            vmask = masks[pos][start:stop]
+            tmask = ~vmask
+            n_tr = int(tmask.sum())
+            if n_tr:
+                order = rng.permutation(n_tr)  # within-chunk row shuffle
+                sl = slice(train_cursor, train_cursor + n_tr)
+                out["train"][0][sl] = cols["features"][tmask][order]
+                out["train"][1][sl] = cols["target"][tmask][order]
+                out["train"][2][sl] = cols["weight"][tmask][order]
+                train_cursor += n_tr
+            n_va = int(vmask.sum())
+            if n_va:
+                # file-ordered position: offset of this file + valid rows
+                # before `start` within it
+                before = int(masks[pos][:start].sum())
+                sl = slice(valid_offsets[pos] + before,
+                           valid_offsets[pos] + before + n_va)
+                out["valid"][0][sl] = cols["features"][vmask]
+                out["valid"][1][sl] = cols["target"][vmask]
+                out["valid"][2][sl] = cols["weight"][vmask]
+        for arrs in out.values():
+            for a in arrs:
+                a.flush()
+        del out
+        meta = {
+            "version": OUT_OF_CORE_VERSION,
+            "n_train": n_train, "n_valid": n_valid,
+            "feature_dim": f_dim, "target_dim": t_dim,
+            "host_index": host_index, "num_hosts": num_hosts,
+            "files": [p for _, p in mine],
+        }
+        with open(os.path.join(tmp_dir, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=1)
+        try:
+            os.rename(tmp_dir, entry_dir)  # atomic publish
+        except OSError:
+            # either a concurrent builder published first (theirs is
+            # equivalent) or the rename genuinely failed — only swallow if a
+            # servable entry actually exists
+            if not os.path.exists(os.path.join(entry_dir, "meta.json")):
+                raise
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        raise

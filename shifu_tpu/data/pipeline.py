@@ -53,6 +53,10 @@ def load_datasets(
     the deterministic hash in `split` (fixes the re-drawn random split quirk,
     ssgd_monitor.py:395).
     """
+    if data.out_of_core:
+        from .outofcore import load_datasets_out_of_core
+        return load_datasets_out_of_core(schema, data, host_index, num_hosts)
+
     paths: list[str] = []
     for p in data.paths:
         paths.extend(reader.list_data_files(p))

@@ -207,8 +207,7 @@ def count_rows(paths: Sequence[str]) -> int:
     total = 0
     for p in paths:
         if fsio.is_remote(p):
-            raw = _fetch_decompressed(p)
-            total += sum(1 for line in raw.split(b"\n") if line.strip())
+            total += fsio.count_data_lines(p)  # streaming, constant memory
             continue
         if use_native:
             try:

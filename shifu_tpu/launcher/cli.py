@@ -358,7 +358,8 @@ def run_eval(args) -> int:
 
     paths: list[str] = []
     for p in args.data:
-        paths.extend(reader.list_data_files(p) if os.path.isdir(p) else [p])
+        # handles local/remote, file-or-directory, with marker-file filtering
+        paths.extend(reader.list_data_files(p))
     if not paths:
         print("eval: no data files found", file=sys.stderr)
         return EXIT_FAIL

@@ -34,6 +34,10 @@ KEY_MAX_MISSED_HEARTBEATS = "shifu.task.max-missed-heartbeats"
 KEY_MESH_DATA = "shifu.mesh.data"
 KEY_MESH_MODEL = "shifu.mesh.model"
 KEY_MESH_SEQ = "shifu.mesh.seq"
+# input-pipeline knobs (no reference analog: its loader was fixed-function)
+KEY_DATA_CACHE_DIR = "shifu.data.cache-dir"
+KEY_DATA_OUT_OF_CORE = "shifu.data.out-of-core"
+KEY_DATA_READ_THREADS = "shifu.data.read-threads"
 
 
 def parse_configuration_xml(path: str) -> dict[str, str]:
@@ -106,6 +110,18 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         import dataclasses
         data = dataclasses.replace(
             data, paths=tuple(conf[KEY_TRAINING_DATA_PATH].split(",")))
+    if KEY_DATA_CACHE_DIR in conf:
+        import dataclasses
+        data = dataclasses.replace(data, cache_dir=conf[KEY_DATA_CACHE_DIR])
+    if KEY_DATA_OUT_OF_CORE in conf:
+        import dataclasses
+        data = dataclasses.replace(
+            data, out_of_core=conf[KEY_DATA_OUT_OF_CORE].strip().lower()
+            in ("true", "1", "yes"))
+    if KEY_DATA_READ_THREADS in conf:
+        import dataclasses
+        data = dataclasses.replace(
+            data, read_threads=int(conf[KEY_DATA_READ_THREADS]))
 
     import dataclasses
     rt_kw: dict[str, Any] = {}

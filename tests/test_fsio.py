@@ -80,6 +80,30 @@ def test_missing_remote_file_raises(tmp_path):
         list_data_files(f"file://{tmp_path}/absent_dir")
 
 
+def test_percent_encoded_uri_after_endpoint_warm(tmp_path):
+    # warm the (file, "") endpoint with a plain path, then read a
+    # percent-encoded one: the cached-endpoint fast path must decode exactly
+    # like pyarrow's from_uri does
+    rng = np.random.default_rng(1)
+    plain = tmp_path / "plain.gz"
+    spaced = tmp_path / "has space.gz"
+    _write_gz(str(plain), rng.standard_normal((5, 3)))
+    _write_gz(str(spaced), rng.standard_normal((7, 3)))
+    assert read_file(f"file://{plain}").shape == (5, 3)  # warms endpoint
+    enc = str(spaced).replace(" ", "%20")
+    assert read_file(f"file://{enc}").shape == (7, 3)
+
+
+def test_streaming_count_matches(data_dir, tmp_path):
+    # remote count streams (constant memory); must equal the local count,
+    # gzip and plain, including a final unterminated non-blank line
+    plain = tmp_path / "plain.psv"
+    plain.write_text("1|2\n\n3|4\n5|6")  # blank line + no trailing newline
+    assert fsio.count_data_lines(f"file://{plain}") == 3
+    gz = data_dir / "part-00000.gz"
+    assert fsio.count_data_lines(f"file://{gz}") == count_rows([str(gz)]) == 20
+
+
 def test_cache_over_uri(data_dir, tmp_path):
     local = str(data_dir / "part-00002.gz")
     uri = f"file://{local}"

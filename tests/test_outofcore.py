@@ -1,0 +1,128 @@
+"""Out-of-core datasets (shifu_tpu/data/outofcore.py).
+
+Contract: memmap-backed (train, valid) with the SAME rows as the in-RAM
+loader — valid partition bit-identical in file order, train partition equal
+as a multiset (only the write-time permutation differs) — built once,
+served from the consolidated cache afterward, invalidated when a source
+file changes, and trainable end-to-end through the staged tier.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.config import DataConfig
+from shifu_tpu.data import load_datasets, synthetic
+from shifu_tpu.data import outofcore
+
+
+def _sorted_rows(ds):
+    """Rows sorted lexicographically: multiset comparison of partitions."""
+    allc = np.concatenate([ds.features, ds.target, ds.weight], axis=1)
+    return allc[np.lexsort(allc.T[::-1])]
+
+
+@pytest.fixture
+def setup(tmp_path):
+    schema = synthetic.make_schema(num_features=6)
+    rows = synthetic.make_rows(3000, schema, seed=11)
+    paths = synthetic.write_files(rows, str(tmp_path / "d"), num_files=5)
+    cdir = str(tmp_path / "cache")
+    return schema, paths, cdir
+
+
+def test_matches_in_ram_loader(setup):
+    schema, paths, cdir = setup
+    ram_cfg = DataConfig(paths=tuple(paths), batch_size=64)
+    ooc_cfg = DataConfig(paths=tuple(paths), batch_size=64,
+                         cache_dir=cdir, out_of_core=True)
+    t_ram, v_ram = load_datasets(schema, ram_cfg)
+    t_ooc, v_ooc = load_datasets(schema, ooc_cfg)
+    # memmap-backed
+    assert isinstance(t_ooc.features, np.memmap)
+    assert isinstance(v_ooc.features, np.memmap)
+    # valid: identical including order (file order in both loaders)
+    np.testing.assert_array_equal(np.asarray(v_ooc.features), v_ram.features)
+    np.testing.assert_array_equal(np.asarray(v_ooc.target), v_ram.target)
+    np.testing.assert_array_equal(np.asarray(v_ooc.weight), v_ram.weight)
+    # train: same multiset of rows (row order differs by design)
+    np.testing.assert_allclose(_sorted_rows(t_ooc), _sorted_rows(t_ram),
+                               rtol=0, atol=0)
+
+
+def test_second_load_serves_consolidated_entry(setup, monkeypatch):
+    schema, paths, cdir = setup
+    cfg = DataConfig(paths=tuple(paths), batch_size=64,
+                     cache_dir=cdir, out_of_core=True)
+    load_datasets(schema, cfg)  # build
+    # a second load must not re-parse any source file
+    import shifu_tpu.data.reader as reader_mod
+
+    def boom(*a, **k):
+        raise AssertionError("consolidated hit must not re-parse sources")
+    monkeypatch.setattr(reader_mod, "read_file", boom)
+    t, v = load_datasets(schema, cfg)
+    assert t.num_rows > 0 and v.num_rows > 0
+
+
+def test_source_change_invalidates(setup):
+    schema, paths, cdir = setup
+    cfg = DataConfig(paths=tuple(paths), batch_size=64,
+                     cache_dir=cdir, out_of_core=True)
+    t0, _ = load_datasets(schema, cfg)
+    n0 = t0.num_rows
+    # append rows to one source file
+    extra = synthetic.make_rows(200, schema, seed=99)
+    import gzip
+    with gzip.open(paths[0], "at") as f:
+        for r in np.asarray(extra):
+            f.write("|".join(f"{v:.6g}" for v in r) + "\n")
+    os.utime(paths[0], ns=(7, 7))
+    t1, _ = load_datasets(schema, cfg)
+    assert t1.num_rows > n0
+
+
+def test_requires_cache_dir(setup, monkeypatch):
+    schema, paths, _ = setup
+    monkeypatch.delenv("SHIFU_TPU_DATA_CACHE", raising=False)
+    cfg = DataConfig(paths=tuple(paths), batch_size=64, out_of_core=True)
+    with pytest.raises(ValueError, match="cache directory"):
+        load_datasets(schema, cfg)
+
+
+def test_host_sharding_partitions_files(setup):
+    schema, paths, cdir = setup
+    cfg = DataConfig(paths=tuple(paths), batch_size=64,
+                     cache_dir=cdir, out_of_core=True)
+    rows_total = 0
+    for host in range(2):
+        t, v = load_datasets(schema, cfg, host_index=host, num_hosts=2)
+        rows_total += t.num_rows + v.num_rows
+    assert rows_total == 3000
+
+
+def test_train_end_to_end_out_of_core(setup):
+    import jax
+
+    from shifu_tpu.config import (JobConfig, ModelSpec, OptimizerConfig,
+                                  TrainConfig)
+    from shifu_tpu.train import train
+
+    schema, paths, cdir = setup
+    job = JobConfig(
+        schema=schema,
+        data=DataConfig(paths=tuple(paths), batch_size=128, cache_dir=cdir,
+                        out_of_core=True,
+                        device_resident_bytes=0),  # force the staged tier
+        model=ModelSpec(model_type="mlp", hidden_nodes=(8,),
+                        activations=("relu",)),
+        train=TrainConfig(epochs=2, loss="weighted_mse",
+                          optimizer=OptimizerConfig(name="adadelta",
+                                                    learning_rate=0.01)),
+    ).validate()
+    result = train(job)
+    assert len(result.history) == 2
+    for m in result.history:
+        assert np.isfinite(m.train_error)
+    assert np.isfinite(result.history[-1].valid_auc)
