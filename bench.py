@@ -127,6 +127,26 @@ def main() -> None:
             scorer.compute_batch(score_rows)
         extras["score_rows_per_sec_numpy"] = round(
             reps * len(score_rows) / (time.perf_counter() - t0), 1)
+
+        # native C++ engine (the libtensorflow_jni-replacement scoring path);
+        # single-row is the reference's actual eval pattern
+        # (eval/.../TensorflowModel.java:52-109 scores one row per call)
+        from shifu_tpu.runtime.native_scorer import NativeScorer
+        nscorer = NativeScorer(export_dir)
+        nscorer.compute_batch(score_rows)  # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            nscorer.compute_batch(score_rows)
+        extras["score_rows_per_sec_native"] = round(
+            reps * len(score_rows) / (time.perf_counter() - t0), 1)
+        one_row = np.asarray(score_rows[0], dtype=np.float64)
+        nscorer.compute(one_row)
+        t0 = time.perf_counter()
+        for _ in range(2000):
+            nscorer.compute(one_row)
+        extras["score_single_row_per_sec_native"] = round(
+            2000 / (time.perf_counter() - t0), 1)
+        nscorer.close()
     except Exception:
         pass
 

@@ -162,11 +162,24 @@ def count_data_lines(path: str, chunk_bytes: int = 1 << 20) -> int:
             chunk = stream.read(chunk_bytes)
             if not chunk:
                 break
+            chunk = bytes(chunk)
             if first:
                 first = False
-                if bytes(chunk[:2]) == b"\x1f\x8b":
+                if chunk[:2] == b"\x1f\x8b":
                     decomp = zlib.decompressobj(wbits=31)  # gzip wrapper
-            feed(decomp.decompress(bytes(chunk)) if decomp else bytes(chunk))
+            if decomp is None:
+                feed(chunk)
+                continue
+            # multi-member (concatenated) gzip: each member ends the
+            # decompressobj with the remainder in unused_data — restart a
+            # fresh decompressor per member (gzip.decompress semantics)
+            data = chunk
+            while data:
+                feed(decomp.decompress(data))
+                if not decomp.eof:
+                    break
+                data = decomp.unused_data
+                decomp = zlib.decompressobj(wbits=31)
     if decomp:
         feed(decomp.flush())
     if line_has_content:

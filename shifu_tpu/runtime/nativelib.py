@@ -10,7 +10,9 @@ native-code layer replacing the reference's consumed TF C++ runtime
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
 import subprocess
 import threading
 from typing import Optional, Sequence
@@ -18,6 +20,23 @@ from typing import Optional, Sequence
 _CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
 _BUILD = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
 _lock = threading.Lock()
+
+
+def _machine_tag() -> str:
+    """Short id of this host's CPU capabilities.  Builds use -march=native,
+    so a cached .so must never be loaded on a CPU with a different ISA (a
+    shared filesystem or baked container image would otherwise SIGILL) —
+    the tag goes into the library filename."""
+    probe = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):  # x86 / arm
+                    probe += ":" + line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        probe += ":" + platform.processor()
+    return hashlib.sha1(probe.encode()).hexdigest()[:10]
 
 
 def build_library(
@@ -35,15 +54,23 @@ def build_library(
     out_dir = os.path.abspath(out_dir or _BUILD)
     os.makedirs(out_dir, exist_ok=True)
     lib_path = os.path.join(
-        out_dir, "lib" + os.path.splitext(source_name)[0] + ".so")
+        out_dir,
+        "lib" + os.path.splitext(source_name)[0] + "-" + _machine_tag() + ".so")
     with _lock:
         if (os.path.exists(lib_path) and not force
                 and os.path.getmtime(lib_path) >= os.path.getmtime(src)):
             return lib_path
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-               "-o", lib_path, src, *extra_flags]
-        proc = subprocess.run(cmd, capture_output=True, text=True)
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"native build failed ({' '.join(cmd)}):\n{proc.stderr}")
+        # libraries are built on (and cached for) the machine that runs them,
+        # so tune for it: -march=native unlocks AVX/FMA for the scorer's
+        # matmuls and the parser's tokenizer; retry without it for compilers/
+        # platforms that reject the flag
+        base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                "-o", lib_path, src, *extra_flags]
+        for flags in (["-march=native", "-funroll-loops"], []):
+            cmd = base[:2] + flags + base[2:]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode == 0:
+                return lib_path
+        raise RuntimeError(
+            f"native build failed ({' '.join(cmd)}):\n{proc.stderr}")
     return lib_path

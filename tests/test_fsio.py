@@ -104,6 +104,16 @@ def test_streaming_count_matches(data_dir, tmp_path):
     assert fsio.count_data_lines(f"file://{gz}") == count_rows([str(gz)]) == 20
 
 
+def test_streaming_count_multimember_gzip(tmp_path):
+    # concatenated gzip members (Hadoop/bgzip-style output) must count every
+    # member, like gzip.decompress and the read path do
+    p = tmp_path / "multi.gz"
+    p.write_bytes(gzip.compress(b"1|2\n3|4\n") + gzip.compress(b"5|6\n7|8\n"))
+    uri = f"file://{p}"
+    assert fsio.count_data_lines(uri) == 4
+    assert read_file(uri).shape == (4, 2)
+
+
 def test_cache_over_uri(data_dir, tmp_path):
     local = str(data_dir / "part-00002.gz")
     uri = f"file://{local}"

@@ -126,3 +126,26 @@ def test_train_end_to_end_out_of_core(setup):
     for m in result.history:
         assert np.isfinite(m.train_error)
     assert np.isfinite(result.history[-1].valid_auc)
+
+
+def test_train_out_of_core_on_mesh(setup):
+    """Out-of-core staged blocks shard over the data axis like any batch."""
+    from shifu_tpu.config import (JobConfig, ModelSpec, OptimizerConfig,
+                                  TrainConfig)
+    from shifu_tpu.parallel import data_parallel_mesh
+    from shifu_tpu.train import train
+
+    schema, paths, cdir = setup
+    job = JobConfig(
+        schema=schema,
+        data=DataConfig(paths=tuple(paths), batch_size=128, cache_dir=cdir,
+                        out_of_core=True, device_resident_bytes=0),
+        model=ModelSpec(model_type="mlp", hidden_nodes=(8,),
+                        activations=("relu",)),
+        train=TrainConfig(epochs=1, loss="weighted_mse",
+                          optimizer=OptimizerConfig(name="adadelta",
+                                                    learning_rate=0.01)),
+    ).validate()
+    result = train(job, mesh=data_parallel_mesh(4))
+    assert np.isfinite(result.history[-1].train_error)
+    assert np.isfinite(result.history[-1].valid_auc)
