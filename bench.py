@@ -22,11 +22,81 @@ this bench runs under.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 10_000_000 / 16  # v5e-16 north star
+
+
+def _ladder_extras(mesh, n_chips: int) -> dict:
+    """Device-resident train throughput for BASELINE ladder rungs 2-5
+    (Wide&Deep, DeepFM w/ embeddings, multi-task, FT-Transformer)."""
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.config import (
+        DataConfig, JobConfig, ModelSpec, OptimizerConfig, TrainConfig)
+    from shifu_tpu.data import synthetic
+    from shifu_tpu.parallel.sharding import shard_blocks
+    from shifu_tpu.train import init_state, make_device_epoch_step
+
+    rungs = [
+        ("wide_deep", ModelSpec(model_type="wide_deep", hidden_nodes=(100, 100),
+                                activations=("relu", "relu"), embedding_dim=16,
+                                compute_dtype="bfloat16"), 32768, 8),
+        ("deepfm", ModelSpec(model_type="deepfm", hidden_nodes=(100, 100),
+                             activations=("relu", "relu"), embedding_dim=16,
+                             compute_dtype="bfloat16"), 32768, 8),
+        ("multitask", ModelSpec(model_type="multitask", hidden_nodes=(100, 100),
+                                activations=("relu", "relu"), num_heads=2,
+                                head_names=("shifu_output_0", "shifu_output_1"),
+                                compute_dtype="bfloat16"), 32768, 8),
+        ("ft_transformer", ModelSpec(model_type="ft_transformer", token_dim=64,
+                                     num_layers=3, num_attention_heads=8,
+                                     compute_dtype="bfloat16"), 4096, 8),
+    ]
+    out = {}
+    rng = np.random.default_rng(7)
+    for name, spec, bs, nb in rungs:
+      try:
+        n_cat = 6 if spec.model_type in ("wide_deep", "deepfm") else 0
+        n_tgt = spec.num_heads
+        schema = synthetic.make_schema(num_features=30, num_categorical=n_cat,
+                                       vocab_size=1000, num_targets=n_tgt)
+        job = JobConfig(
+            schema=schema, data=DataConfig(batch_size=bs), model=spec,
+            train=TrainConfig(
+                epochs=1, loss="weighted_mse",
+                optimizer=OptimizerConfig(name="adadelta", learning_rate=0.003)),
+        ).validate()
+        feats = rng.standard_normal((nb, bs, 30)).astype(np.float32)
+        if n_cat:  # integer ids (stored as floats) in the categorical tail
+            feats[..., 30 - n_cat:] = rng.integers(
+                0, 1000, (nb, bs, n_cat)).astype(np.float32)
+        host_blocks = {
+            "features": feats,
+            "target": (rng.random((nb, bs, n_tgt)) < 0.5).astype(np.float32),
+            "weight": np.ones((nb, bs, 1), np.float32),
+        }
+        blocks = (shard_blocks(host_blocks, mesh) if mesh is not None
+                  else {k: jax.device_put(v) for k, v in host_blocks.items()})
+        state = init_state(job, 30, mesh)
+        step = make_device_epoch_step(job, mesh)
+        order = jnp.arange(nb, dtype=jnp.int32)
+        st, last = step(state, blocks, order)
+        float(last)  # compile + sync
+        epochs = 5
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            st, last = step(st, blocks, order)
+        float(last)
+        out[f"ladder_{name}_samples_per_sec_per_chip"] = round(
+            epochs * nb * bs / (time.perf_counter() - t0) / n_chips, 1)
+      except Exception as e:  # a failed rung must not discard measured ones
+        out[f"ladder_{name}_error"] = str(e)[:200]
+    return out
 
 
 def main() -> None:
@@ -110,6 +180,13 @@ def main() -> None:
     dispatch_per_chip = steps * batch_size / (time.perf_counter() - t0) / n_chips
 
     extras = {}
+    if os.environ.get("SHIFU_TPU_BENCH_LADDER"):
+        # device-resident training throughput for the rest of the BASELINE
+        # model ladder (configs 2-5); opt-in because each rung pays a compile
+        try:
+            extras.update(_ladder_extras(mesh, n_chips))
+        except Exception as e:
+            extras["ladder_error"] = str(e)[:200]
     try:  # eval-side throughput: numpy op-list scorer on the same model
         import tempfile
 

@@ -129,10 +129,46 @@ float apply_act(uint32_t act, float x) {
 }
 
 // y[m][n] = x[m][k] @ w[k][n] + bias[n]; row-major w keeps the inner loop
-// contiguous over n so the compiler vectorizes it.
+// contiguous over n so the compiler vectorizes it.  Rows are tiled by 4 so
+// each streamed weight row w[j][:] feeds 4 accumulating outputs — 4x less
+// weight-memory traffic, which is what separates a naive loop from BLAS at
+// these layer sizes (k,n ~ 100).
 void matmul_bias(const float* x, const float* w, const float* bias, float* y,
                  size_t m, size_t k, size_t n) {
-  for (size_t i = 0; i < m; ++i) {
+  size_t i = 0;
+  for (; i + 4 <= m; i += 4) {
+    const float* r0 = x + (i + 0) * k;
+    const float* r1 = x + (i + 1) * k;
+    const float* r2 = x + (i + 2) * k;
+    const float* r3 = x + (i + 3) * k;
+    float* d0 = y + (i + 0) * n;
+    float* d1 = y + (i + 1) * n;
+    float* d2 = y + (i + 2) * n;
+    float* d3 = y + (i + 3) * n;
+    if (bias) {
+      std::memcpy(d0, bias, n * sizeof(float));
+      std::memcpy(d1, bias, n * sizeof(float));
+      std::memcpy(d2, bias, n * sizeof(float));
+      std::memcpy(d3, bias, n * sizeof(float));
+    } else {
+      std::memset(d0, 0, n * sizeof(float));
+      std::memset(d1, 0, n * sizeof(float));
+      std::memset(d2, 0, n * sizeof(float));
+      std::memset(d3, 0, n * sizeof(float));
+    }
+    for (size_t j = 0; j < k; ++j) {
+      const float v0 = r0[j], v1 = r1[j], v2 = r2[j], v3 = r3[j];
+      const float* wrow = w + j * n;
+      for (size_t o = 0; o < n; ++o) {
+        const float wv = wrow[o];
+        d0[o] += v0 * wv;
+        d1[o] += v1 * wv;
+        d2[o] += v2 * wv;
+        d3[o] += v3 * wv;
+      }
+    }
+  }
+  for (; i < m; ++i) {
     const float* row = x + i * k;
     float* dst = y + i * n;
     if (bias) std::memcpy(dst, bias, n * sizeof(float));
