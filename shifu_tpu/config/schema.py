@@ -280,6 +280,13 @@ class CheckpointConfig:
     save_every_epochs: int = 1
     max_to_keep: int = 3
     resume: bool = True             # auto-resume from newest checkpoint (reference: MonitoredTrainingSession checkpoint_dir, ssgd_monitor.py:251-257)
+    # async saves overlap checkpoint IO with the next epoch's compute.  Off
+    # by default: the synchronous contract ("the save is durable before the
+    # epoch callback runs, so an external kill never loses a completed
+    # epoch") is the stronger fault-tolerance guarantee; turn on for large
+    # models where the save stall matters and losing the newest in-flight
+    # checkpoint to a kill only costs one extra epoch of recompute.
+    async_save: bool = False
 
 
 @dataclass(frozen=True)

@@ -25,12 +25,25 @@ def make_manager(directory: str, max_to_keep: int = 3) -> ocp.CheckpointManager:
 
 
 def save(manager: ocp.CheckpointManager, step: int, state: Any,
-         extra: Optional[dict] = None) -> None:
-    """Save the train state (and a small metadata dict) at `step`."""
+         extra: Optional[dict] = None, block: bool = True) -> None:
+    """Save the train state (and a small metadata dict) at `step`.
+
+    `block=False` (CheckpointConfig.async_save) lets orbax's background
+    writer overlap the save with the next epoch; any previous in-flight save
+    is finalized first, and the train loop finalizes the last one before
+    exiting (`finalize`).
+    """
+    manager.wait_until_finished()  # at most one save in flight
     composite = dict(state=ocp.args.StandardSave(state))
     if extra is not None:
         composite["extra"] = ocp.args.JsonSave(extra)
     manager.save(step, args=ocp.args.Composite(**composite))
+    if block:
+        manager.wait_until_finished()
+
+
+def finalize(manager: ocp.CheckpointManager) -> None:
+    """Block until any in-flight async save is durable (call before exit)."""
     manager.wait_until_finished()
 
 

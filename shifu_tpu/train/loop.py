@@ -205,7 +205,8 @@ def train(job: JobConfig,
     timing_on = bool(os.environ.get("SHIFU_TPU_TIMING")) or job.train.log_every_steps > 0
 
     history: list[EpochMetrics] = []
-    for epoch in range(start_epoch, job.train.epochs):
+    try:
+      for epoch in range(start_epoch, job.train.epochs):
         t0 = time.perf_counter()
         # loss accumulates on device; host sync happens once per epoch so
         # async dispatch keeps the chips busy (bench.py measures the same way)
@@ -288,15 +289,22 @@ def train(job: JobConfig,
             console(timer.console_line())
 
         # save before the callback so external kills (timeout, fault
-        # injection, preemption) never lose the completed epoch
+        # injection, preemption) never lose the completed epoch; async_save
+        # trades that guarantee for overlap with the next epoch's compute
         if manager is not None and (
                 (epoch + 1) % job.runtime.checkpoint.save_every_epochs == 0
                 or epoch == job.train.epochs - 1):
             ckpt_lib.save(manager, int(jax.device_get(state.step)), state,
-                          extra={"epoch": epoch + 1})
+                          extra={"epoch": epoch + 1},
+                          block=not job.runtime.checkpoint.async_save)
 
         if epoch_callback is not None:
             epoch_callback(m)
-
+    finally:
+      if manager is not None:
+        # async saves must be durable (and their errors surfaced) no matter
+        # how the loop exits — a mid-loop exception must not abandon an
+        # in-flight write of a completed epoch
+        ckpt_lib.finalize(manager)
     return TrainResult(state=state, history=history, job=job,
                        resumed_from_epoch=start_epoch)

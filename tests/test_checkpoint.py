@@ -11,12 +11,12 @@ from shifu_tpu.config import CheckpointConfig, RuntimeConfig
 from shifu_tpu.train import train
 
 
-def _with_ckpt(job, directory, epochs=None):
+def _with_ckpt(job, directory, epochs=None, async_save=False):
     return job.replace(
         train=job.train.__class__(epochs=epochs or job.train.epochs,
                                   optimizer=job.train.optimizer),
         runtime=RuntimeConfig(checkpoint=CheckpointConfig(
-            directory=directory, save_every_epochs=1)),
+            directory=directory, save_every_epochs=1, async_save=async_save)),
     )
 
 
@@ -67,3 +67,24 @@ def test_resume_disabled(tmp_path, small_job, small_data):
     r = train(job_no_resume, train_ds, valid_ds, console=lambda s: None)
     assert r.resumed_from_epoch == 0
     assert len(r.history) == 2
+
+
+def test_async_save_resume_equivalence(tmp_path, small_job, small_data):
+    """async_save overlaps IO with compute but must leave the same durable
+    checkpoints: an interrupted async run resumes identically to sync."""
+    train_ds, valid_ds = small_data
+
+    d = str(tmp_path / "async")
+    train(_with_ckpt(small_job, d, epochs=2, async_save=True),
+          train_ds, valid_ds, console=lambda s: None)
+    r = train(_with_ckpt(small_job, d, epochs=4, async_save=True),
+              train_ds, valid_ds, console=lambda s: None)
+    assert r.resumed_from_epoch == 2
+    assert [m.epoch for m in r.history] == [2, 3]
+
+    sync_job = _with_ckpt(small_job, str(tmp_path / "sync"), epochs=4)
+    r_sync = train(sync_job, train_ds, valid_ds, console=lambda s: None)
+    for a, b in zip(jax.tree_util.tree_leaves(r.state.params),
+                    jax.tree_util.tree_leaves(r_sync.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
