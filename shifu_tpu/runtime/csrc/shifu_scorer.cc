@@ -690,3 +690,41 @@ double shifu_scorer_compute(void* handle, const double* row) {
 }
 
 }  // extern "C"
+
+#ifdef SHIFU_SELFTEST_MAIN
+// Sanitizer self-test entry (see shifu_parser.cc counterpart): drives the
+// compute kernels with odd sizes (remainder rows for the 4-row matmul tile)
+// under ASan/UBSan.  Model loading is exercised separately through the
+// Python tests; this covers the math paths with no file dependency.
+#include <cstdio>
+int main() {
+  // matmul: m=7 exercises tiled (4) + remainder (3) paths, bias and no-bias
+  std::vector<float> x(7 * 5), w(5 * 3), b(3), y(7 * 3);
+  for (size_t i = 0; i < x.size(); ++i) x[i] = 0.01f * (float)i - 0.2f;
+  for (size_t i = 0; i < w.size(); ++i) w[i] = 0.02f * (float)i - 0.1f;
+  for (size_t i = 0; i < b.size(); ++i) b[i] = 0.5f;
+  matmul_bias(x.data(), w.data(), b.data(), y.data(), 7, 5, 3);
+  matmul_bias(x.data(), w.data(), nullptr, y.data(), 7, 5, 3);
+  // reference check against a scalar recompute of y[6][2] (no bias)
+  float want = 0.0f;
+  for (size_t j = 0; j < 5; ++j) want += x[6 * 5 + j] * w[j * 3 + 2];
+  if (std::fabs(y[6 * 3 + 2] - want) > 1e-5f) {
+    std::fprintf(stderr, "selftest: matmul mismatch\n");
+    return 1;
+  }
+  for (uint32_t a = 0; a < 8; ++a) (void)apply_act(a, -0.3f);
+  std::vector<float> ln_in(2 * 6), ln_s(6, 1.0f), ln_b(6, 0.0f), ln_out(2 * 6);
+  for (size_t i = 0; i < ln_in.size(); ++i) ln_in[i] = (float)i * 0.1f;
+  layernorm_rows(ln_in.data(), ln_s.data(), ln_b.data(), ln_out.data(), 2, 6);
+  std::vector<float> sm{0.1f, 2.0f, -1.0f, 0.0f, 3.3f};
+  softmax_row(sm.data(), sm.size());
+  float s = 0.0f;
+  for (float v : sm) s += v;
+  if (std::fabs(s - 1.0f) > 1e-5f) {
+    std::fprintf(stderr, "selftest: softmax not normalized\n");
+    return 2;
+  }
+  std::puts("scorer selftest ok");
+  return 0;
+}
+#endif  // SHIFU_SELFTEST_MAIN

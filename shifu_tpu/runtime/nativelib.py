@@ -39,6 +39,38 @@ def _machine_tag() -> str:
     return hashlib.sha1(probe.encode()).hexdigest()[:10]
 
 
+def _flags_tag(*flag_groups: Sequence[str]) -> str:
+    """Short hash of the flag sets baked into a cached artifact, so changing
+    link/sanitize flags never reuses an executable built with the old ones."""
+    return hashlib.sha1("\x00".join(
+        f for g in flag_groups for f in g).encode()).hexdigest()[:8]
+
+
+def _compile_cached(
+    src: str,
+    out_path: str,
+    flag_variants: Sequence[Sequence[str]],
+    tail: Sequence[str],
+    force: bool = False,
+) -> str:
+    """Shared compile-and-cache: rebuild `out_path` from `src` when missing or
+    stale, trying each flag variant in order (first success wins).  `tail` is
+    appended after the source (link libraries).  Callers must bake every
+    cache-relevant flag into `out_path`'s name (see _flags_tag)."""
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with _lock:
+        if (os.path.exists(out_path) and not force
+                and os.path.getmtime(out_path) >= os.path.getmtime(src)):
+            return out_path
+        for flags in flag_variants:
+            cmd = ["g++", *flags, "-o", out_path, src, *tail]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode == 0:
+                return out_path
+        raise RuntimeError(
+            f"native build failed ({' '.join(cmd)}):\n{proc.stderr}")
+
+
 def build_library(
     source_name: str,
     extra_flags: Sequence[str] = (),
@@ -52,25 +84,40 @@ def build_library(
     """
     src = os.path.join(_CSRC, source_name)
     out_dir = os.path.abspath(out_dir or _BUILD)
-    os.makedirs(out_dir, exist_ok=True)
+    # libraries are built on (and cached for) the machine that runs them, so
+    # tune for it: -march=native unlocks AVX/FMA for the scorer's matmuls and
+    # the parser's tokenizer; retry without it for compilers/platforms that
+    # reject the flag
+    base = ["-O3", "-shared", "-fPIC", "-std=c++17"]
+    variants = [["-march=native", "-funroll-loops", *base], base]
     lib_path = os.path.join(
-        out_dir,
-        "lib" + os.path.splitext(source_name)[0] + "-" + _machine_tag() + ".so")
-    with _lock:
-        if (os.path.exists(lib_path) and not force
-                and os.path.getmtime(lib_path) >= os.path.getmtime(src)):
-            return lib_path
-        # libraries are built on (and cached for) the machine that runs them,
-        # so tune for it: -march=native unlocks AVX/FMA for the scorer's
-        # matmuls and the parser's tokenizer; retry without it for compilers/
-        # platforms that reject the flag
-        base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                "-o", lib_path, src, *extra_flags]
-        for flags in (["-march=native", "-funroll-loops"], []):
-            cmd = base[:2] + flags + base[2:]
-            proc = subprocess.run(cmd, capture_output=True, text=True)
-            if proc.returncode == 0:
-                return lib_path
-        raise RuntimeError(
-            f"native build failed ({' '.join(cmd)}):\n{proc.stderr}")
-    return lib_path
+        out_dir, "lib" + os.path.splitext(source_name)[0] + "-"
+        + _machine_tag() + "-" + _flags_tag(*variants, extra_flags) + ".so")
+    return _compile_cached(src, lib_path, variants, extra_flags, force=force)
+
+
+def build_selftest(
+    source_name: str,
+    sanitize: str = "address,undefined",
+    extra_flags: Sequence[str] = (),
+    out_dir: Optional[str] = None,
+    force: bool = False,
+) -> str:
+    """Compile `csrc/<source_name>` with -DSHIFU_SELFTEST_MAIN into a
+    sanitizer-instrumented executable; returns its path.
+
+    This is the framework's memory/UB detection harness — coverage dimension
+    the reference had none of (SURVEY.md §5.2).  Run the binary; exit 0 means
+    the kernels passed under ASan/UBSan.
+    """
+    src = os.path.join(_CSRC, source_name)
+    out_dir = os.path.abspath(out_dir or _BUILD)
+    # -fno-sanitize-recover: UBSan otherwise only *reports* and exits 0,
+    # which would let UB through the tests' returncode assertion
+    flags = ["-O1", "-g", "-fno-omit-frame-pointer", f"-fsanitize={sanitize}",
+             "-fno-sanitize-recover=all", "-DSHIFU_SELFTEST_MAIN",
+             "-std=c++17"]
+    exe = os.path.join(
+        out_dir, os.path.splitext(source_name)[0] + "-selftest-"
+        + sanitize.replace(",", "_") + "-" + _flags_tag(flags, extra_flags))
+    return _compile_cached(src, exe, [flags], extra_flags, force=force)
