@@ -1,11 +1,12 @@
-"""Sanitizer self-tests for the native components (ASan + UBSan).
+"""Sanitizer self-tests for the native components (ASan + UBSan + TSan).
 
 The reference had no race/memory detection of any kind (SURVEY.md §5.2:
 "None").  Here both authored C++ components carry a -DSHIFU_SELFTEST_MAIN
 entry that drives their kernels (multithreaded chunked parse; tiled matmul /
 layernorm / softmax incl. remainder paths) under
 -fsanitize=address,undefined — an out-of-bounds read, use-after-free, leak,
-or UB in the hot paths fails these tests.
+or UB in the hot paths fails these tests — and the parser's threaded path
+additionally runs under -fsanitize=thread for data-race detection.
 """
 
 import gzip
@@ -51,6 +52,22 @@ def test_parser_selftest_asan_ubsan(tmp_path):
     proc = subprocess.run([exe, str(gz)], capture_output=True, text=True,
                           timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "parser selftest ok" in proc.stdout
+
+
+def test_parser_selftest_tsan():
+    """Race detection on the multithreaded chunked parse (ThreadSanitizer).
+
+    SURVEY.md §5.2: the reference had no race detection of any kind.  The
+    parser's threaded path (chunk offset prefix-sum + disjoint-range writes
+    into one shared output buffer) is the framework's only intentional
+    data-parallel shared-memory write, so it gets a dedicated TSan run.
+    """
+    exe = _build_or_skip("shifu_parser.cc", sanitize="thread",
+                         extra_flags=["-lz", "-lpthread", "-ldl"])
+    proc = subprocess.run([exe], capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "WARNING: ThreadSanitizer" not in proc.stderr
     assert "parser selftest ok" in proc.stdout
 
 
