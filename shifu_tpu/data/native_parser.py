@@ -38,7 +38,7 @@ def _load() -> Optional[ctypes.CDLL]:
         try:
             from ..runtime.nativelib import build_library
             lib = ctypes.CDLL(build_library(
-                "shifu_parser.cc", extra_flags=["-lz", "-lpthread", "-ldl"]))
+                "shifu_parser.cc", extra_flags=["-lz", "-pthread", "-ldl"]))
         except Exception as e:  # no g++/zlib: numpy path serves instead
             _lib_err = str(e)
             return None

@@ -22,11 +22,14 @@
 //   shifu_scorer_load / _free / _num_features / _num_heads /
 //   shifu_scorer_compute_batch (float rows) / shifu_scorer_compute (double row)
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -128,47 +131,85 @@ float apply_act(uint32_t act, float x) {
   }
 }
 
-// y[m][n] = x[m][k] @ w[k][n] + bias[n]; row-major w keeps the inner loop
-// contiguous over n so the compiler vectorizes it.  Rows are tiled by 4 so
-// each streamed weight row w[j][:] feeds 4 accumulating outputs — 4x less
-// weight-memory traffic, which is what separates a naive loop from BLAS at
-// these layer sizes (k,n ~ 100).
-void matmul_bias(const float* x, const float* w, const float* bias, float* y,
+// Elementwise activation over a buffer with the switch hoisted out of the
+// loop: the common cases (relu / leaky_relu) become branch-free vector
+// loops instead of a per-element switch dispatch.  Deliberately NOT
+// restrict-qualified: the kDense path calls it in place (dst == src).
+void apply_act_rows(uint32_t act, const float* src, float* dst, size_t n) {
+  switch (act) {
+    case kRelu:
+      for (size_t i = 0; i < n; ++i) dst[i] = src[i] > 0.0f ? src[i] : 0.0f;
+      break;
+    case kLeakyRelu:
+      for (size_t i = 0; i < n; ++i)
+        dst[i] = src[i] >= 0.0f ? src[i] : kLeakyAlpha * src[i];
+      break;
+    case kLinear:
+      if (dst != src) std::memcpy(dst, src, n * sizeof(float));
+      break;
+    default:
+      for (size_t i = 0; i < n; ++i) dst[i] = apply_act(act, src[i]);
+  }
+}
+
+// y[m][n] = x[m][k] @ w[k][n] + bias[n] — register-blocked microkernel.
+// A 6-row x 32-col accumulator tile lives in registers across the whole
+// k-loop (6 broadcasts + 2 vector loads + 12 FMAs per k step with AVX-512),
+// so the only per-step memory traffic is one 128 B weight-row slice — the
+// same blocking idea BLAS uses.  Tile shape chosen empirically on the target
+// class (Sapphire Rapids: 44 GFLOP/s at k=n=100 vs 23 for a 4x16 tile; a
+// streaming loop whose accumulators round-trip through L1 does ~16).
+// Summation order per output element is unchanged (sequential over k), so
+// results are bit-identical to the unblocked formulation.
+void matmul_bias(const float* __restrict x, const float* __restrict w,
+                 const float* __restrict bias, float* __restrict y,
                  size_t m, size_t k, size_t n) {
+  constexpr size_t MR = 6, NR = 32;
   size_t i = 0;
-  for (; i + 4 <= m; i += 4) {
+  for (; i + MR <= m; i += MR) {
     const float* r0 = x + (i + 0) * k;
     const float* r1 = x + (i + 1) * k;
     const float* r2 = x + (i + 2) * k;
     const float* r3 = x + (i + 3) * k;
-    float* d0 = y + (i + 0) * n;
-    float* d1 = y + (i + 1) * n;
-    float* d2 = y + (i + 2) * n;
-    float* d3 = y + (i + 3) * n;
-    if (bias) {
-      std::memcpy(d0, bias, n * sizeof(float));
-      std::memcpy(d1, bias, n * sizeof(float));
-      std::memcpy(d2, bias, n * sizeof(float));
-      std::memcpy(d3, bias, n * sizeof(float));
-    } else {
-      std::memset(d0, 0, n * sizeof(float));
-      std::memset(d1, 0, n * sizeof(float));
-      std::memset(d2, 0, n * sizeof(float));
-      std::memset(d3, 0, n * sizeof(float));
-    }
-    for (size_t j = 0; j < k; ++j) {
-      const float v0 = r0[j], v1 = r1[j], v2 = r2[j], v3 = r3[j];
-      const float* wrow = w + j * n;
-      for (size_t o = 0; o < n; ++o) {
-        const float wv = wrow[o];
-        d0[o] += v0 * wv;
-        d1[o] += v1 * wv;
-        d2[o] += v2 * wv;
-        d3[o] += v3 * wv;
+    const float* r4 = x + (i + 4) * k;
+    const float* r5 = x + (i + 5) * k;
+    for (size_t o = 0; o < n; o += NR) {
+      const size_t nb = n - o < NR ? n - o : NR;
+      float a0[NR], a1[NR], a2[NR], a3[NR], a4[NR], a5[NR];
+      for (size_t c = 0; c < NR; ++c) {
+        const float bv = (bias && c < nb) ? bias[o + c] : 0.0f;
+        a0[c] = bv; a1[c] = bv; a2[c] = bv;
+        a3[c] = bv; a4[c] = bv; a5[c] = bv;
       }
+      if (nb == NR) {  // full tile: constant trip counts vectorize cleanly
+        for (size_t j = 0; j < k; ++j) {
+          const float* wrow = w + j * n + o;
+          const float v0 = r0[j], v1 = r1[j], v2 = r2[j];
+          const float v3 = r3[j], v4 = r4[j], v5 = r5[j];
+          for (size_t c = 0; c < NR; ++c) {
+            const float wv = wrow[c];
+            a0[c] += v0 * wv; a1[c] += v1 * wv; a2[c] += v2 * wv;
+            a3[c] += v3 * wv; a4[c] += v4 * wv; a5[c] += v5 * wv;
+          }
+        }
+      } else {
+        for (size_t j = 0; j < k; ++j) {
+          const float* wrow = w + j * n + o;
+          const float v0 = r0[j], v1 = r1[j], v2 = r2[j];
+          const float v3 = r3[j], v4 = r4[j], v5 = r5[j];
+          for (size_t c = 0; c < nb; ++c) {
+            const float wv = wrow[c];
+            a0[c] += v0 * wv; a1[c] += v1 * wv; a2[c] += v2 * wv;
+            a3[c] += v3 * wv; a4[c] += v4 * wv; a5[c] += v5 * wv;
+          }
+        }
+      }
+      const float* ab[MR] = {a0, a1, a2, a3, a4, a5};
+      for (size_t r = 0; r < MR; ++r)
+        std::memcpy(y + (i + r) * n + o, ab[r], nb * sizeof(float));
     }
   }
-  for (; i < m; ++i) {
+  for (; i < m; ++i) {  // remainder rows
     const float* row = x + i * k;
     float* dst = y + i * n;
     if (bias) std::memcpy(dst, bias, n * sizeof(float));
@@ -457,23 +498,73 @@ void exec_transformer_block(const Op& op, const float* x, float* out,
   for (size_t i = 0; i < rows * d; ++i) out[i] += y[i];
 }
 
+// Reusable intermediate-buffer arenas, shared across calls and across the
+// short-lived worker threads of compute_batch (a thread_local would die
+// with each worker and re-pay its page faults every call).  Retention is
+// bounded: at most kMaxFree arenas are kept, and any arena past
+// kMaxRetainFloats is dropped on release so one huge batch doesn't pin
+// hundreds of MB for the process lifetime.
+class ArenaPool {
+ public:
+  std::vector<float> acquire() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (free_.empty()) return {};
+    std::vector<float> a = std::move(free_.back());
+    free_.pop_back();
+    return a;
+  }
+  void release(std::vector<float>&& a) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (free_.size() < kMaxFree && a.capacity() <= kMaxRetainFloats)
+      free_.push_back(std::move(a));
+  }
+
+ private:
+  static constexpr size_t kMaxFree = 16;
+  static constexpr size_t kMaxRetainFloats = (size_t(64) << 20) / sizeof(float);
+  std::mutex mu_;
+  std::vector<std::vector<float>> free_;
+};
+
+ArenaPool& arena_pool() {
+  static ArenaPool* pool = new ArenaPool();  // never destroyed: safe at exit
+  return *pool;
+}
+
 int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
-  std::vector<std::vector<float>> bufs(m.shapes.size());
-  bufs[0].assign(rows, rows + batch * m.num_features);
+  // One pooled arena holds every intermediate buffer (offsets from the SSA
+  // shape plan).  Fresh per-call vectors would mmap tens of MB of new pages
+  // each batch and pay their page faults back every call — measured ~2x the
+  // whole MLP scoring cost at batch 8192.
+  const size_t nbuf = m.shapes.size();
+  std::vector<size_t> buf_off(nbuf);
+  size_t total = 0;
+  for (size_t i = 0; i < nbuf; ++i) {
+    buf_off[i] = total;
+    total += batch * m.shapes[i].per_row();
+  }
+  std::vector<float> arena = arena_pool().acquire();
+  if (arena.capacity() < total) arena = std::vector<float>();  // grow without
+  if (arena.size() < total) arena.resize(total);  // copying stale contents
+  struct ArenaReturner {
+    std::vector<float>* a;
+    ~ArenaReturner() { arena_pool().release(std::move(*a)); }
+  } returner{&arena};
+  float* const base = arena.data();
+  const auto buf = [&](uint32_t i) { return base + buf_off[i]; };
+  std::memcpy(buf(0), rows, batch * m.num_features * sizeof(float));
   uint32_t last = 0;
   for (const Op& op : m.ops) {
     const Shape& os = m.shapes[op.dst];
-    std::vector<float>& dst = bufs[op.dst];
-    dst.resize(batch * os.per_row());
-    const float* src =
-        op.src != kNoBuf ? bufs[op.src].data() : nullptr;
+    float* const dst = buf(op.dst);
+    const size_t dst_n = batch * os.per_row();
+    const float* src = op.src != kNoBuf ? buf(op.src) : nullptr;
     const Shape in = op.src != kNoBuf ? m.shapes[op.src] : Shape{};
     switch (op.code) {
       case kDense:
-        matmul_bias(src, op.w0.data(), op.w1.data(), dst.data(), batch, op.a,
+        matmul_bias(src, op.w0.data(), op.w1.data(), dst, batch, op.a,
                     op.b);
-        if (op.act != kLinear)
-          for (float& v : dst) v = apply_act(op.act, v);
+        if (op.act != kLinear) apply_act_rows(op.act, dst, dst, dst_n);
         break;
       case kGatherCols:
         for (size_t b = 0; b < batch; ++b)
@@ -502,7 +593,7 @@ int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
             }
             const float* trow =
                 op.w0.data() + (size_t(fidx) * maxv + id) * dim;
-            std::memcpy(dst.data() + (b * nf + fidx) * dim, trow,
+            std::memcpy(dst + (b * nf + fidx) * dim, trow,
                         dim * sizeof(float));
           }
         }
@@ -513,7 +604,7 @@ int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
         for (size_t b = 0; b < batch; ++b)
           for (uint32_t fidx = 0; fidx < nf; ++fidx) {
             const float v = src[b * in.d1 + fidx];
-            float* drow = dst.data() + (b * nf + fidx) * dim;
+            float* drow = dst + (b * nf + fidx) * dim;
             const float* wrow = op.w0.data() + size_t(fidx) * dim;
             const float* brow = op.w1.data() + size_t(fidx) * dim;
             for (uint32_t t = 0; t < dim; ++t)
@@ -527,8 +618,8 @@ int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
           size_t off = 0;
           for (uint32_t sb : op.idx) {
             const size_t n = m.shapes[sb].per_row();
-            std::memcpy(dst.data() + b * stride + off,
-                        bufs[sb].data() + b * n, n * sizeof(float));
+            std::memcpy(dst + b * stride + off,
+                        buf(sb) + b * n, n * sizeof(float));
             off += n;
           }
         }
@@ -537,15 +628,14 @@ int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
       case kFlatten:
       case kActivation:
         if (op.code == kFlatten) {
-          std::memcpy(dst.data(), src, dst.size() * sizeof(float));
+          std::memcpy(dst, src, dst_n * sizeof(float));
         } else {
-          const size_t n = dst.size();
-          for (size_t i = 0; i < n; ++i) dst[i] = apply_act(op.act, src[i]);
+          apply_act_rows(op.act, src, dst, dst_n);
         }
         break;
       case kSumFields:
         for (size_t b = 0; b < batch; ++b) {
-          float* drow = dst.data() + b * in.d2;
+          float* drow = dst + b * in.d2;
           std::memset(drow, 0, in.d2 * sizeof(float));
           for (uint32_t fidx = 0; fidx < in.d1; ++fidx) {
             const float* srow = src + (b * in.d1 + fidx) * in.d2;
@@ -555,10 +645,10 @@ int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
         break;
       case kAdd: {
         const size_t d1 = os.d1;
-        std::memset(dst.data(), 0, dst.size() * sizeof(float));
+        std::memset(dst, 0, dst_n * sizeof(float));
         for (uint32_t sb : op.idx) {
           const Shape& ss = m.shapes[sb];
-          const float* p = bufs[sb].data();
+          const float* p = buf(sb);
           for (size_t b = 0; b < batch; ++b)
             for (size_t i = 0; i < d1; ++i)
               dst[b * d1 + i] += p[b * ss.d1 + (ss.d1 == 1 ? 0 : i)];
@@ -582,7 +672,7 @@ int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
         break;
       case kClsPrepend:
         for (size_t b = 0; b < batch; ++b) {
-          float* drow = dst.data() + b * os.d1 * os.d2;
+          float* drow = dst + b * os.d1 * os.d2;
           std::memcpy(drow, op.w0.data(), os.d2 * sizeof(float));
           std::memcpy(drow + os.d2, src + b * in.d1 * in.d2,
                       size_t(in.d1) * in.d2 * sizeof(float));
@@ -590,18 +680,18 @@ int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
         break;
       case kLayerNorm: {
         const size_t d = op.a;
-        layernorm_rows(src, op.w0.data(), op.w1.data(), dst.data(),
+        layernorm_rows(src, op.w0.data(), op.w1.data(), dst,
                        batch * in.per_row() / d, d);
         break;
       }
       case kSelectToken:
         for (size_t b = 0; b < batch; ++b)
-          std::memcpy(dst.data() + b * in.d2,
+          std::memcpy(dst + b * in.d2,
                       src + (b * in.d1 + op.a) * in.d2,
                       in.d2 * sizeof(float));
         break;
       case kTransformerBlock:
-        exec_transformer_block(op, src, dst.data(), batch, in.d1);
+        exec_transformer_block(op, src, dst, batch, in.d1);
         break;
       default:
         return 2;
@@ -610,7 +700,7 @@ int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
   }
   const Shape& fs = m.shapes[last];
   if (fs.rank != 2 || fs.d1 != m.num_heads) return 3;
-  std::memcpy(out, bufs[last].data(),
+  std::memcpy(out, buf(last),
               batch * m.num_heads * sizeof(float));
   return 0;
 }
@@ -666,11 +756,59 @@ int shifu_scorer_num_heads(void* handle) {
 }
 
 // rows: [n][num_features] float32; out: [n][num_heads]. Returns 0 on success.
+// Every op in the program is row-independent, so large batches are split
+// across threads (each chunk is a standalone exec_program with its own
+// buffers) — per-row results are identical to the single-threaded path.
+// SHIFU_SCORER_THREADS caps/pins the pool; single-core hosts and small
+// batches stay on the calling thread.
 int shifu_scorer_compute_batch(void* handle, const float* rows, int n,
                                float* out) try {
   if (!handle || !rows || !out || n <= 0) return 1;
   const Model& m = *static_cast<Model*>(handle);
-  return exec_program(m, rows, static_cast<size_t>(n), out);
+  const size_t batch = static_cast<size_t>(n);
+  constexpr size_t kMinRowsPerThread = 512;
+  size_t t = 0;
+  if (const char* env = std::getenv("SHIFU_SCORER_THREADS")) {
+    const long v = std::strtol(env, nullptr, 10);
+    if (v >= 1 && v <= 1024) t = static_cast<size_t>(v);
+  }
+  if (t == 0) {
+    const unsigned hw = std::thread::hardware_concurrency();
+    t = hw ? hw : 1;
+  }
+  t = std::min(t, batch / kMinRowsPerThread);
+  if (t <= 1) return exec_program(m, rows, batch, out);
+  std::vector<int> rc(t, 0);
+  const auto run_chunk = [&](size_t c) noexcept {
+    const size_t lo = batch * c / t, hi = batch * (c + 1) / t;
+    try {
+      rc[c] = exec_program(m, rows + lo * m.num_features, hi - lo,
+                           out + lo * m.num_heads);
+    } catch (...) {
+      rc[c] = 4;  // never unwind across a thread boundary either
+    }
+  };
+  // Chunk 0 runs on the calling thread.  Spawn failures (cgroup pid limit,
+  // RLIMIT_NPROC) must not unwind while earlier threads are joinable —
+  // std::thread's destructor would std::terminate the host process — so
+  // catch here and run every unspawned chunk inline instead.
+  std::vector<std::thread> pool;
+  pool.reserve(t - 1);
+  size_t spawned = 0;
+  try {
+    for (size_t c = 1; c < t; ++c) {
+      pool.emplace_back(run_chunk, c);
+      ++spawned;
+    }
+  } catch (...) {
+  }
+  run_chunk(0);
+  for (size_t c = spawned + 1; c < t; ++c) run_chunk(c);
+  int status = 0;
+  for (std::thread& th : pool) th.join();
+  for (size_t c = 0; c < t; ++c)
+    if (rc[c] != 0) status = rc[c];
+  return status;
 } catch (...) {
   return 4;  // allocation failure etc. — never unwind across the C ABI
 }
@@ -693,26 +831,43 @@ double shifu_scorer_compute(void* handle, const double* row) {
 
 #ifdef SHIFU_SELFTEST_MAIN
 // Sanitizer self-test entry (see shifu_parser.cc counterpart): drives the
-// compute kernels with odd sizes (remainder rows for the 4-row matmul tile)
-// under ASan/UBSan.  Model loading is exercised separately through the
-// Python tests; this covers the math paths with no file dependency.
+// compute kernels under ASan/UBSan/TSan with shapes that hit every branch
+// of the register-blocked matmul (full 6x32 tiles, partial-width tile,
+// remainder rows), the hoisted activation loops, and — via a synthetic
+// in-TU model — the multithreaded compute_batch chunking.  Model-file
+// loading is exercised separately through the Python tests.
 #include <cstdio>
 int main() {
-  // matmul: m=7 exercises tiled (4) + remainder (3) paths, bias and no-bias
-  std::vector<float> x(7 * 5), w(5 * 3), b(3), y(7 * 3);
+  // matmul m=13, k=37, n=40: two full 6-row tiles + 1 remainder row; one
+  // full 32-wide tile + one 8-wide partial tile; bias and no-bias
+  const size_t M = 13, K = 37, N = 40;
+  std::vector<float> x(M * K), w(K * N), b(N), y(M * N);
   for (size_t i = 0; i < x.size(); ++i) x[i] = 0.01f * (float)i - 0.2f;
-  for (size_t i = 0; i < w.size(); ++i) w[i] = 0.02f * (float)i - 0.1f;
-  for (size_t i = 0; i < b.size(); ++i) b[i] = 0.5f;
-  matmul_bias(x.data(), w.data(), b.data(), y.data(), 7, 5, 3);
-  matmul_bias(x.data(), w.data(), nullptr, y.data(), 7, 5, 3);
-  // reference check against a scalar recompute of y[6][2] (no bias)
-  float want = 0.0f;
-  for (size_t j = 0; j < 5; ++j) want += x[6 * 5 + j] * w[j * 3 + 2];
-  if (std::fabs(y[6 * 3 + 2] - want) > 1e-5f) {
-    std::fprintf(stderr, "selftest: matmul mismatch\n");
-    return 1;
+  for (size_t i = 0; i < w.size(); ++i) w[i] = 0.002f * (float)i - 0.1f;
+  for (size_t i = 0; i < b.size(); ++i) b[i] = 0.5f - 0.01f * (float)i;
+  matmul_bias(x.data(), w.data(), b.data(), y.data(), M, K, N);
+  // scalar recompute of elements in the full tile (r2,c17), the partial
+  // tile (r2,c38), and the remainder row (r12,c5)
+  const size_t probes[][2] = {{2, 17}, {2, 38}, {12, 5}, {11, 33}};
+  for (auto& pr : probes) {
+    float want = b[pr[1]];
+    for (size_t j = 0; j < K; ++j) want += x[pr[0] * K + j] * w[j * N + pr[1]];
+    if (std::fabs(y[pr[0] * N + pr[1]] - want) > 1e-4f) {
+      std::fprintf(stderr, "selftest: matmul mismatch at %zu,%zu\n",
+                   pr[0], pr[1]);
+      return 1;
+    }
   }
+  matmul_bias(x.data(), w.data(), nullptr, y.data(), M, K, N);  // no-bias path
+
   for (uint32_t a = 0; a < 8; ++a) (void)apply_act(a, -0.3f);
+  std::vector<float> av(33), av2(33);
+  for (size_t i = 0; i < av.size(); ++i) av[i] = 0.1f * (float)i - 1.5f;
+  for (uint32_t a = 0; a < 6; ++a) {
+    apply_act_rows(a, av.data(), av2.data(), av.size());     // out-of-place
+    apply_act_rows(a, av2.data(), av2.data(), av2.size());   // in-place
+  }
+
   std::vector<float> ln_in(2 * 6), ln_s(6, 1.0f), ln_b(6, 0.0f), ln_out(2 * 6);
   for (size_t i = 0; i < ln_in.size(); ++i) ln_in[i] = (float)i * 0.1f;
   layernorm_rows(ln_in.data(), ln_s.data(), ln_b.data(), ln_out.data(), 2, 6);
@@ -723,6 +878,53 @@ int main() {
   if (std::fabs(s - 1.0f) > 1e-5f) {
     std::fprintf(stderr, "selftest: softmax not normalized\n");
     return 2;
+  }
+
+  // threaded compute_batch vs single-thread, on a synthetic 2-layer MLP
+  // built directly (same TU, no file): covers the chunk split, the shared
+  // arena pool, and rc aggregation under the sanitizers
+  Model model;
+  model.num_features = 35;
+  model.num_heads = 1;
+  Op d1;
+  d1.code = kDense; d1.dst = 1; d1.src = 0; d1.act = kRelu;
+  d1.a = 35; d1.b = 40;
+  d1.w0.resize(35 * 40); d1.w1.resize(40);
+  for (size_t i = 0; i < d1.w0.size(); ++i) d1.w0[i] = 0.01f * (float)(i % 71) - 0.3f;
+  for (size_t i = 0; i < d1.w1.size(); ++i) d1.w1[i] = 0.05f;
+  Op d2;
+  d2.code = kDense; d2.dst = 2; d2.src = 1; d2.act = kSigmoid;
+  d2.a = 40; d2.b = 1;
+  d2.w0.resize(40); d2.w1.resize(1, 0.1f);
+  for (size_t i = 0; i < d2.w0.size(); ++i) d2.w0[i] = 0.02f * (float)i - 0.35f;
+  model.ops = {d1, d2};
+  model.shapes.resize(3);
+  if (!infer_shapes(&model)) {
+    std::fprintf(stderr, "selftest: infer_shapes failed\n");
+    return 3;
+  }
+  const size_t batch = 2048 + 5;  // ragged: chunk boundaries not row-aligned
+  std::vector<float> rows(batch * 35), out1(batch), outN(batch);
+  for (size_t i = 0; i < rows.size(); ++i) rows[i] = 0.001f * (float)(i % 977) - 0.4f;
+  setenv("SHIFU_SCORER_THREADS", "1", 1);
+  if (shifu_scorer_compute_batch(&model, rows.data(), (int)batch, out1.data()) != 0) {
+    std::fprintf(stderr, "selftest: single-thread batch failed\n");
+    return 4;
+  }
+  setenv("SHIFU_SCORER_THREADS", "3", 1);
+  if (shifu_scorer_compute_batch(&model, rows.data(), (int)batch, outN.data()) != 0) {
+    std::fprintf(stderr, "selftest: threaded batch failed\n");
+    return 5;
+  }
+  for (size_t i = 0; i < batch; ++i) {
+    if (out1[i] != outN[i]) {
+      std::fprintf(stderr, "selftest: threaded result differs at %zu\n", i);
+      return 6;
+    }
+    if (!(out1[i] >= 0.0f && out1[i] <= 1.0f)) {
+      std::fprintf(stderr, "selftest: score out of [0,1] at %zu\n", i);
+      return 7;
+    }
   }
   std::puts("scorer selftest ok");
   return 0;

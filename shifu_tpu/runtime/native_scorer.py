@@ -150,7 +150,8 @@ def pack_native(export_dir: str) -> str:
 def build_library(out_dir: Optional[str] = None, force: bool = False) -> str:
     """Compile the C++ engine into a shared library (cached); returns path."""
     from .nativelib import build_library as _build
-    return _build("shifu_scorer.cc", out_dir=out_dir, force=force)
+    return _build("shifu_scorer.cc", extra_flags=["-pthread"],
+                  out_dir=out_dir, force=force)
 
 
 class NativeScorer:

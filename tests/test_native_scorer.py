@@ -66,6 +66,24 @@ def test_native_matches_python_scorer(artifact_dir):
     nat.close()
 
 
+def test_native_threaded_batch_identical(artifact_dir, monkeypatch):
+    """The multithreaded batch split must be bit-identical to 1 thread
+    (chunks are row-disjoint and every op is row-independent)."""
+    from shifu_tpu.runtime import NativeScorer
+    _, _, _, out = artifact_dir
+    nat = NativeScorer(out)
+    rng = np.random.default_rng(1)
+    # > kMinRowsPerThread(512) x 4 so four chunks genuinely form, with a
+    # ragged remainder row to cross chunk-boundary math
+    rows = rng.standard_normal((4 * 512 + 3, 10)).astype(np.float32)
+    monkeypatch.setenv("SHIFU_SCORER_THREADS", "1")
+    single = nat.compute_batch(rows)
+    monkeypatch.setenv("SHIFU_SCORER_THREADS", "4")
+    multi = nat.compute_batch(rows)
+    np.testing.assert_array_equal(single, multi)
+    nat.close()
+
+
 def test_native_matches_jax_forward(artifact_dir):
     from shifu_tpu.runtime import NativeScorer
     job, state, forward, out = artifact_dir

@@ -42,7 +42,7 @@ def _build_or_skip(source: str, **kw) -> str:
 
 def test_parser_selftest_asan_ubsan(tmp_path):
     exe = _build_or_skip("shifu_parser.cc",
-                         extra_flags=["-lz", "-lpthread", "-ldl"])
+                         extra_flags=["-lz", "-pthread", "-ldl"])
     # include the optional file path: exercises gzip inflate + count under ASan
     rows = np.random.default_rng(0).standard_normal((500, 8))
     text = "\n".join("|".join(f"{v:.5g}" for v in r) for r in rows) + "\n"
@@ -60,19 +60,29 @@ def test_parser_selftest_tsan():
 
     SURVEY.md §5.2: the reference had no race detection of any kind.  The
     parser's threaded path (chunk offset prefix-sum + disjoint-range writes
-    into one shared output buffer) is the framework's only intentional
-    data-parallel shared-memory write, so it gets a dedicated TSan run.
+    into one shared output buffer) gets a dedicated TSan run.
     """
     exe = _build_or_skip("shifu_parser.cc", sanitize="thread",
-                         extra_flags=["-lz", "-lpthread", "-ldl"])
+                         extra_flags=["-lz", "-pthread", "-ldl"])
     proc = subprocess.run([exe], capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "WARNING: ThreadSanitizer" not in proc.stderr
     assert "parser selftest ok" in proc.stdout
 
 
+def test_scorer_selftest_tsan():
+    """Race detection on the scorer's threaded batch split + shared arena
+    pool (the selftest runs compute_batch with SHIFU_SCORER_THREADS=3)."""
+    exe = _build_or_skip("shifu_scorer.cc", sanitize="thread",
+                         extra_flags=["-pthread"])
+    proc = subprocess.run([exe], capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "WARNING: ThreadSanitizer" not in proc.stderr
+    assert "scorer selftest ok" in proc.stdout
+
+
 def test_scorer_selftest_asan_ubsan():
-    exe = _build_or_skip("shifu_scorer.cc")
+    exe = _build_or_skip("shifu_scorer.cc", extra_flags=["-pthread"])
     proc = subprocess.run([exe], capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "scorer selftest ok" in proc.stdout
