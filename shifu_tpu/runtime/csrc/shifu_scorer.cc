@@ -714,11 +714,16 @@ void* shifu_scorer_load(const char* path) try {
   if (!f) return nullptr;
   auto model = new Model();
   uint32_t magic = 0, version = 0, num_bufs = 0, num_ops = 0;
+  // caps on header counts from the untrusted file: real programs have tens
+  // of ops/buffers; a corrupt count must reject cleanly, not value-
+  // initialize a multi-GB vector (sizeof(Op) is ~400 B)
+  constexpr uint32_t kMaxOps = 1u << 16, kMaxBufs = 1u << 16;
   bool ok = read_u32(f, &magic) && magic == kMagic &&
             read_u32(f, &version) && version == kVersion &&
             read_u32(f, &model->num_features) &&
             read_u32(f, &model->num_heads) && read_u32(f, &num_bufs) &&
-            read_u32(f, &num_ops) && num_bufs >= 1;
+            read_u32(f, &num_ops) && num_bufs >= 1 &&
+            num_bufs <= kMaxBufs && num_ops <= kMaxOps;
   if (ok) {
     model->ops.resize(num_ops);
     model->shapes.resize(num_bufs);
@@ -837,7 +842,27 @@ double shifu_scorer_compute(void* handle, const double* row) {
 // in-TU model — the multithreaded compute_batch chunking.  Model-file
 // loading is exercised separately through the Python tests.
 #include <cstdio>
-int main() {
+int main(int argc, char** argv) {
+  // fuzz mode: with a model path on argv[1], only load/score it — the math
+  // selftest below is covered by the dedicated sanitizer tests, and the
+  // fuzz harness invokes this binary once per mutant
+  if (argc > 1) {
+    void* h = shifu_scorer_load(argv[1]);
+    if (h) {
+      const int nf = shifu_scorer_num_features(h);
+      const int nh = shifu_scorer_num_heads(h);
+      if (nf > 0 && nf < (1 << 20) && nh > 0 && nh < (1 << 10)) {
+        std::vector<float> frow((size_t)nf, 0.0f), fout((size_t)nh);
+        (void)shifu_scorer_compute_batch(h, frow.data(), 1, fout.data());
+      }
+      shifu_scorer_free(h);
+      std::puts("model load ok");
+    } else {
+      std::puts("model load rejected");
+    }
+    std::puts("scorer selftest ok");
+    return 0;
+  }
   // matmul m=13, k=37, n=40: two full 6-row tiles + 1 remainder row; one
   // full 32-wide tile + one 8-wide partial tile; bias and no-bias
   const size_t M = 13, K = 37, N = 40;

@@ -70,6 +70,65 @@ def test_parser_selftest_tsan():
     assert "parser selftest ok" in proc.stdout
 
 
+@pytest.fixture(scope="module")
+def packed_model(tmp_path_factory):
+    """A small exported artifact with its packed model.bin."""
+    import jax
+
+    from shifu_tpu.config import (
+        DataConfig, JobConfig, ModelSpec, OptimizerConfig, TrainConfig)
+    from shifu_tpu.data import synthetic
+    from shifu_tpu.export import save_artifact
+    from shifu_tpu.runtime import pack_native
+    from shifu_tpu.train import init_state
+
+    schema = synthetic.make_schema(num_features=8)
+    job = JobConfig(
+        schema=schema, data=DataConfig(batch_size=32),
+        model=ModelSpec(model_type="mlp", hidden_nodes=(16,),
+                        activations=("relu",)),
+        train=TrainConfig(epochs=1, loss="weighted_mse",
+                          optimizer=OptimizerConfig(name="adadelta")),
+    ).validate()
+    state = init_state(job, 8)
+    out = str(tmp_path_factory.mktemp("fuzz") / "model")
+    save_artifact(jax.device_get(state.params), job, out)
+    return pack_native(out)
+
+
+def test_model_bin_fuzz_asan(packed_model, tmp_path):
+    """Corrupted/truncated model.bin files must be rejected or scored —
+    never crash.  Runs every mutant through the ASan/UBSan selftest binary,
+    so an out-of-bounds read in the untrusted-file loader fails here even
+    when it wouldn't segfault in production."""
+    exe = _build_or_skip("shifu_scorer.cc", extra_flags=["-pthread"])
+    blob = bytearray(open(packed_model, "rb").read())
+    proc = subprocess.run([exe, packed_model], capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 0 and "model load ok" in proc.stdout, (
+        proc.stdout + proc.stderr)
+
+    rng = np.random.default_rng(0)
+    mutant = tmp_path / "mutant.bin"
+    for trial in range(60):
+        m = bytearray(blob)
+        kind = trial % 3
+        if kind == 0:  # truncation
+            m = m[: rng.integers(0, len(m))]
+        elif kind == 1:  # single byte flip
+            i = int(rng.integers(0, len(m)))
+            m[i] ^= int(rng.integers(1, 256))
+        else:  # corrupt a 4-byte header/length field
+            i = int(rng.integers(0, max(1, len(m) // 4))) * 4
+            m[i:i + 4] = rng.integers(0, 256, 4, dtype=np.uint8).tobytes()
+        mutant.write_bytes(bytes(m))
+        proc = subprocess.run([exe, str(mutant)], capture_output=True,
+                              text=True, timeout=120)
+        assert proc.returncode == 0, (
+            f"trial {trial} (kind {kind}): rc={proc.returncode}\n"
+            + proc.stdout + proc.stderr)
+
+
 def test_scorer_selftest_tsan():
     """Race detection on the scorer's threaded batch split + shared arena
     pool (the selftest runs compute_batch with SHIFU_SCORER_THREADS=3)."""
