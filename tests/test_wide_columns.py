@@ -1,0 +1,100 @@
+"""BASELINE config #2 shape: a ~1000-column ColumnConfig driving Wide&Deep.
+
+The reference was only ever exercised on narrow WDBC-like tables; the
+baseline ladder explicitly calls for a ~1000-column risk-scoring setup
+(BASELINE.md configs, SURVEY.md §7.3 "synthetic 1000-col set").  This test
+runs the whole path at that width: Shifu JSON ingestion -> wide_deep train
+on an 8-device CPU mesh -> export -> numpy + native C++ scoring parity.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+N_COLS = 1000            # selected feature columns (target is column 0)
+N_CAT = 24               # categorical tail with binCategory vocabularies
+N_ROWS = 512
+
+
+@pytest.fixture(scope="module")
+def wide_job(tmp_path_factory):
+    from shifu_tpu.config import job_config_from_shifu
+    from shifu_tpu.data import synthetic
+
+    root = tmp_path_factory.mktemp("wide")
+    cols = [{"columnNum": 0, "columnName": "target", "columnFlag": "Target",
+             "columnType": "N", "finalSelect": False}]
+    for i in range(N_COLS):
+        is_cat = i >= N_COLS - N_CAT
+        entry = {"columnNum": i + 1, "columnName": f"f{i}",
+                 "columnType": "C" if is_cat else "N", "finalSelect": True}
+        if is_cat:
+            entry["columnBinning"] = {
+                "binCategory": [f"v{k}" for k in range(7)]}
+        cols.append(entry)
+    mc = {"basic": {"name": "wide_cols"},
+          "train": {"numTrainEpochs": 2, "validSetRate": 0.25,
+                    "algorithm": "NN",
+                    "params": {"NumHiddenLayers": 2,
+                               "NumHiddenNodes": [64, 32],
+                               "ActivationFunc": ["relu", "relu"],
+                               "LearningRate": 0.01}}}
+    mcp, ccp = str(root / "ModelConfig.json"), str(root / "ColumnConfig.json")
+    json.dump(mc, open(mcp, "w"))
+    json.dump(cols, open(ccp, "w"))
+
+    data_dir = str(root / "data")
+    rng = np.random.default_rng(11)
+    rows = rng.standard_normal((N_ROWS, N_COLS + 1)).astype(np.float32)
+    rows[:, 0] = (rng.random(N_ROWS) < 0.5).astype(np.float32)   # target
+    rows[:, N_COLS + 1 - N_CAT:] = rng.integers(                 # cat ids
+        0, 8, (N_ROWS, N_CAT)).astype(np.float32)
+    synthetic.write_files(rows, data_dir, num_files=2)
+
+    job = job_config_from_shifu(mcp, ccp, data_paths=(data_dir,))
+    job = dataclasses.replace(
+        job, model=dataclasses.replace(job.model, model_type="wide_deep",
+                                       embedding_dim=8,
+                                       compute_dtype="float32"))
+    return job.validate(), str(root / "export")
+
+
+def test_schema_ingestion_width(wide_job):
+    job, _ = wide_job
+    assert job.schema.feature_count == N_COLS
+    assert len(job.schema.categorical_indices) == N_CAT
+    # binCategory lists of 7 -> vocab 8 (unseen bucket)
+    by_index = {c.index: c for c in job.schema.columns}
+    assert all(by_index[i].vocab_size == 8
+               for i in job.schema.categorical_indices)
+
+
+def test_wide_train_export_score(wide_job):
+    from shifu_tpu.export import load_scorer, save_artifact
+    from shifu_tpu.runtime import NativeScorer
+    from shifu_tpu.train import make_forward_fn, train
+
+    from shifu_tpu.parallel import data_parallel_mesh
+
+    job, export_dir = wide_job
+    res = train(job, mesh=data_parallel_mesh(8))
+    assert len(res.history) == 2
+    assert np.isfinite(res.history[-1].valid_error)
+
+    import jax
+
+    forward = make_forward_fn(job, res.state.apply_fn)
+    save_artifact(jax.device_get(res.state.params), job, export_dir,
+                  forward_fn=forward)
+    py = load_scorer(export_dir)
+    nat = NativeScorer(export_dir)
+    rng = np.random.default_rng(0)
+    rows = rng.standard_normal((64, N_COLS)).astype(np.float32)
+    rows[:, N_COLS - N_CAT:] = rng.integers(0, 8, (64, N_CAT))
+    a, b = py.compute_batch(rows), nat.compute_batch(rows)
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    assert (b >= 0).all() and (b <= 1).all()
+    nat.close()
