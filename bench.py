@@ -60,17 +60,17 @@ def _ladder_extras(mesh, n_chips: int) -> dict:
     rungs = [
         ("wide_deep", ModelSpec(model_type="wide_deep", hidden_nodes=(100, 100),
                                 activations=("relu", "relu"), embedding_dim=16,
-                                compute_dtype="bfloat16"), 32768, 8),
+                                compute_dtype="bfloat16"), 32768, 32),
         ("deepfm", ModelSpec(model_type="deepfm", hidden_nodes=(100, 100),
                              activations=("relu", "relu"), embedding_dim=16,
-                             compute_dtype="bfloat16"), 32768, 8),
+                             compute_dtype="bfloat16"), 32768, 32),
         ("multitask", ModelSpec(model_type="multitask", hidden_nodes=(100, 100),
                                 activations=("relu", "relu"), num_heads=2,
                                 head_names=("shifu_output_0", "shifu_output_1"),
-                                compute_dtype="bfloat16"), 32768, 8),
+                                compute_dtype="bfloat16"), 32768, 32),
         ("ft_transformer", ModelSpec(model_type="ft_transformer", token_dim=64,
                                      num_layers=3, num_attention_heads=8,
-                                     compute_dtype="bfloat16"), 4096, 8),
+                                     compute_dtype="bfloat16"), 4096, 16),
     ]
     out = {}
     rng = np.random.default_rng(7)
@@ -102,13 +102,16 @@ def _ladder_extras(mesh, n_chips: int) -> dict:
         order = jnp.arange(nb, dtype=jnp.int32)
         st, last = step(state, blocks, order)
         float(last)  # compile + sync
-        epochs = 5
-        t0 = time.perf_counter()
-        for _ in range(epochs):
-            st, last = step(st, blocks, order)
-        float(last)
-        out[f"ladder_{name}_samples_per_sec_per_chip"] = round(
-            epochs * nb * bs / (time.perf_counter() - t0) / n_chips, 1)
+        best = 0.0
+        for _ in range(3):  # best-of-3 (see headline tier)
+            epochs = 3
+            t0 = time.perf_counter()
+            for _ in range(epochs):
+                st, last = step(st, blocks, order)
+            float(last)
+            best = max(best,
+                       epochs * nb * bs / (time.perf_counter() - t0) / n_chips)
+        out[f"ladder_{name}_samples_per_sec_per_chip"] = round(best, 1)
       except Exception as e:  # a failed rung must not discard measured ones
         out[f"ladder_{name}_error"] = str(e)[:200]
     return out
