@@ -120,3 +120,71 @@ def test_optimizer_explicit_wins_over_propagation():
     mc["train"]["params"]["Optimizer"] = "adam"
     _, tc, _ = parse_model_config(mc)
     assert tc.optimizer.name == "adam"
+
+
+def test_multi_target_mode_from_shifu_json(tmp_path):
+    """BASELINE config #4 shape: Shifu multi-target mode (fraud + chargeback
+    heads) selected entirely from unchanged ModelConfig/ColumnConfig JSON --
+    dataSet.multiTargetColumnNames + algorithm MTL -> multitask model."""
+    import gzip
+
+    import numpy as np
+
+    mc = {
+        "basic": {"name": "fraud_cb"},
+        "dataSet": {"multiTargetColumnNames": ["fraud", "chargeback"]},
+        "train": {
+            "numTrainEpochs": 2,
+            "validSetRate": 0.25,
+            "algorithm": "MTL",
+            "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [16],
+                       "ActivationFunc": ["relu"], "LearningRate": 0.02},
+        },
+    }
+    cols = [
+        {"columnNum": 0, "columnName": "fraud", "columnType": "N"},
+        {"columnNum": 1, "columnName": "chargeback", "columnType": "N"},
+    ] + [{"columnNum": i + 2, "columnName": f"f{i}", "columnType": "N",
+          "finalSelect": True} for i in range(12)]
+    mcp, ccp = tmp_path / "ModelConfig.json", tmp_path / "ColumnConfig.json"
+    mcp.write_text(json.dumps(mc))
+    ccp.write_text(json.dumps(cols))
+
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    rng = np.random.default_rng(5)
+    rows = rng.standard_normal((600, 14)).astype(np.float32)
+    rows[:, 0] = (rng.random(600) < 0.5).astype(np.float32)
+    rows[:, 1] = (rng.random(600) < 0.3).astype(np.float32)
+    with gzip.open(data_dir / "part-000.gz", "wt") as f:
+        for r in rows:
+            f.write("|".join(f"{v:.6g}" for v in r) + "\n")
+
+    job = job_config_from_shifu(str(mcp), str(ccp), data_paths=(str(data_dir),))
+    assert job.model.model_type == "multitask"
+    assert job.model.num_heads == 2
+    assert job.model.head_names == ("shifu_output_0", "shifu_output_1")
+    assert job.schema.target_indices == (0, 1)
+    assert job.schema.feature_count == 12
+
+    # end to end: train both heads, export, score -> (N, 2) in [0,1]
+    import jax
+
+    from shifu_tpu.export import load_scorer, save_artifact
+    from shifu_tpu.runtime import NativeScorer
+    from shifu_tpu.train import make_forward_fn, train
+
+    res = train(job)
+    assert len(res.history) == 2
+    export_dir = str(tmp_path / "export")
+    forward = make_forward_fn(job, res.state.apply_fn)
+    save_artifact(jax.device_get(res.state.params), job, export_dir,
+                  forward_fn=forward)
+    score_rows = rng.standard_normal((32, 12)).astype(np.float32)
+    a = load_scorer(export_dir).compute_batch(score_rows)
+    nat = NativeScorer(export_dir)
+    b = nat.compute_batch(score_rows)
+    assert a.shape == b.shape == (32, 2)
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    assert (b >= 0).all() and (b <= 1).all()
+    nat.close()
