@@ -300,6 +300,12 @@ class RuntimeConfig:
     max_restarts: int = 2           # checkpoint-restart budget; successor of backup-worker promotion (TensorflowApplicationMaster.java:410-426)
     final_model_path: str = ""      # FINAL_MODEL_PATH env in the reference
     tmp_model_path: str = ""        # TMP_MODEL_PATH env in the reference
+    # Kerberos for secured HDFS access — successor of the reference client's
+    # delegation-token fetch (TensorflowClient.java:481-502); a configured
+    # principal+keytab runs kinit before data access, otherwise the ambient
+    # ticket cache is used (libhdfs via pyarrow.fs picks it up)
+    kerberos_principal: str = ""
+    kerberos_keytab: str = ""
     distributed: bool = False       # multi-host: jax.distributed.initialize
 
 

@@ -61,6 +61,8 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--input", required=True, help="pipe-delimited rows file")
     s.add_argument("--output", default="-", help="output file (- = stdout)")
     s.add_argument("--native", action="store_true", help="use the C++ engine")
+    s.add_argument("--globalconfig", default=None,
+                   help="Hadoop-style XML (shifu.security.* for secured HDFS)")
 
     e = sub.add_parser(
         "eval", help="score labeled rows and report AUC/error (the Shifu "
@@ -75,7 +77,32 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--scores-output", default=None,
                    help="also write per-row scores to this file")
     e.add_argument("--native", action="store_true", help="use the C++ engine")
+    e.add_argument("--globalconfig", default=None,
+                   help="Hadoop-style XML (shifu.security.* for secured HDFS)")
     return p
+
+
+def _kerberos_from_xml(globalconfig) -> int:
+    """Acquire a Kerberos ticket for score/eval when --globalconfig carries
+    shifu.security.kerberos.* keys (same fail-fast as run_train); returns an
+    exit code (EXIT_OK to proceed)."""
+    if not globalconfig:
+        return EXIT_OK
+    from types import SimpleNamespace
+
+    from ..utils import xmlconfig
+    from .security import KerberosError, ensure_kerberos_ticket
+
+    conf = xmlconfig.parse_configuration_xml(globalconfig)
+    rt = SimpleNamespace(
+        kerberos_principal=conf.get(xmlconfig.KEY_KERBEROS_PRINCIPAL, ""),
+        kerberos_keytab=conf.get(xmlconfig.KEY_KERBEROS_KEYTAB, ""))
+    try:
+        ensure_kerberos_ticket(rt)
+    except KerberosError as e:
+        print(f"kerberos auth failed: {e}", flush=True)
+        return EXIT_FAIL
+    return EXIT_OK
 
 
 def _assemble_job(args) -> "JobConfig":
@@ -132,6 +159,19 @@ def _assemble_job(args) -> "JobConfig":
 
 def run_train(args) -> int:
     job, out_dir = _assemble_job(args)
+
+    # secured HDFS: acquire the Kerberos ticket before any data access
+    # (successor of the reference client's delegation-token fetch,
+    # TensorflowClient.java:481-502); no-op unless a principal is configured
+    from .security import KerberosError, ensure_kerberos_ticket
+    try:
+        # under --supervise each restart attempt re-enters run_train in a
+        # fresh child process (child_args below), re-running kinit — so
+        # long jobs refresh the ticket on every restart
+        ensure_kerberos_ticket(job.runtime)
+    except KerberosError as e:
+        print(f"kerberos auth failed: {e}", flush=True)
+        return EXIT_FAIL
 
     if args.supervise:
         from .supervisor import supervise
@@ -304,6 +344,9 @@ def _project_features(rows, model_dir: str, scorer):
 def run_score(args) -> int:
     from ..data import reader
 
+    rc = _kerberos_from_xml(args.globalconfig)
+    if rc != EXIT_OK:
+        return rc
     rows = reader.read_file(args.input)
     scorer = _load_scorer(args.model, args.native)
     scores = scorer.compute_batch(_project_features(rows, args.model, scorer))
@@ -347,6 +390,9 @@ def run_eval(args) -> int:
     from ..data import reader
     from ..ops.metrics import auc, weighted_error
 
+    rc = _kerberos_from_xml(args.globalconfig)
+    if rc != EXIT_OK:
+        return rc
     target_name = weight_name = None
     if args.modelconfig:
         dataset = load_json(args.modelconfig).get("dataSet", {}) or {}

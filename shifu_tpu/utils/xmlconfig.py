@@ -35,6 +35,10 @@ KEY_MESH_DATA = "shifu.mesh.data"
 KEY_MESH_MODEL = "shifu.mesh.model"
 KEY_MESH_SEQ = "shifu.mesh.seq"
 # input-pipeline knobs (no reference analog: its loader was fixed-function)
+# secured-HDFS auth (successor of the reference's Kerberos delegation
+# tokens, TensorflowClient.java:481-502)
+KEY_KERBEROS_PRINCIPAL = "shifu.security.kerberos.principal"
+KEY_KERBEROS_KEYTAB = "shifu.security.kerberos.keytab"
 KEY_DATA_CACHE_DIR = "shifu.data.cache-dir"
 KEY_DATA_OUT_OF_CORE = "shifu.data.out-of-core"
 KEY_DATA_READ_THREADS = "shifu.data.read-threads"
@@ -140,6 +144,10 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         rt_kw["checkpoint"] = ck
     if KEY_MAX_RESTARTS in conf:
         rt_kw["max_restarts"] = int(conf[KEY_MAX_RESTARTS])
+    if KEY_KERBEROS_PRINCIPAL in conf:
+        rt_kw["kerberos_principal"] = conf[KEY_KERBEROS_PRINCIPAL]
+    if KEY_KERBEROS_KEYTAB in conf:
+        rt_kw["kerberos_keytab"] = conf[KEY_KERBEROS_KEYTAB]
     if KEY_MESH_DATA in conf or KEY_MESH_MODEL in conf or KEY_MESH_SEQ in conf:
         rt_kw["mesh"] = dataclasses.replace(
             runtime.mesh,

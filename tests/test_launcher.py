@@ -218,3 +218,73 @@ def test_mesh_from_globalconfig_sequence_parallel(job_dir):
     assert job["model"]["attention_impl"] == "ring"
     assert "falling back to local attention" not in r.stdout
     assert "Epoch 0:" in r.stdout
+
+
+def test_kerberos_config_and_kinit(monkeypatch, tmp_path):
+    """shifu.security.kerberos.* keys reach RuntimeConfig and drive kinit
+    (successor of the reference's delegation-token fetch,
+    TensorflowClient.java:481-502)."""
+    from shifu_tpu.config.schema import RuntimeConfig
+    from shifu_tpu.launcher.security import KerberosError, ensure_kerberos_ticket
+    from shifu_tpu.utils import xmlconfig
+
+    conf = {xmlconfig.KEY_KERBEROS_PRINCIPAL: "shifu@EXAMPLE.COM",
+            xmlconfig.KEY_KERBEROS_KEYTAB: "/etc/shifu.keytab"}
+
+    class _Job:
+        train = None
+        data = None
+        runtime = RuntimeConfig()
+
+        def replace(self, **kw):
+            for k, v in kw.items():
+                setattr(self, k, v)
+            return self
+
+    job = xmlconfig.apply_to_job(_Job(), conf)
+    assert job.runtime.kerberos_principal == "shifu@EXAMPLE.COM"
+    assert job.runtime.kerberos_keytab == "/etc/shifu.keytab"
+
+    # no principal -> no-op
+    assert ensure_kerberos_ticket(RuntimeConfig()) is False
+    # half-configured is a misconfiguration, not a silent no-op
+    with pytest.raises(KerberosError, match="without shifu.security.kerberos.principal"):
+        ensure_kerberos_ticket(RuntimeConfig(kerberos_keytab="/k.keytab"))
+    with pytest.raises(KerberosError, match="without shifu.security.kerberos.keytab"):
+        ensure_kerberos_ticket(RuntimeConfig(kerberos_principal="p@R"))
+
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+
+        class R:
+            returncode = 0
+            stderr = ""
+            stdout = ""
+        return R()
+
+    monkeypatch.setattr("shutil.which", lambda name: "/usr/bin/kinit")
+    monkeypatch.setattr("subprocess.run", fake_run)
+    assert ensure_kerberos_ticket(job.runtime) is True
+    assert calls == [["/usr/bin/kinit", "-kt", "/etc/shifu.keytab",
+                      "shifu@EXAMPLE.COM"]]
+
+    # kinit missing -> fail fast with a clear error
+    monkeypatch.setattr("shutil.which", lambda name: None)
+    with pytest.raises(KerberosError, match="no `kinit`"):
+        ensure_kerberos_ticket(job.runtime)
+
+    # kinit failure -> surfaced stderr
+    monkeypatch.setattr("shutil.which", lambda name: "/usr/bin/kinit")
+
+    def fail_run(cmd, **kw):
+        class R:
+            returncode = 1
+            stderr = "keytab not found"
+            stdout = ""
+        return R()
+
+    monkeypatch.setattr("subprocess.run", fail_run)
+    with pytest.raises(KerberosError, match="keytab not found"):
+        ensure_kerberos_ticket(job.runtime)
