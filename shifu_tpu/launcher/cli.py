@@ -88,19 +88,15 @@ def _kerberos_from_xml(globalconfig) -> int:
     exit code (EXIT_OK to proceed)."""
     if not globalconfig:
         return EXIT_OK
-    from types import SimpleNamespace
-
     from ..utils import xmlconfig
     from .security import KerberosError, ensure_kerberos_ticket
 
     conf = xmlconfig.parse_configuration_xml(globalconfig)
-    rt = SimpleNamespace(
-        kerberos_principal=conf.get(xmlconfig.KEY_KERBEROS_PRINCIPAL, ""),
-        kerberos_keytab=conf.get(xmlconfig.KEY_KERBEROS_KEYTAB, ""))
     try:
-        ensure_kerberos_ticket(rt)
+        ensure_kerberos_ticket(conf.get(xmlconfig.KEY_KERBEROS_PRINCIPAL, ""),
+                               conf.get(xmlconfig.KEY_KERBEROS_KEYTAB, ""))
     except KerberosError as e:
-        print(f"kerberos auth failed: {e}", flush=True)
+        print(f"kerberos auth failed: {e}", file=sys.stderr, flush=True)
         return EXIT_FAIL
     return EXIT_OK
 
@@ -165,12 +161,13 @@ def run_train(args) -> int:
     # TensorflowClient.java:481-502); no-op unless a principal is configured
     from .security import KerberosError, ensure_kerberos_ticket
     try:
-        # under --supervise each restart attempt re-enters run_train in a
-        # fresh child process (child_args below), re-running kinit — so
-        # long jobs refresh the ticket on every restart
-        ensure_kerberos_ticket(job.runtime)
+        # supervisor restarts re-enter run_train in a fresh child process
+        # (child_args below) and re-kinit; healthy long runs renew
+        # periodically from the epoch callback below
+        ensure_kerberos_ticket(job.runtime.kerberos_principal,
+                               job.runtime.kerberos_keytab)
     except KerberosError as e:
-        print(f"kerberos auth failed: {e}", flush=True)
+        print(f"kerberos auth failed: {e}", file=sys.stderr, flush=True)
         return EXIT_FAIL
 
     if args.supervise:
@@ -242,10 +239,22 @@ def run_train(args) -> int:
     deadline = (time.monotonic() + job.runtime.timeout_seconds
                 if job.runtime.timeout_seconds else None)
 
+    # ticket renewal for healthy long runs: re-kinit from the per-epoch
+    # callback once half a typical 10h ticket lifetime has passed, so a job
+    # streaming hdfs:// data never outlives its credentials mid-read
+    kinit_renew_s = 4 * 3600
+    last_kinit = time.monotonic()
+
     def check_timeout(_m):
+        nonlocal last_kinit
         if deadline is not None and time.monotonic() > deadline:
             board(f"job timeout ({job.runtime.timeout_seconds}s) exceeded — aborting")
             raise TimeoutError("job timeout")
+        if (job.runtime.kerberos_principal
+                and time.monotonic() - last_kinit > kinit_renew_s):
+            ensure_kerberos_ticket(job.runtime.kerberos_principal,
+                                   job.runtime.kerberos_keytab)
+            last_kinit = time.monotonic()
         _maybe_inject_fault(_m, board)
 
     try:

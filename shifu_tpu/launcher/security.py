@@ -24,16 +24,16 @@ class KerberosError(RuntimeError):
     """kinit was required but unavailable or failed."""
 
 
-def ensure_kerberos_ticket(runtime) -> bool:
-    """Acquire a ticket if `runtime.kerberos_principal` is configured.
+def ensure_kerberos_ticket(principal: str = "", keytab: str = "") -> bool:
+    """Acquire a ticket if a principal is configured.
 
     Returns True when a kinit ran successfully, False for the no-op case.
     Raises KerberosError when a principal is configured but the ticket
     cannot be obtained (missing kinit, missing keytab, kinit failure) —
     failing fast here beats an opaque libhdfs GSSAPI error mid-read.
     """
-    principal = getattr(runtime, "kerberos_principal", "") or ""
-    keytab = getattr(runtime, "kerberos_keytab", "") or ""
+    principal = principal or ""
+    keytab = keytab or ""
     if not principal:
         if keytab:
             raise KerberosError(

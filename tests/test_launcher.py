@@ -246,12 +246,12 @@ def test_kerberos_config_and_kinit(monkeypatch, tmp_path):
     assert job.runtime.kerberos_keytab == "/etc/shifu.keytab"
 
     # no principal -> no-op
-    assert ensure_kerberos_ticket(RuntimeConfig()) is False
+    assert ensure_kerberos_ticket() is False
     # half-configured is a misconfiguration, not a silent no-op
     with pytest.raises(KerberosError, match="without shifu.security.kerberos.principal"):
-        ensure_kerberos_ticket(RuntimeConfig(kerberos_keytab="/k.keytab"))
+        ensure_kerberos_ticket(keytab="/k.keytab")
     with pytest.raises(KerberosError, match="without shifu.security.kerberos.keytab"):
-        ensure_kerberos_ticket(RuntimeConfig(kerberos_principal="p@R"))
+        ensure_kerberos_ticket(principal="p@R")
 
     calls = []
 
@@ -266,14 +266,16 @@ def test_kerberos_config_and_kinit(monkeypatch, tmp_path):
 
     monkeypatch.setattr("shutil.which", lambda name: "/usr/bin/kinit")
     monkeypatch.setattr("subprocess.run", fake_run)
-    assert ensure_kerberos_ticket(job.runtime) is True
+    assert ensure_kerberos_ticket(job.runtime.kerberos_principal,
+                                  job.runtime.kerberos_keytab) is True
     assert calls == [["/usr/bin/kinit", "-kt", "/etc/shifu.keytab",
                       "shifu@EXAMPLE.COM"]]
 
     # kinit missing -> fail fast with a clear error
     monkeypatch.setattr("shutil.which", lambda name: None)
     with pytest.raises(KerberosError, match="no `kinit`"):
-        ensure_kerberos_ticket(job.runtime)
+        ensure_kerberos_ticket(job.runtime.kerberos_principal,
+                               job.runtime.kerberos_keytab)
 
     # kinit failure -> surfaced stderr
     monkeypatch.setattr("shutil.which", lambda name: "/usr/bin/kinit")
@@ -287,4 +289,5 @@ def test_kerberos_config_and_kinit(monkeypatch, tmp_path):
 
     monkeypatch.setattr("subprocess.run", fail_run)
     with pytest.raises(KerberosError, match="keytab not found"):
-        ensure_kerberos_ticket(job.runtime)
+        ensure_kerberos_ticket(job.runtime.kerberos_principal,
+                               job.runtime.kerberos_keytab)
