@@ -402,14 +402,16 @@ def run_eval(args) -> int:
     rc = _kerberos_from_xml(args.globalconfig)
     if rc != EXIT_OK:
         return rc
-    target_name = weight_name = None
+    target_name = weight_name = multi_targets = None
     if args.modelconfig:
         dataset = load_json(args.modelconfig).get("dataSet", {}) or {}
         target_name = dataset.get("targetColumnName")
         weight_name = dataset.get("weightColumnName")
+        multi_targets = dataset.get("multiTargetColumnNames")
     schema = parse_column_config(load_json(args.columnconfig),
                                  target_column_name=target_name,
-                                 weight_column_name=weight_name)
+                                 weight_column_name=weight_name,
+                                 multi_target_names=multi_targets)
 
     paths: list[str] = []
     for p in args.data:
@@ -435,7 +437,8 @@ def run_eval(args) -> int:
         return EXIT_FAIL
     scores = scorer.compute_batch(np.concatenate(feats_l, axis=0))
 
-    labels = np.concatenate(target_l, axis=0)[:, 0]
+    labels_m = np.concatenate(target_l, axis=0)
+    labels = labels_m[:, 0]
     weights = np.concatenate(weight_l, axis=0)[:, 0]
 
     def _round_finite(v: float, nd: int = 6):
@@ -443,14 +446,33 @@ def run_eval(args) -> int:
         import math
         return round(float(v), nd) if math.isfinite(float(v)) else None
 
+    # Head names come from the schema's *resolved* target columns (in
+    # target-index order), not the raw multiTargetColumnNames list — a name
+    # the ColumnConfig doesn't contain would otherwise shift every
+    # subsequent head's metrics under the wrong label.
+    name_by_index = {c.index: c.name for c in schema.columns}
+    resolved_names = [name_by_index.get(i, f"head_{h}")
+                      for h, i in enumerate(schema.all_target_indices)]
+    if scores.shape[1] != labels_m.shape[1]:
+        print(f"eval: artifact has {scores.shape[1]} heads but "
+              f"{labels_m.shape[1]} target columns resolved from the configs "
+              "— reporting the overlap only", file=sys.stderr)
+    n_heads = min(scores.shape[1], labels_m.shape[1])
+    heads = [
+        {"name": resolved_names[h] if h < len(resolved_names) else f"head_{h}",
+         "auc": _round_finite(auc(scores[:, h], labels_m[:, h], weights)),
+         "weighted_error": _round_finite(
+             weighted_error(scores[:, h], labels_m[:, h], weights))}
+        for h in range(n_heads)]
     summary = {
         "rows": int(labels.shape[0]),
-        "auc": _round_finite(auc(scores[:, 0], labels, weights)),
-        "weighted_error": _round_finite(
-            weighted_error(scores[:, 0], labels, weights)),
+        "auc": heads[0]["auc"],
+        "weighted_error": heads[0]["weighted_error"],
         "mean_score": _round_finite(scores[:, 0].mean()),
         "positive_rate": _round_finite((labels > 0.5).mean()),
     }
+    if n_heads > 1:
+        summary["heads"] = heads
     if args.scores_output:
         with open(args.scores_output, "w") as f:
             for s in scores:

@@ -291,3 +291,51 @@ def test_kerberos_config_and_kinit(monkeypatch, tmp_path):
     with pytest.raises(KerberosError, match="keytab not found"):
         ensure_kerberos_ticket(job.runtime.kerberos_principal,
                                job.runtime.kerberos_keytab)
+
+
+def test_eval_cli_multi_target_per_head(tmp_path):
+    """Multi-target mode through the full CLI: train MTL from JSON, then
+    `eval` reports per-head AUC/error alongside the head-0 summary."""
+    from shifu_tpu.data import synthetic
+
+    mc = {
+        "dataSet": {"multiTargetColumnNames": ["fraud", "chargeback"]},
+        "train": {"validSetRate": 0.2, "numTrainEpochs": 1, "algorithm": "MTL",
+                  "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                             "ActivationFunc": ["relu"], "LearningRate": 0.01}},
+    }
+    cols = [{"columnNum": 0, "columnName": "fraud", "columnType": "N"},
+            {"columnNum": 1, "columnName": "chargeback", "columnType": "N"}]
+    cols += [{"columnNum": i + 2, "columnName": f"f{i}", "columnType": "N",
+              "finalSelect": True} for i in range(8)]
+    (tmp_path / "ModelConfig.json").write_text(json.dumps(mc))
+    (tmp_path / "ColumnConfig.json").write_text(json.dumps(cols))
+
+    rng = np.random.default_rng(3)
+    rows = rng.standard_normal((800, 10)).astype(np.float32)
+    rows[:, 0] = (rng.random(800) < 0.5).astype(np.float32)
+    rows[:, 1] = (rng.random(800) < 0.3).astype(np.float32)
+    synthetic.write_files(rows, str(tmp_path / "normalized"), num_files=2)
+
+    out = tmp_path / "out"
+    r = _run_cli(["train",
+                  "--modelconfig", str(tmp_path / "ModelConfig.json"),
+                  "--columnconfig", str(tmp_path / "ColumnConfig.json"),
+                  "--data", str(tmp_path / "normalized"),
+                  "--output", str(out)])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    r2 = _run_cli(["eval", "--model", str(out / "final_model"),
+                   "--modelconfig", str(tmp_path / "ModelConfig.json"),
+                   "--columnconfig", str(tmp_path / "ColumnConfig.json"),
+                   "--data", str(tmp_path / "normalized")])
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    summary = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert summary["rows"] == 800
+    heads = summary["heads"]
+    assert [h["name"] for h in heads] == ["fraud", "chargeback"]
+    for h in heads:
+        assert h["auc"] is None or 0.0 <= h["auc"] <= 1.0
+        assert h["weighted_error"] is not None
+    # head 0 of the per-head block matches the top-level summary
+    assert heads[0]["auc"] == summary["auc"]
