@@ -219,3 +219,33 @@ def test_native_rejects_giant_length_fields(tmp_path):
     bad2.write_bytes(header + struct.pack("<3I", 2, 1, 0)
                      + struct.pack("<3I", 4, 2**31, 2**31))
     assert lib.shifu_scorer_load(str(bad2).encode()) is None
+
+
+def test_concurrent_scoring_same_handle(artifact_dir):
+    """Shifu's eval step scores from a thread pool (the reference's
+    TensorflowModel.compute was called concurrently per eval row); one
+    NativeScorer handle must serve concurrent compute/compute_batch calls
+    with results identical to serial scoring.  ctypes releases the GIL, so
+    this genuinely exercises the C engine concurrently (model is read-only
+    after load; intermediate arenas come from a mutex-guarded pool)."""
+    import concurrent.futures
+
+    from shifu_tpu.runtime import NativeScorer
+    _, _, _, out = artifact_dir
+    nat = NativeScorer(out)
+    rng = np.random.default_rng(2)
+    rows = rng.standard_normal((512, 10)).astype(np.float32)
+    expect_batch = nat.compute_batch(rows)
+    expect_single = [nat.compute(np.asarray(r, np.float64)) for r in rows[:32]]
+
+    def worker(seed):
+        got_b = nat.compute_batch(rows)
+        got_s = [nat.compute(np.asarray(r, np.float64)) for r in rows[:32]]
+        np.testing.assert_array_equal(got_b, expect_batch)
+        assert got_s == expect_single
+        return True
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+        assert all(f.result() for f in
+                   [ex.submit(worker, i) for i in range(16)])
+    nat.close()
