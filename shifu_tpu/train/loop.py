@@ -43,12 +43,17 @@ class EpochMetrics:
     epoch_time: float
     valid_time: float
 
-    def console_line(self) -> str:
+    def console_line(self, total_epochs: int = 0) -> str:
         # Reference line shape: worker_index,time,current_epoch,training_loss,
-        # valid_loss,valid_time (ssgd_monitor.py:287-293) aggregated by the AM.
+        # valid_loss,valid_time (ssgd_monitor.py:287-293) aggregated by the AM;
+        # progress % mirrors the AM's globalEpoch/totalEpochs report incl.
+        # resumed-epoch offset (AMRMCallbackHandler.java:224-244).
+        progress = (f" progress={100.0 * (self.epoch + 1) / total_epochs:.0f}%"
+                    if total_epochs > 0 else "")
         return (f"Epoch {self.epoch}: train_error={self.train_error:.6f} "
                 f"valid_error={self.valid_error:.6f} valid_auc={self.valid_auc:.4f} "
-                f"time={self.epoch_time:.2f}s valid_time={self.valid_time:.2f}s")
+                f"time={self.epoch_time:.2f}s valid_time={self.valid_time:.2f}s"
+                f"{progress}")
 
 
 @dataclasses.dataclass
@@ -284,7 +289,7 @@ def train(job: JobConfig,
             valid_time=valid_time,
         )
         history.append(m)
-        console(m.console_line())
+        console(m.console_line(job.train.epochs))
         if timing_on:
             console(timer.console_line())
 
