@@ -141,6 +141,15 @@ def _assemble_job(args) -> "JobConfig":
             runtime, final_model_path=os.path.join(out_dir, "final_model"))
     job = job.replace(train=train, data=data, runtime=runtime)
 
+    # persist the raw Shifu inputs beside the derived configs, like the
+    # reference client's per-app upload of ModelConfig/ColumnConfig
+    # (TensorflowClient.java:356-382) — the job dir alone reproduces the run
+    import shutil
+    for src in (args.modelconfig, args.columnconfig):
+        dst = os.path.join(out_dir, os.path.basename(src))
+        if os.path.abspath(src) != os.path.abspath(dst):
+            shutil.copyfile(src, dst)
+
     # persist the merged view (global-final.xml parity + typed JSON)
     xmlconfig.write_configuration_xml(
         {**merged_xml,
