@@ -147,7 +147,8 @@ def _assemble_job(args) -> "JobConfig":
     import shutil
     for src in (args.modelconfig, args.columnconfig):
         dst = os.path.join(out_dir, os.path.basename(src))
-        if os.path.abspath(src) != os.path.abspath(dst):
+        # realpath: a symlinked cwd can alias src and dst (SameFileError)
+        if os.path.realpath(src) != os.path.realpath(dst):
             shutil.copyfile(src, dst)
 
     # persist the merged view (global-final.xml parity + typed JSON)
