@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Shared demo runner: generate the demo in $1's dir, train (Shifu configs
+# unchanged), export, then score with BOTH the numpy interpreter and the
+# native C++ engine and show they agree.
+# Usage: _run_demo.sh <demo_dir> [out_dir]
+set -euo pipefail
+DEMO_DIR="$(cd "$1" && pwd)"
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="$ROOT${PYTHONPATH:+:$PYTHONPATH}"
+cd "$DEMO_DIR"
+
+OUT="${2:-generated}"
+python make_demo.py --out "$OUT"
+
+python -m shifu_tpu.launcher.cli train \
+    --modelconfig "$OUT/ModelConfig.json" \
+    --columnconfig "$OUT/ColumnConfig.json" \
+    --data "$OUT/data" \
+    --output "$OUT/job"
+
+INPUT="$(ls "$OUT"/data/part-* | head -1)"
+python -m shifu_tpu.launcher.cli score \
+    --model "$OUT/job/final_model" --input "$INPUT" \
+    --output "$OUT/scores_python.txt"
+if command -v g++ >/dev/null 2>&1; then
+    python -m shifu_tpu.launcher.cli score \
+        --model "$OUT/job/final_model" --input "$INPUT" \
+        --output "$OUT/scores_native.txt" --native
+else
+    echo "g++ not found: skipping the native-engine scoring comparison"
+fi
+
+python - "$OUT" <<'PYEOF'
+import os
+import sys
+import numpy as np
+out = sys.argv[1]
+a = np.loadtxt(f"{out}/scores_python.txt")
+print(f"scored {len(a)} rows (python engine)")
+native = f"{out}/scores_native.txt"
+if os.path.exists(native):
+    b = np.loadtxt(native)
+    print(f"python-vs-native max delta: {np.abs(a-b).max():.2e}")
+    assert np.abs(a - b).max() < 1e-5
+print("demo OK")
+PYEOF

@@ -1,0 +1,106 @@
+"""Generate the bundled CTR demo: mixed numeric + categorical data driving a
+DeepFM (BASELINE.md config #3 — sparse embedding tables, data-parallel).
+
+Same artifact set as the WDBC demo (Shifu-normalized gzip part files +
+unchanged ModelConfig/ColumnConfig JSON), but the last CAT_FEATURES columns
+are high-cardinality categorical ids with binCategory vocabularies in
+ColumnConfig — the input shape that exercises the embedding path
+(models/embedding.py) and, with `shifu.mesh.model > 1`, vocab-sharded
+tables.
+
+Usage: python make_demo.py [--out DIR] [--rows N] [--epochs E]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(os.path.dirname(_HERE))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+NUM_FEATURES = 26   # 18 numeric + 8 categorical (criteo-like mix, no download)
+CAT_FEATURES = 8
+VOCAB = 500
+
+
+def write_demo(out_dir: str, rows: int = 6000, epochs: int = 12,
+               seed: int = 11) -> dict[str, str]:
+    from shifu_tpu.data import synthetic
+
+    os.makedirs(out_dir, exist_ok=True)
+    schema = synthetic.make_schema(num_features=NUM_FEATURES,
+                                   num_categorical=CAT_FEATURES,
+                                   vocab_size=VOCAB)
+    data_dir = os.path.join(out_dir, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    matrix = synthetic.make_rows(rows, schema, seed=seed, noise=0.4)
+    synthetic.write_files(matrix, data_dir, num_files=4)
+
+    model_config = {
+        "basic": {"name": "ctr_demo", "author": "shifu_tpu",
+                  "version": "0.1.0"},
+        "dataSet": {"dataDelimiter": "|", "targetColumnName": "target"},
+        "normalize": {"normType": "ZSCALE"},
+        "train": {
+            "baggingSampleRate": 1.0,
+            "validSetRate": 0.2,
+            "numTrainEpochs": epochs,
+            "algorithm": "NN",
+            "params": {
+                # params.ModelType selects the new family through the same
+                # Shifu train surface (config/shifu_compat.py)
+                "ModelType": "deepfm",
+                "NumHiddenLayers": 2,
+                "NumHiddenNodes": [64, 32],
+                "ActivationFunc": ["ReLU", "ReLU"],
+                "EmbeddingDim": 8,
+                "LearningRate": 0.002,
+                "Optimizer": "adam",
+            },
+        },
+    }
+    mc_path = os.path.join(out_dir, "ModelConfig.json")
+    with open(mc_path, "w") as f:
+        json.dump(model_config, f, indent=2)
+
+    column_config = [{
+        "columnNum": 0, "columnName": "target", "columnFlag": "Target",
+        "columnType": "N", "finalSelect": False,
+    }]
+    for i in range(NUM_FEATURES):
+        is_cat = i >= NUM_FEATURES - CAT_FEATURES
+        entry = {
+            "columnNum": 1 + i, "columnName": f"f{i}",
+            "columnFlag": "FinalSelect",
+            "columnType": "C" if is_cat else "N",
+            "finalSelect": True,
+        }
+        if is_cat:
+            entry["columnBinning"] = {
+                "binCategory": [f"v{k}" for k in range(VOCAB - 1)]}
+        column_config.append(entry)
+    cc_path = os.path.join(out_dir, "ColumnConfig.json")
+    with open(cc_path, "w") as f:
+        json.dump(column_config, f, indent=2)
+
+    return {"data": data_dir, "modelconfig": mc_path, "columnconfig": cc_path}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default=os.path.join(_HERE, "generated"))
+    p.add_argument("--rows", type=int, default=6000)
+    p.add_argument("--epochs", type=int, default=12)
+    args = p.parse_args()
+    paths = write_demo(args.out, rows=args.rows, epochs=args.epochs)
+    print(json.dumps(paths, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

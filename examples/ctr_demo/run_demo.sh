@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# CTR demo (BASELINE config #3: DeepFM, sparse embeddings) — see ../_run_demo.sh
+exec "$(dirname "$0")/../_run_demo.sh" "$(dirname "$0")" "$@"

@@ -1,46 +1,3 @@
 #!/usr/bin/env bash
-# The full reference workflow in one command: generate the bundled demo,
-# train the default 3x100 MLP (Shifu configs unchanged), export the scoring
-# artifact, then score the training rows with BOTH the numpy interpreter and
-# the native C++ engine and show they agree.
-set -euo pipefail
-cd "$(dirname "$0")"
-ROOT="$(cd ../.. && pwd)"
-export PYTHONPATH="$ROOT${PYTHONPATH:+:$PYTHONPATH}"
-
-OUT="${1:-generated}"
-python make_demo.py --out "$OUT"
-
-python -m shifu_tpu.launcher.cli train \
-    --modelconfig "$OUT/ModelConfig.json" \
-    --columnconfig "$OUT/ColumnConfig.json" \
-    --data "$OUT/data" \
-    --output "$OUT/job"
-
-# score the first part file; add the native C++ engine when a toolchain exists
-INPUT="$(ls "$OUT"/data/part-* | head -1)"
-python -m shifu_tpu.launcher.cli score \
-    --model "$OUT/job/final_model" --input "$INPUT" \
-    --output "$OUT/scores_python.txt"
-if command -v g++ >/dev/null 2>&1; then
-    python -m shifu_tpu.launcher.cli score \
-        --model "$OUT/job/final_model" --input "$INPUT" \
-        --output "$OUT/scores_native.txt" --native
-else
-    echo "g++ not found: skipping the native-engine scoring comparison"
-fi
-
-python - "$OUT" <<'EOF'
-import os
-import sys
-import numpy as np
-out = sys.argv[1]
-a = np.loadtxt(f"{out}/scores_python.txt")
-print(f"scored {len(a)} rows (python engine)")
-native = f"{out}/scores_native.txt"
-if os.path.exists(native):
-    b = np.loadtxt(native)
-    print(f"python-vs-native max delta: {np.abs(a-b).max():.2e}")
-    assert np.abs(a - b).max() < 1e-5
-print("demo OK")
-EOF
+# WDBC demo (BASELINE config #1: 3x100 MLP) — see ../_run_demo.sh
+exec "$(dirname "$0")/../_run_demo.sh" "$(dirname "$0")" "$@"

@@ -1,29 +1,44 @@
-"""E2E test of the bundled demo — the framework's equivalent of running the
+"""E2E tests of the bundled demos — the framework's equivalent of running the
 reference's full `shifu train` + eval smoke path (reference had no such
 automated test; SURVEY.md section 4 calls for the bundled-demo fixture)."""
 
 import importlib.util
 import os
+import shutil
 import sys
 
 import numpy as np
 import pytest
 
-_DEMO = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     "examples", "wdbc_demo", "make_demo.py")
+_EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples")
 
 
-def _load_make_demo():
-    spec = importlib.util.spec_from_file_location("make_demo", _DEMO)
+def _load_make_demo(demo):
+    spec = importlib.util.spec_from_file_location(
+        f"make_demo_{demo}", os.path.join(_EXAMPLES, demo, "make_demo.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
 
 
-def test_wdbc_demo_end_to_end(tmp_path):
-    make_demo = _load_make_demo()
+# (demo dir, schema kwargs beyond num_features, rows, epochs, seed, noise,
+#  min AUC) — wdbc is BASELINE config #1 (3x100 MLP), ctr is config #3
+# (DeepFM over mixed numeric/categorical)
+DEMOS = [
+    ("wdbc_demo", {}, 1200, 8, 7, 0.3, 0.8),
+    ("ctr_demo", {"num_categorical": "CAT_FEATURES", "vocab_size": "VOCAB"},
+     1500, 6, 11, 0.4, 0.6),
+]
+
+
+@pytest.mark.parametrize("demo,extra,rows,epochs,seed,noise,min_auc", DEMOS,
+                         ids=[d[0] for d in DEMOS])
+def test_demo_end_to_end(tmp_path, demo, extra, rows, epochs, seed, noise,
+                         min_auc):
+    make_demo = _load_make_demo(demo)
     out = str(tmp_path / "demo")
-    paths = make_demo.write_demo(out, rows=1200, epochs=8)
+    paths = make_demo.write_demo(out, rows=rows, epochs=epochs)
 
     from shifu_tpu.launcher import cli
     rc = cli.main([
@@ -43,15 +58,16 @@ def test_wdbc_demo_end_to_end(tmp_path):
     from shifu_tpu.export import load_scorer
     from shifu_tpu.ops import auc
 
-    schema = synthetic.make_schema(num_features=make_demo.NUM_FEATURES)
-    matrix = synthetic.make_rows(1200, schema, seed=7, noise=0.3)
+    schema_kwargs = {k: getattr(make_demo, v) for k, v in extra.items()}
+    schema = synthetic.make_schema(num_features=make_demo.NUM_FEATURES,
+                                   **schema_kwargs)
+    matrix = synthetic.make_rows(rows, schema, seed=seed, noise=noise)
     scorer = load_scorer(export_dir)
     scores = scorer.compute_batch(matrix[:, 1:].astype(np.float32))
     demo_auc = auc(scores[:, 0], matrix[:, 0])
-    assert demo_auc > 0.8, f"demo AUC too low: {demo_auc}"
+    assert demo_auc > min_auc, f"{demo} AUC too low: {demo_auc}"
 
     # native engine agrees (model.bin was packed by the train CLI)
-    import shutil
     if shutil.which("g++"):
         from shifu_tpu.runtime import NativeScorer
         nat = NativeScorer(export_dir)
