@@ -53,6 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="job timeout seconds (0 = none)")
     t.add_argument("--supervise", action="store_true",
                    help="run under the restart supervisor")
+    t.add_argument("--num-processes", type=int, default=0,
+                   help="spawn N coordinated processes on this machine "
+                        "(multi-host simulation / multi-process training); "
+                        "on a real pod run one process per host with the "
+                        "SHIFU_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID env")
     t.add_argument("--max-restarts", type=int, default=-1,
                    help="supervisor restart budget (-1 = from config)")
 
@@ -101,7 +106,7 @@ def _kerberos_from_xml(globalconfig) -> int:
     return EXIT_OK
 
 
-def _assemble_job(args) -> "JobConfig":
+def _assemble_job(args, write_files: bool = True) -> "JobConfig":
     import dataclasses
 
     from ..config import job_config_from_shifu
@@ -116,8 +121,7 @@ def _assemble_job(args) -> "JobConfig":
         merged_xml = xmlconfig.parse_configuration_xml(args.globalconfig)
         job = xmlconfig.apply_to_job(job, merged_xml)
 
-    out_dir = args.output or os.path.join(
-        os.getcwd(), f"shifu_tpu_job_{time.strftime('%Y%m%d_%H%M%S')}")
+    out_dir = _resolve_out_dir(args)
     os.makedirs(out_dir, exist_ok=True)
 
     # overrides, highest precedence (the reference's programmatic layer)
@@ -141,75 +145,191 @@ def _assemble_job(args) -> "JobConfig":
             runtime, final_model_path=os.path.join(out_dir, "final_model"))
     job = job.replace(train=train, data=data, runtime=runtime)
 
-    # persist the raw Shifu inputs beside the derived configs, like the
-    # reference client's per-app upload of ModelConfig/ColumnConfig
-    # (TensorflowClient.java:356-382) — the job dir alone reproduces the run
-    import shutil
-    for src in (args.modelconfig, args.columnconfig):
-        dst = os.path.join(out_dir, os.path.basename(src))
-        # realpath: a symlinked cwd can alias src and dst (SameFileError)
-        if os.path.realpath(src) != os.path.realpath(dst):
-            shutil.copyfile(src, dst)
+    if write_files:  # chief-only under multi-process (shared job dir)
+        # persist the raw Shifu inputs beside the derived configs, like the
+        # reference client's per-app upload of ModelConfig/ColumnConfig
+        # (TensorflowClient.java:356-382) — the job dir alone reproduces the run
+        import shutil
+        for src in (args.modelconfig, args.columnconfig):
+            dst = os.path.join(out_dir, os.path.basename(src))
+            # realpath: a symlinked cwd can alias src and dst (SameFileError)
+            if os.path.realpath(src) != os.path.realpath(dst):
+                shutil.copyfile(src, dst)
 
-    # persist the merged view (global-final.xml parity + typed JSON)
-    xmlconfig.write_configuration_xml(
-        {**merged_xml,
-         "shifu.application.epochs": str(job.train.epochs),
-         "shifu.application.final-model-path": job.runtime.final_model_path,
-         "shifu.application.tmp-model-path": job.runtime.checkpoint.directory},
-        os.path.join(out_dir, "global-final.xml"))
-    with open(os.path.join(out_dir, "job-config.json"), "w") as f:
-        f.write(job.to_json())
+        # persist the merged view (global-final.xml parity + typed JSON)
+        xmlconfig.write_configuration_xml(
+            {**merged_xml,
+             "shifu.application.epochs": str(job.train.epochs),
+             "shifu.application.final-model-path": job.runtime.final_model_path,
+             "shifu.application.tmp-model-path": job.runtime.checkpoint.directory},
+            os.path.join(out_dir, "global-final.xml"))
+        with open(os.path.join(out_dir, "job-config.json"), "w") as f:
+            f.write(job.to_json())
     return job, out_dir
 
 
+def _resolve_out_dir(args) -> str:
+    """The job output dir, resolved once (children/attempts must share it)."""
+    return args.output or os.path.join(
+        os.getcwd(), f"shifu_tpu_job_{time.strftime('%Y%m%d_%H%M%S')}")
+
+
+def _child_train_args(args, out_dir: str,
+                      num_processes: int = 0) -> list[str]:
+    """Rebuild a `train` child argv from parsed args, with --output pinned
+    (shared checkpoints/board) and supervisor/multi-process flags stripped
+    unless re-requested via num_processes."""
+    child = ["train",
+             "--modelconfig", args.modelconfig,
+             "--columnconfig", args.columnconfig,
+             "--output", out_dir]
+    if args.data:
+        child += ["--data", *args.data]
+    if args.globalconfig:
+        child += ["--globalconfig", args.globalconfig]
+    if num_processes > 1:
+        child += ["--num-processes", str(num_processes)]
+    for flag, val in (("--devices", args.devices), ("--epochs", args.epochs),
+                      ("--batch-size", args.batch_size),
+                      ("--timeout", args.timeout),
+                      ("--cache-dir", getattr(args, "cache_dir", None))):
+        if val:
+            child += [flag, str(val)]
+    return child
+
+
+def _spawn_processes(args, out_dir: str) -> int:
+    """Local multi-process mode: spawn N coordinated `train` children wired
+    through the SHIFU_TPU_* rendezvous contract (parallel/distributed.py) —
+    the single-machine analog of one-process-per-host on a pod, and the
+    successor of the AM's container orchestration (TensorflowSession.java:
+    202-318).  Child 0 is the chief (output streams through); the other
+    processes log to <out_dir>/process-<i>.log.  If any child dies the rest
+    are torn down — a half-gang would block in collectives forever."""
+    import subprocess
+    import socket
+
+    if args.devices:
+        # a device *prefix* of the global list would strand non-chief
+        # processes outside the mesh; device counts are per-process here
+        print("--devices cannot combine with --num-processes "
+              "(set SHIFU_TPU_CPU_DEVICES per process instead)",
+              file=sys.stderr, flush=True)
+        return EXIT_FAIL
+
+    with socket.socket() as sck:
+        sck.bind(("127.0.0.1", 0))
+        port = sck.getsockname()[1]
+
+    os.makedirs(out_dir, exist_ok=True)
+    child_args = _child_train_args(args, out_dir)
+    n = args.num_processes
+    procs, logs = [], []
+    for pid in range(n):
+        env = dict(os.environ)
+        env.update({"SHIFU_TPU_COORDINATOR": f"127.0.0.1:{port}",
+                    "SHIFU_TPU_NUM_PROCESSES": str(n),
+                    "SHIFU_TPU_PROCESS_ID": str(pid)})
+        log = (None if pid == 0 else
+               open(os.path.join(out_dir, f"process-{pid}.log"), "w"))
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "shifu_tpu.launcher.cli", *child_args],
+            env=env, stdout=log, stderr=subprocess.STDOUT if log else None))
+
+    status = EXIT_OK
+    try:
+        remaining = set(range(n))
+        while remaining:
+            for pid in sorted(remaining):
+                rc = procs[pid].poll()
+                if rc is None:
+                    continue
+                remaining.discard(pid)
+                if rc != 0:
+                    print(f"process {pid} exited rc={rc}"
+                          + (f" (see {out_dir}/process-{pid}.log)"
+                             if pid else ""),
+                          file=sys.stderr, flush=True)
+                    status = status or rc
+                    # tear the rest down: they would block in collectives
+                    for other in sorted(remaining):
+                        procs[other].terminate()
+            if remaining:
+                time.sleep(0.5)
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for log in logs:
+            if log:
+                log.close()
+    return status
+
+
 def run_train(args) -> int:
-    job, out_dir = _assemble_job(args)
+    # Order matters: the supervisor parent must NOT join the distributed
+    # rendezvous (its child re-registers the same process id), and a
+    # supervised multi-process job restarts as a whole gang — supervisor
+    # wraps the spawner, spawner wraps the worker processes.
+    if args.supervise:
+        from .supervisor import supervise
+        out_dir = _resolve_out_dir(args)
+        os.makedirs(out_dir, exist_ok=True)
+        max_restarts = (args.max_restarts if args.max_restarts >= 0
+                        else _assemble_job(args, write_files=False)[0]
+                        .runtime.max_restarts)
+        child_args = _child_train_args(
+            args, out_dir, num_processes=getattr(args, "num_processes", 0))
+        return supervise(child_args, max_restarts=max_restarts,
+                         board_path=os.path.join(out_dir, "console.board"))
+
+    if getattr(args, "num_processes", 0) > 1:
+        return _spawn_processes(args, _resolve_out_dir(args))
+
+    # multi-host rendezvous (no-op without the env contract / pod runtime);
+    # must run before any jax device use so every process joins the global
+    # mesh — the successor of the ZooKeeper ip:port registration dance
+    # (TensorflowSession.java:551-594)
+    from ..parallel import distributed
+    distributed.initialize()
+    chief = distributed.is_chief()
+
+    job, out_dir = _assemble_job(args, write_files=chief)
 
     # secured HDFS: acquire the Kerberos ticket before any data access
     # (successor of the reference client's delegation-token fetch,
     # TensorflowClient.java:481-502); no-op unless a principal is configured
     from .security import KerberosError, ensure_kerberos_ticket
     try:
-        # supervisor restarts re-enter run_train in a fresh child process
-        # (child_args below) and re-kinit; healthy long runs renew
-        # periodically from the epoch callback below
+        # supervisor restarts re-enter run_train in fresh child processes,
+        # re-running kinit; healthy long runs renew periodically from the
+        # epoch callback below
         ensure_kerberos_ticket(job.runtime.kerberos_principal,
                                job.runtime.kerberos_keytab)
     except KerberosError as e:
         print(f"kerberos auth failed: {e}", file=sys.stderr, flush=True)
         return EXIT_FAIL
 
-    if args.supervise:
-        from .supervisor import supervise
-        max_restarts = (args.max_restarts if args.max_restarts >= 0
-                        else job.runtime.max_restarts)
-        # rebuild the child command from parsed args (supervisor flags stripped);
-        # pin --output so every attempt shares the checkpoint dir and resumes
-        child_args = ["train",
-                      "--modelconfig", args.modelconfig,
-                      "--columnconfig", args.columnconfig,
-                      "--output", out_dir]
-        if args.data:
-            child_args += ["--data", *args.data]
-        if args.globalconfig:
-            child_args += ["--globalconfig", args.globalconfig]
-        for flag, val in (("--devices", args.devices), ("--epochs", args.epochs),
-                          ("--batch-size", args.batch_size), ("--timeout", args.timeout),
-                          ("--cache-dir", getattr(args, "cache_dir", None))):
-            if val:
-                child_args += [flag, str(val)]
-        return supervise(child_args, max_restarts=max_restarts,
-                         board_path=os.path.join(out_dir, "console.board"))
-
     import jax
+
+    if jax.process_count() > 1 and args.devices:
+        print("--devices is not supported under multi-host (device counts "
+              "are per-process)", file=sys.stderr, flush=True)
+        return EXIT_FAIL
 
     from ..export import save_artifact
     from ..parallel import data_parallel_mesh
     from ..train import make_forward_fn, train
     from .console import ConsoleBoard
 
-    board = ConsoleBoard(os.path.join(out_dir, "console.board"))
+    if chief:
+        board = ConsoleBoard(os.path.join(out_dir, "console.board"))
+    else:  # non-chief processes train silently (reference: only the AM's
+        class board:  # aggregated view reached the console board)
+            def __call__(self, _s): pass
+            def close(self): pass
+        board = board()
     n_devices = len(jax.devices())
     if args.devices:
         n_devices = min(n_devices, args.devices)
@@ -277,19 +397,33 @@ def run_train(args) -> int:
         board.close()
         return EXIT_FAIL
 
-    forward = make_forward_fn(job)  # meshless rebuild: single-host export graph
-    export_dir = save_artifact(result.state.params, job,
-                               job.runtime.final_model_path, forward_fn=forward)
-    try:
-        from ..runtime import pack_native
-        pack_native(export_dir)
-    except Exception as e:  # native pack is best-effort at train time
-        board(f"native pack skipped: {e}")
-    board(f"model exported to {export_dir}")
-    _write_metrics_jsonl(result, os.path.join(out_dir, "metrics.jsonl"))
-    if result.history:
-        last = result.history[-1]
-        board(f"final: valid_error={last.valid_error:.6f} valid_auc={last.valid_auc:.4f}")
+    params = result.state.params
+    if jax.process_count() > 1 and mesh is not None:
+        # collective: EVERY process participates in replicating (all-gather)
+        # any model-sharded params so the chief holds full values to export
+        from jax.sharding import NamedSharding, PartitionSpec
+        replicate = jax.jit(
+            lambda t: t, out_shardings=NamedSharding(mesh, PartitionSpec()))
+        params = jax.device_get(replicate(params))
+    if chief:
+        forward = make_forward_fn(job)  # meshless rebuild: single-host export
+        export_dir = save_artifact(params, job,
+                                   job.runtime.final_model_path,
+                                   forward_fn=forward)
+        try:
+            from ..runtime import pack_native
+            pack_native(export_dir)
+        except Exception as e:  # native pack is best-effort at train time
+            board(f"native pack skipped: {e}")
+        board(f"model exported to {export_dir}")
+        _write_metrics_jsonl(result, os.path.join(out_dir, "metrics.jsonl"))
+        if result.history:
+            last = result.history[-1]
+            board(f"final: valid_error={last.valid_error:.6f} "
+                  f"valid_auc={last.valid_auc:.4f}")
+    if jax.process_count() > 1:
+        from ..parallel import distributed as dist
+        dist.barrier("export_done")
     board.close()
     return EXIT_OK
 

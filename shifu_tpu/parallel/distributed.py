@@ -52,6 +52,15 @@ def initialize(coordinator: Optional[str] = None,
         process_id = int(os.environ[ENV_PROCESS_ID])
 
     if coordinator:
+        # CPU backends need an explicit cross-process collectives transport
+        # (gloo) — the stand-in for ICI/DCN when simulating hosts locally;
+        # must be set before backend init or collectives silently hang
+        try:
+            if jax.config.jax_platforms in ("cpu", None) or \
+                    "cpu" in str(jax.config.jax_platforms or ""):
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # older jax or already-initialized backend
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,

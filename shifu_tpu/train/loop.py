@@ -96,23 +96,66 @@ def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
              batch_size: Optional[int] = None) -> tuple[float, float]:
     """(weighted_error, auc) over the full dataset — every row counted, the
     tail padded with zero-weight rows (reference evaluates the full valid set
-    per epoch, ssgd_monitor.py:281-284)."""
-    if ds.num_rows == 0:
+    per epoch, ssgd_monitor.py:281-284).
+
+    Multi-host: `ds` is this host's shard; every process contributes its
+    rows to global eval batches, runs the same number of collective steps
+    (shorter hosts feed zero-weight padding), and the gathered scores give
+    identical global metrics on every host."""
+    multihost = jax.process_count() > 1 and mesh is not None
+    if not multihost and ds.num_rows == 0:
         return float("nan"), float("nan")
     bs = batch_size or max(job.data.batch_size, 4096)
     if mesh is not None:
         # keep the per-device shard static
         bs = -(-bs // mesh.size) * mesh.size
+    if not multihost:
+        scores_parts, targets_parts, weights_parts = [], [], []
+        for batch in pipe.batch_iterator(ds, bs, shuffle=False,
+                                         drop_remainder=False):
+            padded, mask = pipe.pad_to_batch(batch, bs)
+            if mesh is not None:
+                padded = shard_lib.shard_batch(padded, mesh)
+            s = np.asarray(jax.device_get(eval_step(state, padded)))
+            n = int(mask.sum())
+            scores_parts.append(s[:n])
+            targets_parts.append(batch["target"])
+            weights_parts.append(batch["weight"])
+        scores = np.concatenate(scores_parts)
+        targets = np.concatenate(targets_parts)
+        weights = np.concatenate(weights_parts)
+        err = metrics_lib.weighted_error(scores[:, 0], targets[:, 0],
+                                         weights[:, 0])
+        auc = metrics_lib.auc(scores[:, 0], targets[:, 0], weights[:, 0])
+        return err, auc
+
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    nproc = jax.process_count()
+    local_bs = max(bs // nproc, 1)
+    n_steps = int(np.max(multihost_utils.process_allgather(
+        np.asarray(-(-ds.num_rows // local_bs) if ds.num_rows else 0))))
+    if n_steps == 0:
+        return float("nan"), float("nan")
+    replicated = NamedSharding(mesh, PartitionSpec())
+    # one collective fetch per eval step: scores + labels + weights ride the
+    # same all-gather so the row pairing is identical on every host
+    gather3 = jax.jit(lambda a, b, c: (a, b, c),
+                      out_shardings=(replicated, replicated, replicated))
     scores_parts, targets_parts, weights_parts = [], [], []
-    for batch in pipe.batch_iterator(ds, bs, shuffle=False, drop_remainder=False):
-        padded, mask = pipe.pad_to_batch(batch, bs)
-        if mesh is not None:
-            padded = shard_lib.shard_batch(padded, mesh)
-        s = np.asarray(jax.device_get(eval_step(state, padded)))
-        n = int(mask.sum())
-        scores_parts.append(s[:n])
-        targets_parts.append(batch["target"])
-        weights_parts.append(batch["weight"])
+    for i in range(n_steps):
+        lo = min(i * local_bs, ds.num_rows)
+        hi = min(lo + local_bs, ds.num_rows)
+        local = {"features": ds.features[lo:hi], "target": ds.target[lo:hi],
+                 "weight": ds.weight[lo:hi]}
+        local, _ = pipe.pad_to_batch(local, local_bs)  # zero-weight tail
+        gbatch = shard_lib.shard_batch_process_local(local, mesh)
+        s, t, w = gather3(eval_step(state, gbatch), gbatch["target"],
+                          gbatch["weight"])
+        scores_parts.append(np.asarray(s.addressable_data(0)))
+        targets_parts.append(np.asarray(t.addressable_data(0)))
+        weights_parts.append(np.asarray(w.addressable_data(0)))
     scores = np.concatenate(scores_parts)
     targets = np.concatenate(targets_parts)
     weights = np.concatenate(weights_parts)
@@ -160,28 +203,59 @@ def train(job: JobConfig,
                 start_epoch = int((extra or {}).get("epoch", 0))
                 console(f"Resumed from checkpoint step {step} (epoch {start_epoch})")
 
-    if train_ds.num_rows == 0:
-        raise ValueError("training dataset has 0 rows — nothing to train on")
+    # multi-host: every process holds a disjoint file shard, so batches are
+    # assembled process-locally into global arrays and the step count is
+    # agreed across hosts (collective input path; single-host tiers assume
+    # the whole dataset is visible locally).  ALL sizing decisions below
+    # derive from globally agreed numbers — a host deciding from its local
+    # row count alone would diverge on shapes and deadlock the collectives.
+    multihost = jax.process_count() > 1 and mesh is not None
+    nproc = jax.process_count() if multihost else 1
+    if multihost:
+        from jax.experimental import multihost_utils
+        min_host_rows = int(np.min(multihost_utils.process_allgather(
+            np.asarray(train_ds.num_rows))))
+    else:
+        min_host_rows = train_ds.num_rows
+    if min_host_rows == 0:
+        raise ValueError("a training data shard has 0 rows — nothing to "
+                         "train on" if multihost else
+                         "training dataset has 0 rows — nothing to train on")
 
     bs = job.data.batch_size
     mesh_size = mesh.size if mesh is not None else 1
-    if bs > train_ds.num_rows and job.data.drop_remainder:
+    global_capacity = min_host_rows * nproc  # rows every host can cover
+    if bs > global_capacity and job.data.drop_remainder:
         # A dataset smaller than the batch would silently train zero steps;
-        # clamp down (keeping per-device divisibility) and say so.
-        bs = max((train_ds.num_rows // mesh_size) * mesh_size, mesh_size)
-        console(f"batch_size {job.data.batch_size} > {train_ds.num_rows} rows; "
-                f"clamped to {bs}")
+        # clamp down (keeping per-device divisibility) and say so.  The
+        # agreed min_host_rows keeps every host choosing the same bs.
+        bs = max((global_capacity // mesh_size) * mesh_size, mesh_size)
+        console(f"batch_size {job.data.batch_size} > {global_capacity} "
+                f"usable rows; clamped to {bs}")
     if mesh is not None:
         bs = -(-bs // mesh.size) * mesh.size  # divisible per-device shards
+
+    local_bs = bs
+    steps_per_epoch = None
+    if multihost:
+        # mesh.size = nproc * local_devices, and bs is a mesh.size multiple,
+        # so bs always divides evenly across processes
+        local_bs = bs // nproc
+        steps_per_epoch = min_host_rows // max(local_bs, 1)
+        if steps_per_epoch == 0:
+            raise ValueError(
+                f"a host has < {local_bs} rows (global batch {bs} / {nproc} "
+                "processes) — lower the batch size or rebalance file shards")
 
     # input-path tier selection: device-resident (dataset fits HBM budget)
     # > staged blocks > per-batch host feed
     ds_bytes = (train_ds.features.nbytes + train_ds.target.nbytes
                 + train_ds.weight.nbytes)
-    use_resident = (job.data.staged and job.data.drop_remainder
+    use_resident = (not multihost and job.data.staged and job.data.drop_remainder
                     and 0 < ds_bytes <= job.data.device_resident_bytes
                     and train_ds.num_rows // bs > 0)
-    use_staged = job.data.staged and job.data.drop_remainder and not use_resident
+    use_staged = (not multihost and job.data.staged and job.data.drop_remainder
+                  and not use_resident)
     resident_blocks = None
     if use_resident:
         from .step import make_device_epoch_step
@@ -253,12 +327,21 @@ def train(job: JobConfig,
                     loss_n += nb
                     timer.mark_step_done()
             else:
+                import itertools
                 host_batches = pipe.batch_iterator(
-                    train_ds, bs, shuffle=job.data.shuffle,
+                    train_ds, local_bs, shuffle=job.data.shuffle,
                     seed=job.data.shuffle_seed, epoch=epoch,
-                    drop_remainder=job.data.drop_remainder)
+                    drop_remainder=job.data.drop_remainder or multihost)
+                put_fn = None
+                if multihost:
+                    # every host must run the SAME number of collective steps
+                    host_batches = itertools.islice(host_batches,
+                                                    steps_per_epoch)
+                    put_fn = (lambda b:
+                              shard_lib.shard_batch_process_local(b, mesh))
                 for batch in pipe.prefetch_to_device(host_batches, mesh,
-                                                     size=job.data.prefetch):
+                                                     size=job.data.prefetch,
+                                                     put_fn=put_fn):
                     timer.mark_input_ready()
                     state, step_metrics = train_step(state, batch)
                     loss = step_metrics["loss"]

@@ -81,3 +81,55 @@ def test_two_process_train_step_agrees():
     assert results[0]["loss"] == pytest.approx(results[1]["loss"], rel=1e-6)
     # chief election: exactly process 0
     assert results[0]["chief"] is True and results[1]["chief"] is False
+
+
+@pytest.mark.slow
+def test_cli_num_processes_end_to_end(tmp_path):
+    """The launcher's own multi-process mode: `train --num-processes 2`
+    spawns coordinated processes (SHIFU_TPU_* contract), each loads its own
+    file shard, batches assemble process-locally into global arrays
+    (parallel/sharding.shard_batch_process_local), metrics/export come from
+    the chief only — the operator-facing path over per-host *disjoint* data
+    that the worker-fixture test (identical batches) does not cover."""
+    import json as json_lib
+
+    from shifu_tpu.data import synthetic
+
+    mc = {"dataSet": {"targetColumnName": "target"},
+          "train": {"validSetRate": 0.2, "numTrainEpochs": 2,
+                    "algorithm": "NN",
+                    "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                               "ActivationFunc": ["relu"],
+                               "LearningRate": 0.01, "Optimizer": "adam"}}}
+    cols = [{"columnNum": 0, "columnName": "target", "columnFlag": "Target"}]
+    cols += [{"columnNum": i, "columnName": f"f{i}", "columnType": "N",
+              "finalSelect": True} for i in range(1, 9)]
+    (tmp_path / "ModelConfig.json").write_text(json_lib.dumps(mc))
+    (tmp_path / "ColumnConfig.json").write_text(json_lib.dumps(cols))
+    schema = synthetic.make_schema(num_features=8)
+    rows = synthetic.make_rows(1600, schema, seed=5, noise=0.3)
+    synthetic.write_files(rows, str(tmp_path / "data"), num_files=4)
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env.update({"SHIFU_TPU_PLATFORM": "cpu", "SHIFU_TPU_CPU_DEVICES": "2",
+                "PYTHONPATH": os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))})
+    out = tmp_path / "job"
+    r = subprocess.run(
+        [sys.executable, "-m", "shifu_tpu.launcher.cli", "train",
+         "--modelconfig", str(tmp_path / "ModelConfig.json"),
+         "--columnconfig", str(tmp_path / "ColumnConfig.json"),
+         "--data", str(tmp_path / "data"),
+         "--output", str(out), "--num-processes", "2"],
+        env=env, capture_output=True, text=True, timeout=600)
+    if r.returncode != 0 and "gloo" in r.stderr and "collectives" in r.stderr:
+        pytest.skip("no gloo cpu collectives in this jax build")
+    assert r.returncode == 0, r.stdout + r.stderr
+    # chief-only console: each epoch line appears exactly once
+    assert r.stdout.count("Epoch 0:") == 1, r.stdout
+    assert r.stdout.count("Epoch 1:") == 1, r.stdout
+    board = (out / "console.board").read_text()
+    assert board.count("Epoch 1:") == 1
+    for f in ("GenericModelConfig.json", "weights.npz", "model.bin"):
+        assert (out / "final_model" / f).exists(), f
