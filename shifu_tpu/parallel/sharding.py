@@ -76,6 +76,17 @@ def shard_blocks(blocks: Mapping[str, np.ndarray], mesh: Mesh) -> dict[str, jax.
             for k, v in blocks.items()}
 
 
+def shard_blocks_process_local(blocks: Mapping[str, np.ndarray],
+                               mesh: Mesh) -> dict[str, jax.Array]:
+    """Multi-host device-resident blocks: each process passes its shard's
+    (nb, local_B, ...) stack; the result is global (nb, B, ...) arrays
+    sharded on the batch (second) axis — the whole cluster's training
+    partition lives in HBM and each epoch is one collective scan."""
+    return {k: jax.make_array_from_process_local_data(
+                block_sharding(mesh, v.ndim), v)
+            for k, v in blocks.items()}
+
+
 # -- parameter sharding rules ------------------------------------------------
 
 # rules: list of (path regex, PartitionSpec); first match wins, default replicated.

@@ -248,26 +248,40 @@ def train(job: JobConfig,
                 "processes) — lower the batch size or rebalance file shards")
 
     # input-path tier selection: device-resident (dataset fits HBM budget)
-    # > staged blocks > per-batch host feed
-    ds_bytes = (train_ds.features.nbytes + train_ds.target.nbytes
-                + train_ds.weight.nbytes)
-    use_resident = (not multihost and job.data.staged and job.data.drop_remainder
+    # > staged blocks > per-batch host feed.  Multi-host uses the resident
+    # tier too — each host stacks its shard into (nb, local_B, ...) blocks
+    # that assemble into global arrays, with nb agreed across hosts — so
+    # distributed epochs are one collective scan, not per-batch dispatches.
+    rows_for_blocks = min_host_rows if multihost else train_ds.num_rows
+    # agreed across hosts: per-row bytes are schema-determined (identical
+    # everywhere), and the tier only stages the usable rows_for_blocks
+    # prefix — a host deciding from its raw local shard size could pick a
+    # different tier and deadlock the collectives
+    per_row_bytes = ((train_ds.features.nbytes + train_ds.target.nbytes
+                      + train_ds.weight.nbytes)
+                     // max(train_ds.num_rows, 1))
+    ds_bytes = per_row_bytes * rows_for_blocks
+    use_resident = (job.data.staged and job.data.drop_remainder
                     and 0 < ds_bytes <= job.data.device_resident_bytes
-                    and train_ds.num_rows // bs > 0)
+                    and rows_for_blocks // local_bs > 0)
     use_staged = (not multihost and job.data.staged and job.data.drop_remainder
                   and not use_resident)
     resident_blocks = None
     if use_resident:
         from .step import make_device_epoch_step
         device_epoch_step = make_device_epoch_step(job, mesh)
-        nb_total = train_ds.num_rows // bs
+        nb_total = rows_for_blocks // local_bs
 
         def stack(arr):
-            return arr[:nb_total * bs].reshape(nb_total, bs, *arr.shape[1:])
+            return arr[:nb_total * local_bs].reshape(
+                nb_total, local_bs, *arr.shape[1:])
         host_blocks = {"features": stack(train_ds.features),
                        "target": stack(train_ds.target),
                        "weight": stack(train_ds.weight)}
-        if mesh is not None:
+        if multihost:
+            resident_blocks = shard_lib.shard_blocks_process_local(
+                host_blocks, mesh)
+        elif mesh is not None:
             resident_blocks = shard_lib.shard_blocks(host_blocks, mesh)
         else:
             resident_blocks = {k: jax.device_put(v)
