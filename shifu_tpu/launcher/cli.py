@@ -537,11 +537,9 @@ def run_eval(args) -> int:
     TensorflowModel.compute, TensorflowModel.java:52-109) — with the batch
     scoring and in-process metrics the reference's row-at-a-time JNI path
     could not offer."""
-    import numpy as np
 
     from ..config.shifu_compat import load_json, parse_column_config
     from ..data import reader
-    from ..ops.metrics import auc, weighted_error
 
     rc = _kerberos_from_xml(args.globalconfig)
     if rc != EXIT_OK:
@@ -565,25 +563,49 @@ def run_eval(args) -> int:
         print("eval: no data files found", file=sys.stderr)
         return EXIT_FAIL
     scorer = _load_scorer(args.model, args.native)
-    # project per file (empty part files contribute nothing; memory is bounded
-    # by the useful columns, not the full raw width of the whole eval set)
-    feats_l, target_l, weight_l = [], [], []
-    for p in sorted(paths):
-        raw = reader.read_file(p)
-        if raw.shape[0] == 0:
-            continue
-        cols = reader.project_columns(raw, schema)
-        feats_l.append(_project_features(raw, args.model, scorer))
-        target_l.append(cols["target"])
-        weight_l.append(cols["weight"])
-    if not feats_l:
+    # Stream file by file: metrics accumulate out-of-core (exact weighted
+    # error; binned weighted AUC over the [0,1] sigmoid range, error <1e-6)
+    # so eval-set size is bounded by disk, not RAM — the reference's eval
+    # was row-at-a-time through JNI with aggregation left to the Shifu host.
+    from ..ops.metrics import StreamingMetrics
+
+    accs: list = []
+    n_heads = 0
+    score_sum = 0.0
+    pos_count = 0
+    scores_out = None  # created lazily so failure paths leave no stray file
+    try:
+        for p in sorted(paths):
+            raw = reader.read_file(p)
+            if raw.shape[0] == 0:
+                continue
+            if args.scores_output and scores_out is None:
+                scores_out = open(args.scores_output, "w")
+            cols = reader.project_columns(raw, schema)
+            scores = scorer.compute_batch(
+                _project_features(raw, args.model, scorer))
+            labels_m, weights = cols["target"], cols["weight"][:, 0]
+            if not accs:
+                if scores.shape[1] != labels_m.shape[1]:
+                    print(f"eval: artifact has {scores.shape[1]} heads but "
+                          f"{labels_m.shape[1]} target columns resolved from "
+                          "the configs — reporting the overlap only",
+                          file=sys.stderr)
+                n_heads = min(scores.shape[1], labels_m.shape[1])
+                accs = [StreamingMetrics() for _ in range(n_heads)]
+            for h in range(n_heads):
+                accs[h].update(scores[:, h], labels_m[:, h], weights)
+            score_sum += float(scores[:, 0].sum())
+            pos_count += int((labels_m[:, 0] > 0.5).sum())
+            if scores_out is not None:
+                for row in scores:
+                    scores_out.write("|".join(f"{v:.6f}" for v in row) + "\n")
+    finally:
+        if scores_out is not None:
+            scores_out.close()
+    if not accs:
         print("eval: no data rows found", file=sys.stderr)
         return EXIT_FAIL
-    scores = scorer.compute_batch(np.concatenate(feats_l, axis=0))
-
-    labels_m = np.concatenate(target_l, axis=0)
-    labels = labels_m[:, 0]
-    weights = np.concatenate(weight_l, axis=0)[:, 0]
 
     def _round_finite(v: float, nd: int = 6):
         # NaN (e.g. single-class AUC) is not valid JSON; emit null instead
@@ -597,30 +619,21 @@ def run_eval(args) -> int:
     name_by_index = {c.index: c.name for c in schema.columns}
     resolved_names = [name_by_index.get(i, f"head_{h}")
                       for h, i in enumerate(schema.all_target_indices)]
-    if scores.shape[1] != labels_m.shape[1]:
-        print(f"eval: artifact has {scores.shape[1]} heads but "
-              f"{labels_m.shape[1]} target columns resolved from the configs "
-              "— reporting the overlap only", file=sys.stderr)
-    n_heads = min(scores.shape[1], labels_m.shape[1])
+    rows = accs[0].rows
     heads = [
         {"name": resolved_names[h] if h < len(resolved_names) else f"head_{h}",
-         "auc": _round_finite(auc(scores[:, h], labels_m[:, h], weights)),
-         "weighted_error": _round_finite(
-             weighted_error(scores[:, h], labels_m[:, h], weights))}
+         "auc": _round_finite(accs[h].auc()),
+         "weighted_error": _round_finite(accs[h].weighted_error())}
         for h in range(n_heads)]
     summary = {
-        "rows": int(labels.shape[0]),
+        "rows": int(rows),
         "auc": heads[0]["auc"],
         "weighted_error": heads[0]["weighted_error"],
-        "mean_score": _round_finite(scores[:, 0].mean()),
-        "positive_rate": _round_finite((labels > 0.5).mean()),
+        "mean_score": _round_finite(score_sum / max(rows, 1)),
+        "positive_rate": _round_finite(pos_count / max(rows, 1)),
     }
     if n_heads > 1:
         summary["heads"] = heads
-    if args.scores_output:
-        with open(args.scores_output, "w") as f:
-            for s in scores:
-                f.write("|".join(f"{v:.6f}" for v in s) + "\n")
     print(json.dumps(summary))
     return EXIT_OK
 
