@@ -502,10 +502,14 @@ def run_score(args) -> int:
         return rc
     rows = reader.read_file(args.input)
     scorer = _load_scorer(args.model, args.native)
-    scores = scorer.compute_batch(_project_features(rows, args.model, scorer))
+    feats = _project_features(rows, args.model, scorer)
     out = sys.stdout if args.output == "-" else open(args.output, "w")
-    for s in scores:
-        out.write("|".join(f"{v:.6f}" for v in s) + "\n")
+    # chunked scoring + incremental writes: peak memory stays bounded by the
+    # chunk, not the input (the reference scored one row per JNI call)
+    chunk = 65536
+    for lo in range(0, feats.shape[0], chunk):
+        for s in scorer.compute_batch(feats[lo:lo + chunk]):
+            out.write("|".join(f"{v:.6f}" for v in s) + "\n")
     if out is not sys.stdout:
         out.close()
     return EXIT_OK
