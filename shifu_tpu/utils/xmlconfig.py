@@ -41,6 +41,7 @@ KEY_KERBEROS_PRINCIPAL = "shifu.security.kerberos.principal"
 KEY_KERBEROS_KEYTAB = "shifu.security.kerberos.keytab"
 KEY_DATA_CACHE_DIR = "shifu.data.cache-dir"
 KEY_DATA_OUT_OF_CORE = "shifu.data.out-of-core"
+KEY_DATA_STAGED = "shifu.data.staged"
 KEY_DATA_READ_THREADS = "shifu.data.read-threads"
 
 
@@ -121,6 +122,11 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         import dataclasses
         data = dataclasses.replace(
             data, out_of_core=conf[KEY_DATA_OUT_OF_CORE].strip().lower()
+            in ("true", "1", "yes"))
+    if KEY_DATA_STAGED in conf:
+        import dataclasses
+        data = dataclasses.replace(
+            data, staged=conf[KEY_DATA_STAGED].strip().lower()
             in ("true", "1", "yes"))
     if KEY_DATA_READ_THREADS in conf:
         import dataclasses

@@ -84,7 +84,9 @@ def test_two_process_train_step_agrees():
 
 
 @pytest.mark.slow
-def test_cli_num_processes_end_to_end(tmp_path):
+@pytest.mark.parametrize("staged", [True, False],
+                         ids=["resident-tier", "per-batch-tier"])
+def test_cli_num_processes_end_to_end(tmp_path, staged):
     """The launcher's own multi-process mode: `train --num-processes 2`
     spawns coordinated processes (SHIFU_TPU_* contract), each loads its own
     file shard, batches assemble process-locally into global arrays
@@ -115,12 +117,19 @@ def test_cli_num_processes_end_to_end(tmp_path):
     env.update({"SHIFU_TPU_PLATFORM": "cpu", "SHIFU_TPU_CPU_DEVICES": "2",
                 "PYTHONPATH": os.path.dirname(os.path.dirname(
                     os.path.abspath(__file__)))})
+    from shifu_tpu.utils import xmlconfig
+    gconf = tmp_path / "global.xml"
+    # staged=False forces the per-batch process-local input path; True uses
+    # the device-resident collective-scan tier — both must work multi-host
+    xmlconfig.write_configuration_xml(
+        {xmlconfig.KEY_DATA_STAGED: str(staged).lower()}, str(gconf))
     out = tmp_path / "job"
     r = subprocess.run(
         [sys.executable, "-m", "shifu_tpu.launcher.cli", "train",
          "--modelconfig", str(tmp_path / "ModelConfig.json"),
          "--columnconfig", str(tmp_path / "ColumnConfig.json"),
          "--data", str(tmp_path / "data"),
+         "--globalconfig", str(gconf),
          "--output", str(out), "--num-processes", "2"],
         env=env, capture_output=True, text=True, timeout=600)
     if r.returncode != 0 and "gloo" in r.stderr and "collectives" in r.stderr:
