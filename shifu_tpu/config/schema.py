@@ -175,6 +175,14 @@ class ModelSpec:
     # effect when the training mesh has a `seq` axis of size > 1; flash is a
     # per-device kernel choice; scoring/export always runs local.
     attention_impl: str = "local"
+    # pipeline parallelism (ft_transformer): split the transformer blocks
+    # into this many stages over the mesh's `pipe` axis, GPipe-style
+    # microbatch schedule (parallel/pipeline.py).  1 = off.  Training-time
+    # knob only: export always canonicalizes to the single-device graph.
+    pipeline_stages: int = 1
+    # microbatches per global batch when pipelined; 0 = pipeline_stages
+    # (the minimum that keeps every stage busy at steady state)
+    pipeline_microbatches: int = 0
     # numerics
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
@@ -194,6 +202,23 @@ class ModelSpec:
             raise ConfigError(
                 f"unknown attention_impl {self.attention_impl!r}; "
                 "expected local|ring|ulysses|flash")
+        if self.pipeline_stages < 1 or self.pipeline_microbatches < 0:
+            raise ConfigError("pipeline_stages must be >= 1 and "
+                              "pipeline_microbatches >= 0")
+        if self.pipeline_stages > 1:
+            if self.model_type != "ft_transformer":
+                raise ConfigError("pipeline_stages > 1 requires "
+                                  "model_type='ft_transformer'")
+            if self.num_layers % self.pipeline_stages != 0:
+                raise ConfigError(
+                    f"num_layers ({self.num_layers}) must be divisible by "
+                    f"pipeline_stages ({self.pipeline_stages})")
+            if self.attention_impl in ("ring", "ulysses"):
+                raise ConfigError(
+                    "pipeline_stages > 1 composes with local/flash attention "
+                    "only (sequence parallelism uses its own mesh axis)")
+            if self.dropout_rate > 0:
+                raise ConfigError("pipeline_stages > 1 requires dropout_rate=0")
 
 
 # ---------------------------------------------------------------------------
@@ -262,16 +287,26 @@ class MeshConfig:
     data: int = 1
     model: int = 1
     seq: int = 1
-    axis_order: tuple[str, ...] = ("data", "seq", "model")
+    # pipeline-parallel axis: transformer stages hold disjoint layer blocks,
+    # activations hop stage->stage over ICI (parallel/pipeline.py)
+    pipe: int = 1
+    axis_order: tuple[str, ...] = ("data", "seq", "pipe", "model")
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.model * self.seq
+        return self.data * self.model * self.seq * self.pipe
 
     def validate(self) -> None:
-        for name in ("data", "model", "seq"):
+        for name in ("data", "model", "seq", "pipe"):
             if getattr(self, name) < 1:
                 raise ConfigError(f"mesh axis {name} must be >= 1")
+        known = {"data", "seq", "pipe", "model"}
+        if not set(self.axis_order) <= known or len(set(self.axis_order)) != len(self.axis_order):
+            raise ConfigError(f"axis_order must be distinct axes from {sorted(known)}: "
+                              f"{self.axis_order}")
+        for name in known - set(self.axis_order):
+            if getattr(self, name) != 1:
+                raise ConfigError(f"mesh axis {name} > 1 but missing from axis_order")
 
 
 @dataclass(frozen=True)

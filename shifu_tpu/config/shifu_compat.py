@@ -221,6 +221,8 @@ def parse_model_config(model_config: dict[str, Any]) -> tuple[ModelSpec, TrainCo
         token_dim=int(params.get("TokenDim", 64)),
         dropout_rate=float(params.get("DropoutRate", 0.0)),
         attention_impl=str(params.get("AttentionImpl", "local")).lower(),
+        pipeline_stages=int(params.get("PipelineStages", 1)),
+        pipeline_microbatches=int(params.get("PipelineMicrobatches", 0)),
     )
 
     lr = float(params.get("LearningRate", 0.003))  # reference fallback 0.003 (ssgd_monitor.py:136)

@@ -112,6 +112,18 @@ def save_artifact(params: Any, job: JobConfig, export_dir: str,
     the reference (ssgd_monitor.py:476-490) so an unmodified Shifu eval step
     routes the model to its generic scorer the same way.
     """
+    import dataclasses as _dc
+    if (job.model.model_type == "ft_transformer"
+            and job.model.pipeline_stages > 1):
+        # pipeline parallelism is a training-time layout: export ships the
+        # canonical per-block artifact (identical scoring graph + weights)
+        from ..models.ft_transformer import canonicalize_params
+        params = canonicalize_params(dict(jax.device_get(params)), job.model)
+        job = job.replace(model=_dc.replace(job.model, pipeline_stages=1,
+                                            pipeline_microbatches=0))
+        if forward_fn is not None:
+            from ..train.step import make_forward_fn
+            forward_fn = make_forward_fn(job)
     os.makedirs(export_dir, exist_ok=True)
 
     flat = _flatten_params(params)

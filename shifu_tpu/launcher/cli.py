@@ -334,11 +334,11 @@ def run_train(args) -> int:
     if args.devices:
         n_devices = min(n_devices, args.devices)
     mesh_cfg = job.runtime.mesh
-    need = mesh_cfg.data * mesh_cfg.model * mesh_cfg.seq
+    need = mesh_cfg.num_devices
     if need > 1:
-        # explicit topology from config (shifu.mesh.* — dp size, tp and/or
-        # sequence parallelism); all-axes-1 means "unset" and defaults to
-        # data parallelism over every visible device
+        # explicit topology from config (shifu.mesh.* — dp size, tp,
+        # sequence and/or pipeline parallelism); all-axes-1 means "unset"
+        # and defaults to data parallelism over every visible device
         from ..parallel import make_mesh
         if need > n_devices:
             board(f"mesh {mesh_cfg} needs {need} devices, have {n_devices}")
@@ -359,6 +359,17 @@ def run_train(args) -> int:
         board("warning: attention_impl='flash' is a per-device kernel and "
               "ignores the mesh seq axis; use 'ring' or 'ulysses' for "
               "sequence parallelism")
+    if job.model.pipeline_stages > 1 and (
+            mesh is None or mesh.shape.get("pipe", 1) <= 1):
+        board(f"warning: pipeline_stages={job.model.pipeline_stages} needs a "
+              "mesh with a pipe axis > 1 (shifu.mesh.pipe); running the "
+              "stacked trunk on one stage")
+    if job.model.pipeline_stages <= 1 and (
+            mesh is not None and mesh.shape.get("pipe", 1) > 1):
+        board(f"warning: mesh pipe axis = {mesh.shape['pipe']} but the model "
+              "is not pipelined (PipelineStages in ModelConfig params); the "
+              "pipe group replicates work — fold those devices into "
+              "shifu.mesh.data instead")
 
     board(f"shifu_tpu train: {job.runtime.app_name} "
           f"devices={devices_in_use}/{n_devices} "

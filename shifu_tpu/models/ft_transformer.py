@@ -21,11 +21,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+import numpy as np
+from jax.nn import initializers as jinit
+
 from ..config.schema import ModelSpec
 from ..ops.attention import mha, ring_attention, ulysses_attention
 from ..ops.pallas_attention import flash_attention
 from ..ops.initializers import xavier_uniform
-from ..parallel.mesh import SEQ_AXIS
+from ..parallel.mesh import PIPE_AXIS, SEQ_AXIS
 from .base import ShifuDense, dtype_of
 from .embedding import (CategoricalEmbed, FieldLayout, NumericEmbed,
                         split_features)
@@ -35,6 +38,12 @@ def _seq_parallel_size(mesh: Optional[Mesh]) -> int:
     if mesh is None or SEQ_AXIS not in mesh.shape:
         return 1
     return int(mesh.shape[SEQ_AXIS])
+
+
+def _pipe_parallel_size(mesh: Optional[Mesh]) -> int:
+    if mesh is None or PIPE_AXIS not in mesh.shape:
+        return 1
+    return int(mesh.shape[PIPE_AXIS])
 
 
 class TransformerBlock(nn.Module):
@@ -99,6 +108,143 @@ class TransformerBlock(nn.Module):
         return x + y
 
 
+# -- pipeline-parallel trunk -------------------------------------------------
+
+# stacked param name -> canonical (module, leaf) path inside block_{i}/
+_BLOCK_PARAM_PATHS = {
+    "ln_attn_scale": ("ln_attn", "scale"), "ln_attn_bias": ("ln_attn", "bias"),
+    "qkv_kernel": ("qkv", "kernel"), "qkv_bias": ("qkv", "bias"),
+    "proj_kernel": ("proj", "kernel"), "proj_bias": ("proj", "bias"),
+    "ln_mlp_scale": ("ln_mlp", "scale"), "ln_mlp_bias": ("ln_mlp", "bias"),
+    "mlp_in_kernel": ("mlp_in", "kernel"), "mlp_in_bias": ("mlp_in", "bias"),
+    "mlp_out_kernel": ("mlp_out", "kernel"), "mlp_out_bias": ("mlp_out", "bias"),
+}
+
+
+def _layernorm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+               cdt, eps: float = 1e-6) -> jax.Array:
+    """Flax-default LayerNorm (float32 statistics, eps 1e-6) as a pure fn —
+    the same math the artifact's `layernorm` op executes (export/program.py)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(cdt)
+
+
+def _block_forward(p: dict, x: jax.Array, spec: ModelSpec) -> jax.Array:
+    """One pre-LN transformer block as a pure function over a param dict —
+    the same math as TransformerBlock (module form), reused by the stacked
+    (lax.scan) and pipelined (shard_map) trunks."""
+    cdt = dtype_of(spec.compute_dtype)
+    d = spec.token_dim
+    h = spec.num_attention_heads
+    dh = d // h
+    b, s, _ = x.shape
+
+    y = _layernorm(x, p["ln_attn_scale"], p["ln_attn_bias"], cdt)
+    qkv = y @ p["qkv_kernel"].astype(cdt) + p["qkv_bias"].astype(cdt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
+    attn = (flash_attention(q, k, v) if spec.attention_impl == "flash"
+            else mha(q, k, v))
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
+    attn = attn @ p["proj_kernel"].astype(cdt) + p["proj_bias"].astype(cdt)
+    x = x + attn
+
+    y = _layernorm(x, p["ln_mlp_scale"], p["ln_mlp_bias"], cdt)
+    y = y @ p["mlp_in_kernel"].astype(cdt) + p["mlp_in_bias"].astype(cdt)
+    y = nn.gelu(y)
+    y = y @ p["mlp_out_kernel"].astype(cdt) + p["mlp_out_bias"].astype(cdt)
+    return x + y
+
+
+def make_stage_fn(spec: ModelSpec):
+    """stage_fn(local_params, h) for parallel/pipeline.pipeline_apply: scan
+    `_block_forward` over this stage's share of the stacked layers."""
+    def stage_fn(params, h):
+        def body(carry, layer_params):
+            return _block_forward(layer_params, carry, spec), None
+        out, _ = jax.lax.scan(body, h, params)
+        return out
+    return stage_fn
+
+
+class StackedBlocks(nn.Module):
+    """The transformer trunk with layer-stacked parameters (leaves
+    (num_layers, ...)), enabling pipeline parallelism: with a `pipe` mesh
+    axis the stacked leaves shard by stage (place_params rule in
+    train/loop.init_state) and microbatches flow through
+    parallel/pipeline.pipeline_apply; otherwise the same params run as one
+    lax.scan.  `canonicalize_params` converts the stacked tree to the
+    per-block module tree for export (export/artifact.py)."""
+
+    spec: ModelSpec
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        spec = self.spec
+        L, d, r = spec.num_layers, spec.token_dim, spec.mlp_ratio
+        pdt = dtype_of(spec.param_dtype)
+        stacked_xavier = jinit.variance_scaling(
+            1.0, "fan_avg", "uniform", in_axis=-2, out_axis=-1, batch_axis=(0,))
+        f32 = jnp.float32  # LayerNorm params stay float32 like flax's
+        # nn.LayerNorm default, so canonicalized artifacts match exactly
+        shapes = {
+            "ln_attn_scale": ((L, d), jinit.ones, f32),
+            "ln_attn_bias": ((L, d), jinit.zeros, f32),
+            "qkv_kernel": ((L, d, 3 * d), stacked_xavier, pdt),
+            "qkv_bias": ((L, 3 * d), jinit.zeros, pdt),
+            "proj_kernel": ((L, d, d), stacked_xavier, pdt),
+            "proj_bias": ((L, d), jinit.zeros, pdt),
+            "ln_mlp_scale": ((L, d), jinit.ones, f32),
+            "ln_mlp_bias": ((L, d), jinit.zeros, f32),
+            "mlp_in_kernel": ((L, d, r * d), stacked_xavier, pdt),
+            "mlp_in_bias": ((L, r * d), jinit.zeros, pdt),
+            "mlp_out_kernel": ((L, r * d, d), stacked_xavier, pdt),
+            "mlp_out_bias": ((L, d), jinit.zeros, pdt),
+        }
+        params = {name: self.param(name, init, shape, dt)
+                  for name, (shape, init, dt) in shapes.items()}
+
+        n_pipe = _pipe_parallel_size(self.mesh)
+        stage_fn = make_stage_fn(spec)
+        if n_pipe <= 1:
+            return stage_fn(params, x)
+
+        from ..parallel.pipeline import pipeline_apply
+        n_micro = spec.pipeline_microbatches or spec.pipeline_stages
+        b = x.shape[0]
+        if b % n_micro != 0:
+            raise ValueError(
+                f"pipeline needs batch ({b}) divisible by microbatch count "
+                f"({n_micro}); adjust batch_size or pipeline_microbatches")
+        micro = x.reshape(n_micro, b // n_micro, *x.shape[1:])
+        out = pipeline_apply(stage_fn, params, micro, self.mesh)
+        return out.reshape(b, *x.shape[1:])
+
+
+def canonicalize_params(params: dict, spec: ModelSpec) -> dict:
+    """Convert a StackedBlocks ('blocks/<name>' leaves (L, ...)) param tree
+    into the canonical per-block tree ('block_{i}/<module>/<leaf>') the
+    export program references (export/program.py transformer_block op keys),
+    so a pipeline-trained model ships the exact same artifact as a
+    single-device one.  Non-stacked trees pass through unchanged."""
+    if "blocks" not in params:
+        return params
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    stacked = {name: np.asarray(leaf) for name, leaf in params["blocks"].items()}
+    for i in range(spec.num_layers):
+        block: dict = {}
+        for name, (module, leaf) in _BLOCK_PARAM_PATHS.items():
+            block.setdefault(module, {})[leaf] = stacked[name][i]
+        out[f"block_{i}"] = block
+    return out
+
+
 class FTTransformer(nn.Module):
     spec: ModelSpec
     layout: FieldLayout
@@ -128,9 +274,16 @@ class FTTransformer(nn.Module):
         cls = jnp.broadcast_to(cls.astype(cdt), (x.shape[0], 1, d))
         x = jnp.concatenate([cls, x.astype(cdt)], axis=1)
 
-        for i in range(self.spec.num_layers):
-            x = TransformerBlock(spec=self.spec, mesh=self.mesh,
-                                 name=f"block_{i}")(x, train=train)
+        if self.spec.pipeline_stages > 1:
+            if _seq_parallel_size(self.mesh) > 1:
+                raise ValueError("pipeline_stages > 1 does not compose with a "
+                                 "seq mesh axis; use one or the other")
+            x = StackedBlocks(spec=self.spec, mesh=self.mesh,
+                              name="blocks")(x, train=train)
+        else:
+            for i in range(self.spec.num_layers):
+                x = TransformerBlock(spec=self.spec, mesh=self.mesh,
+                                     name=f"block_{i}")(x, train=train)
 
         cls_out = nn.LayerNorm(dtype=cdt, name="ln_final")(x[:, 0, :])
         return ShifuDense(features=self.spec.num_heads, activation=None,

@@ -1,11 +1,13 @@
 from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
+    PIPE_AXIS,
     SEQ_AXIS,
     data_parallel_mesh,
     host_shard_info,
     make_mesh,
 )
+from .pipeline import pipeline_apply, pipeline_reference
 from .sharding import (
     DEFAULT_RULES,
     batch_sharding,
@@ -20,7 +22,10 @@ from .sharding import (
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "PIPE_AXIS",
     "SEQ_AXIS",
+    "pipeline_apply",
+    "pipeline_reference",
     "data_parallel_mesh",
     "host_shard_info",
     "make_mesh",

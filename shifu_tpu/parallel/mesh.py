@@ -4,8 +4,9 @@ The mesh replaces the reference's cluster topology: the `data` axis succeeds
 the N worker containers (each held a disjoint file shard —
 yarn/appmaster/TrainingDataSet.java:65-82), `model` succeeds parameter
 placement across PS containers (replica_device_setter round-robin,
-resources/ssgd_monitor.py:202-206), and `seq` is the sequence/context-parallel
-axis for attention models.  Collectives ride ICI inside a slice and DCN across
+resources/ssgd_monitor.py:202-206), `seq` is the sequence/context-parallel
+axis for attention models, and `pipe` is the pipeline-parallel axis (stages
+hold disjoint layer blocks — parallel/pipeline.py).  Collectives ride ICI inside a slice and DCN across
 slices; XLA chooses them from the shardings — nothing here speaks NCCL/gRPC.
 """
 
@@ -21,6 +22,7 @@ from ..config.schema import ConfigError, MeshConfig
 
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
 MODEL_AXIS = "model"
 
 
@@ -35,10 +37,13 @@ def make_mesh(cfg: Optional[MeshConfig] = None,
     n = len(devices)
     if cfg is None:
         cfg = MeshConfig(data=n)
+    cfg.validate()
     if cfg.num_devices != n:
         raise ConfigError(
-            f"mesh {cfg.data}x{cfg.seq}x{cfg.model} needs {cfg.num_devices} devices, have {n}")
-    sizes = {"data": cfg.data, "seq": cfg.seq, "model": cfg.model}
+            f"mesh {cfg.data}x{cfg.seq}x{cfg.pipe}x{cfg.model} needs "
+            f"{cfg.num_devices} devices, have {n}")
+    sizes = {"data": cfg.data, "seq": cfg.seq, "pipe": cfg.pipe,
+             "model": cfg.model}
     axis_names = tuple(cfg.axis_order)
     shape = tuple(sizes[a] for a in axis_names)
     try:

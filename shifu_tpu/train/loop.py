@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from ..config.schema import JobConfig
+from ..config.schema import ConfigError, JobConfig
 from ..data import pipeline as pipe
 from ..models.registry import build_model
 from ..ops import metrics as metrics_lib
@@ -67,17 +67,50 @@ class TrainResult:
 def init_state(job: JobConfig, num_features: int,
                mesh: Optional[Mesh] = None) -> TrainState:
     """Build model + optimizer and initialize (optionally mesh-placed) state."""
+    if (mesh is not None and job.model.pipeline_stages > 1
+            and int(mesh.shape.get("pipe", 1)) > 1
+            and int(mesh.shape["pipe"]) != job.model.pipeline_stages):
+        # the effective stage count IS the mesh's pipe axis: demand the
+        # config agree rather than silently running a different split or
+        # crashing inside shard_map with a bare divisibility error
+        raise ConfigError(
+            f"mesh pipe axis ({int(mesh.shape['pipe'])}) must equal "
+            f"model.pipeline_stages ({job.model.pipeline_stages})")
+    if job.model.pipeline_stages > 1:
+        # fail at init with the fix spelled out, not at the first train step
+        # deep inside shard_map with a bare divisibility error
+        n_micro = (job.model.pipeline_microbatches
+                   or job.model.pipeline_stages)
+        n_data = int(mesh.shape.get("data", 1)) if mesh is not None else 1
+        bs = job.data.batch_size
+        if bs % n_micro != 0 or (bs // n_micro) % n_data != 0:
+            raise ConfigError(
+                f"batch_size ({bs}) must be divisible by pipeline "
+                f"microbatches ({n_micro}) x data axis ({n_data}); "
+                f"use a multiple of {n_micro * n_data}")
     model = build_model(job.model, job.schema, mesh)
     tx = build_optimizer(job.train.optimizer)
     rng = jax.random.PRNGKey(job.train.seed)
     # init batch must divide the data axis: a mesh-aware model (sequence-
-    # parallel attention) shard_maps the batch dimension even at init
+    # parallel attention) shard_maps the batch dimension even at init —
+    # and the pipelined trunk additionally splits it into microbatches
     init_batch = int(mesh.shape.get("data", 1)) if mesh is not None else 1
+    if job.model.pipeline_stages > 1:
+        init_batch *= (job.model.pipeline_microbatches
+                       or job.model.pipeline_stages)
     dummy = jnp.zeros((init_batch, num_features), jnp.float32)
     variables = model.init(rng, dummy)
     state = TrainState.create(apply_fn=model.apply, params=variables["params"], tx=tx)
     if mesh is not None:
-        rules = shard_lib.DEFAULT_RULES if job.runtime.mesh.model > 1 else ()
+        rules: tuple = ()
+        if job.runtime.mesh.model > 1:
+            rules += tuple(shard_lib.DEFAULT_RULES)
+        if (job.model.pipeline_stages > 1
+                and int(mesh.shape.get("pipe", 1)) > 1):
+            # stacked trunk layers shard by stage: each device holds (and
+            # updates) only its own pipeline stage's parameters
+            from jax.sharding import PartitionSpec as P
+            rules += ((r".*\bblocks\b.*", P("pipe")),)
         placed_params = shard_lib.place_params(state.params, mesh, rules)
         placed_opt = jax.tree_util.tree_map(
             lambda x: jax.device_put(x, shard_lib.replicated(mesh))
@@ -109,6 +142,11 @@ def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
     if mesh is not None:
         # keep the per-device shard static
         bs = -(-bs // mesh.size) * mesh.size
+    if job.model.pipeline_stages > 1:
+        # the pipelined trunk splits every batch into microbatches
+        n_micro = job.model.pipeline_microbatches or job.model.pipeline_stages
+        quantum = n_micro * (mesh.size if mesh is not None else 1)
+        bs = -(-bs // quantum) * quantum
     if not multihost:
         scores_parts, targets_parts, weights_parts = [], [], []
         for batch in pipe.batch_iterator(ds, bs, shuffle=False,

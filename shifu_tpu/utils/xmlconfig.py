@@ -34,6 +34,7 @@ KEY_MAX_MISSED_HEARTBEATS = "shifu.task.max-missed-heartbeats"
 KEY_MESH_DATA = "shifu.mesh.data"
 KEY_MESH_MODEL = "shifu.mesh.model"
 KEY_MESH_SEQ = "shifu.mesh.seq"
+KEY_MESH_PIPE = "shifu.mesh.pipe"
 # input-pipeline knobs (no reference analog: its loader was fixed-function)
 # secured-HDFS auth (successor of the reference's Kerberos delegation
 # tokens, TensorflowClient.java:481-502)
@@ -154,12 +155,14 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         rt_kw["kerberos_principal"] = conf[KEY_KERBEROS_PRINCIPAL]
     if KEY_KERBEROS_KEYTAB in conf:
         rt_kw["kerberos_keytab"] = conf[KEY_KERBEROS_KEYTAB]
-    if KEY_MESH_DATA in conf or KEY_MESH_MODEL in conf or KEY_MESH_SEQ in conf:
+    if (KEY_MESH_DATA in conf or KEY_MESH_MODEL in conf
+            or KEY_MESH_SEQ in conf or KEY_MESH_PIPE in conf):
         rt_kw["mesh"] = dataclasses.replace(
             runtime.mesh,
             data=int(conf.get(KEY_MESH_DATA, runtime.mesh.data)),
             model=int(conf.get(KEY_MESH_MODEL, runtime.mesh.model)),
-            seq=int(conf.get(KEY_MESH_SEQ, runtime.mesh.seq)))
+            seq=int(conf.get(KEY_MESH_SEQ, runtime.mesh.seq)),
+            pipe=int(conf.get(KEY_MESH_PIPE, runtime.mesh.pipe)))
     if rt_kw:
         runtime = dataclasses.replace(runtime, **rt_kw)
 

@@ -39,7 +39,7 @@ def _batch(n=64, f=30, seed=0):
 
 def test_make_mesh_shapes(eight_devices):
     mesh = make_mesh(MeshConfig(data=4, model=2), devices=eight_devices)
-    assert mesh.shape == {"data": 4, "seq": 1, "model": 2}
+    assert mesh.shape == {"data": 4, "seq": 1, "pipe": 1, "model": 2}
     mesh2 = data_parallel_mesh(8)
     assert mesh2.shape["data"] == 8
 

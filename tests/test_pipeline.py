@@ -1,0 +1,203 @@
+"""Pipeline parallelism (`pipe` mesh axis) on the virtual 8-device CPU mesh.
+
+The reference has no pipeline parallelism (SURVEY.md section 2.4); these tests
+pin the new capability's contract: the GPipe microbatch schedule over
+`ppermute` (parallel/pipeline.py) computes exactly what the sequential
+stage-by-stage oracle computes — forward AND gradients — and a
+pipeline-trained FT-Transformer updates identically to its single-device
+stacked twin and exports the canonical artifact.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from shifu_tpu.config import (ConfigError, DataConfig, JobConfig, MeshConfig,
+                              ModelSpec, OptimizerConfig, TrainConfig)
+from shifu_tpu.data import synthetic
+from shifu_tpu.parallel import make_mesh, pipeline_apply, pipeline_reference
+from shifu_tpu.train import init_state, make_train_step
+
+
+def _dense_stage_fn(params, h):
+    """Toy stage: scan h @ W over this stage's stacked kernels."""
+    def body(carry, w):
+        return jnp.tanh(carry @ w), None
+    out, _ = jax.lax.scan(body, h, params)
+    return out
+
+
+def _pipe_mesh(eight_devices, data=2, pipe=4):
+    return make_mesh(MeshConfig(data=data, pipe=pipe), devices=eight_devices)
+
+
+def test_pipeline_matches_reference_forward(eight_devices, rng):
+    mesh = _pipe_mesh(eight_devices)
+    L, d, n_micro, mb = 4, 8, 6, 4
+    params = rng.standard_normal((L, d, d)).astype(np.float32) * 0.3
+    x = rng.standard_normal((n_micro, mb, d)).astype(np.float32)
+
+    want = pipeline_reference(_dense_stage_fn, jnp.asarray(params),
+                              jnp.asarray(x), n_stages=4)
+    got = pipeline_apply(_dense_stage_fn, jnp.asarray(params),
+                         jnp.asarray(x), mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_matches_reference_gradients(eight_devices, rng):
+    mesh = _pipe_mesh(eight_devices)
+    L, d, n_micro, mb = 4, 8, 4, 4
+    params = jnp.asarray(rng.standard_normal((L, d, d)).astype(np.float32) * 0.3)
+    x = jnp.asarray(rng.standard_normal((n_micro, mb, d)).astype(np.float32))
+
+    def loss_pipe(p):
+        return jnp.sum(pipeline_apply(_dense_stage_fn, p, x, mesh) ** 2)
+
+    def loss_ref(p):
+        return jnp.sum(pipeline_reference(_dense_stage_fn, p, x, 4) ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(params)
+    g_ref = jax.grad(loss_ref)(params)
+    np.testing.assert_allclose(np.asarray(g_pipe), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def _ft_job(pipeline_stages, batch_size=16, mesh_cfg=None):
+    schema = synthetic.make_schema(num_features=7, num_categorical=2,
+                                   vocab_size=16)
+    job = JobConfig(
+        schema=schema,
+        data=DataConfig(batch_size=batch_size),
+        model=ModelSpec(model_type="ft_transformer", hidden_nodes=(8,),
+                        activations=("relu",), token_dim=8,
+                        num_attention_heads=2, num_layers=2,
+                        pipeline_stages=pipeline_stages,
+                        compute_dtype="float32"),
+        train=TrainConfig(epochs=1, loss="weighted_mse",
+                          optimizer=OptimizerConfig(name="adadelta",
+                                                    learning_rate=0.01)),
+    ).validate()
+    if mesh_cfg is not None:
+        job = job.replace(runtime=job.runtime.__class__(mesh=mesh_cfg))
+    return job
+
+
+def _ft_batch(job, n, seed=0):
+    rows = synthetic.make_rows(n, job.schema, seed=seed)
+    from shifu_tpu.data import reader
+    return reader.project_columns(rows, job.schema)
+
+
+def test_pipelined_train_step_matches_single_device(eight_devices):
+    """Pipeline-parallel update == single-device update on the same batch
+    (the same sync-semantics contract as test_parallel's data-parallel case)."""
+    mesh_cfg = MeshConfig(data=4, pipe=2)
+    job = _ft_job(pipeline_stages=2, batch_size=16, mesh_cfg=mesh_cfg)
+    batch_np = _ft_batch(job, 16)
+
+    state1 = init_state(job, job.schema.feature_count)
+    step1 = make_train_step(job, donate=False)
+    new1, m1 = step1(state1, {k: jnp.asarray(v) for k, v in batch_np.items()})
+
+    mesh = make_mesh(mesh_cfg, devices=eight_devices)
+    from shifu_tpu.parallel import shard_batch
+    state8 = init_state(job, job.schema.feature_count, mesh)
+    # stacked trunk leaves must be stage-sharded over `pipe`
+    spec = state8.params["blocks"]["qkv_kernel"].sharding.spec
+    assert spec[0] == "pipe", spec
+    step8 = make_train_step(job, mesh, donate=False)
+    new8, m8 = step8(state8, shard_batch(batch_np, mesh))
+
+    assert float(m1["loss"]) == pytest.approx(float(m8["loss"]), rel=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(new1.params),
+                    jax.tree_util.tree_leaves(new8.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_canonicalize_params_matches_per_block_model():
+    """Stacked-trunk forward == standard per-block FTTransformer forward on
+    the canonicalized param tree (the export-parity contract)."""
+    import dataclasses
+
+    from shifu_tpu.models.ft_transformer import canonicalize_params
+    from shifu_tpu.models.registry import build_model
+
+    job = _ft_job(pipeline_stages=2, batch_size=8)
+    stacked_model = build_model(job.model, job.schema)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal(
+        (8, job.schema.feature_count)).astype(np.float32))
+    variables = stacked_model.init(jax.random.PRNGKey(0), x)
+    want = stacked_model.apply(variables, x)
+
+    canon_spec = dataclasses.replace(job.model, pipeline_stages=1)
+    canon_model = build_model(canon_spec, job.schema)
+    canon_params = canonicalize_params(dict(variables["params"]), job.model)
+    got = canon_model.apply({"params": canon_params}, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipelined_model_exports_canonical_artifact(tmp_path):
+    """save_artifact on a pipeline-trained model ships the canonical
+    per-block artifact; the numpy scorer reproduces the training forward."""
+    from shifu_tpu.export import load_scorer, save_artifact
+    from shifu_tpu.models.registry import build_model
+
+    job = _ft_job(pipeline_stages=2, batch_size=8)
+    state = init_state(job, job.schema.feature_count)
+    save_artifact(jax.device_get(state.params), job, str(tmp_path))
+
+    import json
+    topo = json.loads((tmp_path / "topology.json").read_text())
+    assert topo["model_spec"]["pipeline_stages"] == 1
+    assert any(op["op"] == "transformer_block" for op in topo["program"])
+
+    rows = np.random.default_rng(2).standard_normal(
+        (16, job.schema.feature_count)).astype(np.float32)
+    model = build_model(job.model, job.schema)
+    want = jax.nn.sigmoid(model.apply({"params": state.params},
+                                      jnp.asarray(rows)))
+    scorer = load_scorer(str(tmp_path))
+    got = scorer.compute_batch(rows)
+    np.testing.assert_allclose(np.asarray(got).ravel(),
+                               np.asarray(want).ravel(), rtol=1e-4, atol=1e-5)
+
+
+def test_mesh_pipe_stage_mismatch_rejected(eight_devices):
+    """A pipe axis that disagrees with pipeline_stages must fail loudly at
+    init, not crash in placement or silently run a different stage count."""
+    mesh_cfg = MeshConfig(data=2, pipe=4)
+    job = _ft_job(pipeline_stages=2, batch_size=16, mesh_cfg=mesh_cfg)
+    mesh = make_mesh(mesh_cfg, devices=eight_devices)
+    with pytest.raises(ConfigError, match="pipe axis"):
+        init_state(job, job.schema.feature_count, mesh)
+
+
+def test_pipeline_batch_quantum_rejected(eight_devices):
+    """batch_size not divisible by microbatches x data axis must fail at
+    init_state with a ConfigError naming the usable multiple."""
+    mesh_cfg = MeshConfig(data=4, pipe=2)
+    job = _ft_job(pipeline_stages=2, batch_size=24, mesh_cfg=mesh_cfg)
+    job = job.replace(data=DataConfig(batch_size=24))
+    import dataclasses
+    job = job.replace(model=dataclasses.replace(job.model,
+                                                pipeline_microbatches=4))
+    mesh = make_mesh(mesh_cfg, devices=eight_devices)
+    with pytest.raises(ConfigError, match="multiple of 16"):
+        init_state(job, job.schema.feature_count, mesh)
+
+
+def test_mesh_config_pipe_validation():
+    with pytest.raises(ConfigError):
+        MeshConfig(pipe=0).validate()
+    with pytest.raises(ConfigError):
+        MeshConfig(pipe=2, axis_order=("data", "seq", "model")).validate()
+    with pytest.raises(ConfigError):
+        ModelSpec(model_type="mlp", pipeline_stages=2).validate()
+    with pytest.raises(ConfigError):
+        ModelSpec(model_type="ft_transformer", num_layers=3,
+                  pipeline_stages=2).validate()
