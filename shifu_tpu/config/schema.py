@@ -183,6 +183,10 @@ class ModelSpec:
     # microbatches per global batch when pipelined; 0 = pipeline_stages
     # (the minimum that keeps every stage busy at steady state)
     pipeline_microbatches: int = 0
+    # rematerialization (gradient checkpointing): recompute each transformer
+    # block's activations in the backward pass instead of storing them —
+    # trades FLOPs for HBM on deep stacks / long token axes (jax.checkpoint)
+    remat: bool = False
     # numerics
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"

@@ -63,6 +63,14 @@ _ALGORITHM_TO_MODEL_TYPE = {
 }
 
 
+def _parse_bool(value: Any) -> bool:
+    """Shifu params are often string-typed: 'false'/'0'/'no' must read as
+    False (bool('false') would be True)."""
+    if isinstance(value, str):
+        return value.strip().lower() in ("true", "1", "yes")
+    return bool(value)
+
+
 def _norm_activation(name: Optional[str]) -> str:
     # Reference: unknown/None activation falls back to leaky_relu
     # (ssgd_monitor.py:77-90).
@@ -223,6 +231,7 @@ def parse_model_config(model_config: dict[str, Any]) -> tuple[ModelSpec, TrainCo
         attention_impl=str(params.get("AttentionImpl", "local")).lower(),
         pipeline_stages=int(params.get("PipelineStages", 1)),
         pipeline_microbatches=int(params.get("PipelineMicrobatches", 0)),
+        remat=_parse_bool(params.get("Remat", False)),
     )
 
     lr = float(params.get("LearningRate", 0.003))  # reference fallback 0.003 (ssgd_monitor.py:136)

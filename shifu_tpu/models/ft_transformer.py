@@ -51,7 +51,7 @@ class TransformerBlock(nn.Module):
     mesh: Optional[Mesh] = None  # enables ring/ulysses when it has a seq axis
 
     @nn.compact
-    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         cdt = dtype_of(self.spec.compute_dtype)
         d = self.spec.token_dim
         h = self.spec.num_attention_heads
@@ -163,10 +163,16 @@ def _block_forward(p: dict, x: jax.Array, spec: ModelSpec) -> jax.Array:
 
 def make_stage_fn(spec: ModelSpec):
     """stage_fn(local_params, h) for parallel/pipeline.pipeline_apply: scan
-    `_block_forward` over this stage's share of the stacked layers."""
+    `_block_forward` over this stage's share of the stacked layers.  With
+    spec.remat each block recomputes its activations in the backward pass
+    (jax.checkpoint) instead of storing them across the scan."""
+    block = lambda p, x: _block_forward(p, x, spec)
+    if spec.remat:
+        block = jax.checkpoint(block)
+
     def stage_fn(params, h):
         def body(carry, layer_params):
-            return _block_forward(layer_params, carry, spec), None
+            return block(layer_params, carry), None
         out, _ = jax.lax.scan(body, h, params)
         return out
     return stage_fn
@@ -281,9 +287,14 @@ class FTTransformer(nn.Module):
             x = StackedBlocks(spec=self.spec, mesh=self.mesh,
                               name="blocks")(x, train=train)
         else:
+            # static_argnums marks `train` (arg 2, after self/x) static so
+            # jax.checkpoint never traces the bool — dropout's
+            # `deterministic=not train` stays a Python branch under remat
+            block_cls = (nn.remat(TransformerBlock, static_argnums=(2,))
+                         if self.spec.remat else TransformerBlock)
             for i in range(self.spec.num_layers):
-                x = TransformerBlock(spec=self.spec, mesh=self.mesh,
-                                     name=f"block_{i}")(x, train=train)
+                x = block_cls(spec=self.spec, mesh=self.mesh,
+                              name=f"block_{i}")(x, train)
 
         cls_out = nn.LayerNorm(dtype=cdt, name="ln_final")(x[:, 0, :])
         return ShifuDense(features=self.spec.num_heads, activation=None,

@@ -14,6 +14,7 @@ from .sharding import (
     batch_spec,
     param_shardings,
     param_specs,
+    place_opt_state,
     place_params,
     replicated,
     shard_batch,
@@ -34,6 +35,7 @@ __all__ = [
     "batch_spec",
     "param_shardings",
     "param_specs",
+    "place_opt_state",
     "place_params",
     "replicated",
     "shard_batch",

@@ -128,3 +128,38 @@ def place_params(params: PyTree, mesh: Mesh, rules: ShardingRules = ()) -> PyTre
     """device_put params according to rules (default: fully replicated)."""
     shardings = param_shardings(params, mesh, rules)
     return jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+
+def place_opt_state(opt_state: PyTree, params: PyTree, mesh: Mesh,
+                    rules: ShardingRules = ()) -> PyTree:
+    """device_put optimizer state so param-shaped slots follow their param's
+    sharding (a vocab-sharded embedding's adadelta accumulators stay sharded
+    over `model`, a stage-sharded pipeline trunk's slots over `pipe`);
+    everything else — step counters, scalars — replicates.
+
+    Optimizer states embed copies of the param tree (optax accumulators are
+    `tree_map(zeros_like, params)`), so each slot's key path ends with the
+    full path of its param; the longest matching path suffix with an equal
+    shape picks the sharding.  Works through nested wrappers (MultiSteps,
+    chains) since matching is purely structural.
+    """
+    p_sh = param_shardings(params, mesh, rules)
+    flat_params = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_sh = jax.tree_util.tree_leaves(
+        p_sh, is_leaf=lambda x: isinstance(x, NamedSharding))
+    by_path = {
+        tuple(str(k) for k in kp): (leaf.shape, sh)
+        for (kp, leaf), sh in zip(flat_params, flat_sh)
+    }
+
+    def place(kp, leaf):
+        if not hasattr(leaf, "shape"):
+            return leaf
+        keys = tuple(str(k) for k in kp)
+        for n in range(len(keys), 0, -1):
+            hit = by_path.get(keys[-n:])
+            if hit is not None and hit[0] == leaf.shape:
+                return jax.device_put(leaf, hit[1])
+        return jax.device_put(leaf, replicated(mesh))
+
+    return jax.tree_util.tree_map_with_path(place, opt_state)

@@ -112,10 +112,11 @@ def init_state(job: JobConfig, num_features: int,
             from jax.sharding import PartitionSpec as P
             rules += ((r".*\bblocks\b.*", P("pipe")),)
         placed_params = shard_lib.place_params(state.params, mesh, rules)
-        placed_opt = jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, shard_lib.replicated(mesh))
-            if isinstance(x, jax.Array) else x,
-            state.opt_state)
+        # optimizer slots follow their param's sharding (a vocab-sharded
+        # embedding or stage-sharded pipeline trunk keeps its optimizer
+        # memory sharded too, instead of replicating it on every device)
+        placed_opt = shard_lib.place_opt_state(state.opt_state, state.params,
+                                               mesh, rules)
         state = state.replace(
             params=placed_params,
             opt_state=placed_opt,

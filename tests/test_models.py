@@ -126,3 +126,57 @@ def test_deepfm_embedding_sharded_on_mesh(eight_devices):
     assert np.isfinite(float(metrics["loss"]))
     # update preserved the sharding
     assert new_state.params["cat_embedding"]["embedding"].sharding.spec[0] == "model"
+
+
+def test_remat_matches_unremat_gradients():
+    """ModelSpec.remat recomputes block activations in the backward pass;
+    forward and gradients must be identical to the stored-activation model
+    (both per-block and stacked/pipelined trunks)."""
+    schema = synthetic.make_schema(num_features=7, num_categorical=2,
+                                   vocab_size=16)
+    x = jnp.asarray(np.random.default_rng(3).standard_normal(
+        (8, schema.feature_count)).astype(np.float32))
+
+    for stages in (1, 2):
+        spec = ModelSpec(model_type="ft_transformer", hidden_nodes=(8,),
+                         activations=("relu",), token_dim=8,
+                         num_attention_heads=2, num_layers=2,
+                         pipeline_stages=stages, compute_dtype="float32")
+        base = build_model(spec, schema)
+        variables = base.init(jax.random.PRNGKey(0), x)
+        import dataclasses
+        rem = build_model(dataclasses.replace(spec, remat=True), schema)
+
+        def loss(model):
+            return lambda p: jnp.sum(model.apply({"params": p}, x) ** 2)
+
+        l0, g0 = jax.value_and_grad(loss(base))(variables["params"])
+        l1, g1 = jax.value_and_grad(loss(rem))(variables["params"])
+        assert float(l0) == pytest.approx(float(l1), rel=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)
+
+
+def test_remat_with_dropout_initializes():
+    """remat must keep `train` static: dropout's `deterministic=not train`
+    is a Python branch and must not see a tracer under jax.checkpoint."""
+    schema = synthetic.make_schema(num_features=7, num_categorical=2,
+                                   vocab_size=16)
+    spec = ModelSpec(model_type="ft_transformer", hidden_nodes=(8,),
+                     activations=("relu",), token_dim=8,
+                     num_attention_heads=2, num_layers=2, dropout_rate=0.1,
+                     remat=True, compute_dtype="float32")
+    model = build_model(spec, schema)
+    x = jnp.zeros((4, schema.feature_count), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x)  # train=False: deterministic
+    assert out.shape == (4, 1)
+
+
+def test_shifu_remat_string_values():
+    from shifu_tpu.config.shifu_compat import _parse_bool
+    assert _parse_bool("true") and _parse_bool("1") and _parse_bool(True)
+    assert not _parse_bool("false") and not _parse_bool("0")
+    assert not _parse_bool("no") and not _parse_bool(False)

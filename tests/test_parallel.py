@@ -93,6 +93,34 @@ def test_param_sharding_rules(eight_devices):
     assert placed["dense"]["kernel"].sharding.spec == P()
 
 
+def test_opt_state_follows_param_sharding(eight_devices):
+    """Optimizer slots of sharded params carry the same sharding (adadelta
+    accumulators of a vocab-sharded embedding table must not replicate)."""
+    from shifu_tpu.config import (DataConfig, JobConfig, ModelSpec,
+                                  OptimizerConfig, TrainConfig)
+
+    mesh_cfg = MeshConfig(data=4, model=2)
+    mesh = make_mesh(mesh_cfg, devices=eight_devices)
+    schema = synthetic.make_schema(num_features=10, num_categorical=4,
+                                   vocab_size=64)
+    job = JobConfig(
+        schema=schema, data=DataConfig(batch_size=32),
+        model=ModelSpec(model_type="deepfm", hidden_nodes=(8,),
+                        activations=("relu",), embedding_dim=8),
+        train=TrainConfig(epochs=1, loss="weighted_mse",
+                          optimizer=OptimizerConfig(name="adadelta",
+                                                    learning_rate=0.01)),
+    ).validate()
+    job = job.replace(runtime=job.runtime.__class__(mesh=mesh_cfg))
+    state = init_state(job, schema.feature_count, mesh)
+    table = state.params["cat_embedding"]["embedding"]
+    assert table.sharding.spec[0] == "model"
+    opt_specs = [leaf.sharding.spec
+                 for leaf in jax.tree_util.tree_leaves(state.opt_state)
+                 if getattr(leaf, "shape", None) == table.shape]
+    assert opt_specs and all(s[0] == "model" for s in opt_specs), opt_specs
+
+
 def test_multi_epoch_sharded_training_learns(small_job, eight_devices):
     """Full loop over the mesh: learns on synthetic data like single-device."""
     from shifu_tpu.train import train as train_fn

@@ -108,6 +108,13 @@ def test_pipelined_train_step_matches_single_device(eight_devices):
     # stacked trunk leaves must be stage-sharded over `pipe`
     spec = state8.params["blocks"]["qkv_kernel"].sharding.spec
     assert spec[0] == "pipe", spec
+    # ...and their optimizer slots must follow the same sharding (stage
+    # memory stays sharded end-to-end, not replicated)
+    qkv_shape = state8.params["blocks"]["qkv_kernel"].shape
+    opt_specs = [leaf.sharding.spec
+                 for leaf in jax.tree_util.tree_leaves(state8.opt_state)
+                 if getattr(leaf, "shape", None) == qkv_shape]
+    assert opt_specs and all(s[0] == "pipe" for s in opt_specs), opt_specs
     step8 = make_train_step(job, mesh, donate=False)
     new8, m8 = step8(state8, shard_batch(batch_np, mesh))
 
