@@ -246,12 +246,33 @@ class OptimizerConfig:
     # (reference: resources/SAGN.py:110-142) — accumulate k microbatch grads
     # before applying one update.
     accumulate_steps: int = 1
+    # learning-rate schedule over optimizer steps (the reference only had a
+    # constant LR): constant | cosine | exponential | warmup_cosine
+    schedule: str = "constant"
+    warmup_steps: int = 0           # linear warmup from 0 (warmup_cosine)
+    decay_steps: int = 0            # horizon for cosine/exponential (required)
+    decay_rate: float = 0.96        # per-decay_steps factor (exponential)
+    end_lr_factor: float = 0.0      # final lr = learning_rate * this (cosine)
 
     def validate(self) -> None:
         if self.learning_rate <= 0:
             raise ConfigError("learning_rate must be positive")
         if self.accumulate_steps < 1:
             raise ConfigError("accumulate_steps must be >= 1")
+        if self.schedule not in ("constant", "cosine", "exponential",
+                                 "warmup_cosine"):
+            raise ConfigError(f"unknown schedule {self.schedule!r}; expected "
+                              "constant|cosine|exponential|warmup_cosine")
+        if self.schedule != "constant" and self.decay_steps <= 0:
+            raise ConfigError(
+                f"schedule {self.schedule!r} requires decay_steps > 0")
+        if self.warmup_steps < 0:
+            raise ConfigError("warmup_steps must be >= 0")
+        if (self.schedule == "warmup_cosine"
+                and self.decay_steps <= self.warmup_steps):
+            raise ConfigError(
+                f"warmup_cosine requires decay_steps ({self.decay_steps}) > "
+                f"warmup_steps ({self.warmup_steps})")
 
 
 @dataclass(frozen=True)
@@ -263,10 +284,18 @@ class TrainConfig:
     eval_every_epochs: int = 1      # reference evaluates the valid set every epoch (ssgd_monitor.py:281-284)
     log_every_steps: int = 0        # 0: epoch-level logging only, like the reference
     bagging_sample_rate: float = 1.0
+    # early stopping on the valid error (no reference analog — it always ran
+    # all numTrainEpochs): stop after this many evaluated epochs without an
+    # improvement of at least early_stop_min_delta.  0 disables.
+    early_stop_patience: int = 0
+    early_stop_min_delta: float = 0.0
 
     def validate(self) -> None:
         if self.epochs <= 0:
             raise ConfigError("epochs must be positive")
+        if self.early_stop_patience < 0 or self.early_stop_min_delta < 0:
+            raise ConfigError("early_stop_patience and early_stop_min_delta "
+                              "must be >= 0")
         if self.loss not in ("weighted_mse", "bce", "weighted_bce"):
             raise ConfigError(f"unknown loss {self.loss!r}")
         self.optimizer.validate()

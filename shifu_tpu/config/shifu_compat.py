@@ -22,6 +22,7 @@ import json
 import os
 from typing import Any, Optional, Sequence
 
+from ..utils.xmlconfig import parse_bool
 from .schema import (
     ColumnSpec,
     ConfigError,
@@ -61,14 +62,6 @@ _ALGORITHM_TO_MODEL_TYPE = {
     "FTTRANSFORMER": "ft_transformer",
     "FT_TRANSFORMER": "ft_transformer",
 }
-
-
-def _parse_bool(value: Any) -> bool:
-    """Shifu params are often string-typed: 'false'/'0'/'no' must read as
-    False (bool('false') would be True)."""
-    if isinstance(value, str):
-        return value.strip().lower() in ("true", "1", "yes")
-    return bool(value)
 
 
 def _norm_activation(name: Optional[str]) -> str:
@@ -231,7 +224,7 @@ def parse_model_config(model_config: dict[str, Any]) -> tuple[ModelSpec, TrainCo
         attention_impl=str(params.get("AttentionImpl", "local")).lower(),
         pipeline_stages=int(params.get("PipelineStages", 1)),
         pipeline_microbatches=int(params.get("PipelineMicrobatches", 0)),
-        remat=_parse_bool(params.get("Remat", False)),
+        remat=parse_bool(params.get("Remat", False)),
     )
 
     lr = float(params.get("LearningRate", 0.003))  # reference fallback 0.003 (ssgd_monitor.py:136)
@@ -240,12 +233,17 @@ def parse_model_config(model_config: dict[str, Any]) -> tuple[ModelSpec, TrainCo
         name=str(params.get("Optimizer", params.get("Propagation", "adadelta"))).lower(),
         learning_rate=lr,
         accumulate_steps=int(params.get("AccumulateSteps", 1)),
+        schedule=str(params.get("LearningRateSchedule", "constant")).lower(),
+        warmup_steps=int(params.get("WarmupSteps", 0)),
+        decay_steps=int(params.get("DecaySteps", 0)),
+        decay_rate=float(params.get("DecayRate", 0.96)),
+        end_lr_factor=float(params.get("EndLearningRateFactor", 0.0)),
     )
     # Shifu Propagation codes (Q=quick/adadelta-era encog codes) all map to the
     # reference backend's single behavior: Adadelta (ssgd_monitor.py:140).
     if optimizer.name in ("q", "b", "r", "quick", "back", "resilient"):
-        optimizer = OptimizerConfig(name="adadelta", learning_rate=lr,
-                                    accumulate_steps=optimizer.accumulate_steps)
+        import dataclasses as _dc
+        optimizer = _dc.replace(optimizer, name="adadelta")
 
     # Shifu ModelConfigs conventionally carry Loss='squared' (which the
     # reference ignored, always using weighted MSE — ssgd_monitor.py:129) or
@@ -257,6 +255,8 @@ def parse_model_config(model_config: dict[str, Any]) -> tuple[ModelSpec, TrainCo
         loss=loss_name,
         optimizer=optimizer,
         bagging_sample_rate=float(train.get("baggingSampleRate", 1.0)),
+        early_stop_patience=int(params.get("EarlyStopPatience", 0)),
+        early_stop_min_delta=float(params.get("EarlyStopMinDelta", 0.0)),
     )
     train_config.validate()
     model_spec.validate()

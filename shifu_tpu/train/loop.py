@@ -337,6 +337,15 @@ def train(job: JobConfig,
     timing_on = bool(os.environ.get("SHIFU_TPU_TIMING")) or job.train.log_every_steps > 0
 
     history: list[EpochMetrics] = []
+    # early stopping (TrainConfig.early_stop_patience): best valid error seen
+    # and evaluated epochs since it improved by at least min_delta.  Counters
+    # reset on resume — patience then applies to the remaining epochs.  The
+    # best epoch's params are snapshotted to host (device buffers may be
+    # donated by the next step) and restored at the end, so the returned /
+    # exported model is the best one measured, not the last.
+    best_valid = float("inf")
+    evals_since_best = 0
+    best_params_host = None
     try:
       for epoch in range(start_epoch, job.train.epochs):
         t0 = time.perf_counter()
@@ -441,6 +450,34 @@ def train(job: JobConfig,
 
         if epoch_callback is not None:
             epoch_callback(m)
+
+        patience = job.train.early_stop_patience
+        if patience > 0 and valid_error == valid_error:  # evaluated, not NaN
+            if valid_error < best_valid - job.train.early_stop_min_delta:
+                best_valid = valid_error
+                evals_since_best = 0
+                best_params_host = jax.device_get(state.params)
+            else:
+                evals_since_best += 1
+                if evals_since_best >= patience:
+                    console(f"Early stop at epoch {epoch}: no valid_error "
+                            f"improvement > {job.train.early_stop_min_delta:g} "
+                            f"in {patience} evaluated epochs "
+                            f"(best {best_valid:.6f})")
+                    # the break below skips the loop's end-of-training save;
+                    # persist the stopping state so resume/export never fall
+                    # back to an older checkpoint
+                    if manager is not None:
+                        ckpt_lib.save(manager,
+                                      int(jax.device_get(state.step)), state,
+                                      extra={"epoch": epoch + 1}, block=True)
+                    break
+      if best_params_host is not None and best_valid < float("inf"):
+        # restore the best-measured params (same shardings as the current
+        # state's leaves) for the returned / exported model
+        state = state.replace(params=jax.tree_util.tree_map(
+            lambda host, cur: jax.device_put(host, cur.sharding),
+            best_params_host, state.params))
     finally:
       if manager is not None:
         # async saves must be durable (and their errors surfaced) no matter

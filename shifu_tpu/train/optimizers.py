@@ -21,9 +21,27 @@ _TF_ADADELTA_RHO = 0.95
 _TF_ADADELTA_EPS = 1e-8
 
 
+def _learning_rate(cfg: OptimizerConfig):
+    """The LR or optax schedule per OptimizerConfig.schedule (counted in
+    optimizer steps; the reference only ever had a constant LR)."""
+    lr = cfg.learning_rate
+    if cfg.schedule == "constant":
+        return lr
+    if cfg.schedule == "cosine":
+        return optax.cosine_decay_schedule(lr, cfg.decay_steps,
+                                           alpha=cfg.end_lr_factor)
+    if cfg.schedule == "exponential":
+        return optax.exponential_decay(lr, cfg.decay_steps, cfg.decay_rate)
+    if cfg.schedule == "warmup_cosine":
+        return optax.warmup_cosine_decay_schedule(
+            0.0, lr, cfg.warmup_steps, cfg.decay_steps,
+            end_value=lr * cfg.end_lr_factor)
+    raise ConfigError(f"unknown schedule {cfg.schedule!r}")
+
+
 def build_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     name = cfg.name.lower()
-    lr = cfg.learning_rate
+    lr = _learning_rate(cfg)
     if name == "adadelta":
         tx = optax.adadelta(learning_rate=lr, rho=_TF_ADADELTA_RHO, eps=_TF_ADADELTA_EPS)
     elif name == "adam":

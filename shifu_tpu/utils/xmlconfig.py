@@ -15,6 +15,14 @@ from __future__ import annotations
 import xml.etree.ElementTree as ET
 from typing import Any, Mapping, Optional
 
+
+def parse_bool(value: Any) -> bool:
+    """Config bools arrive string-typed from XML and Shifu JSON params:
+    'false'/'0'/'no' must read as False (bool('false') would be True)."""
+    if isinstance(value, str):
+        return value.strip().lower() in ("true", "1", "yes")
+    return bool(value)
+
 # reference key namespace (GlobalConfigurationKeys.java)
 KEY_EPOCHS = "shifu.application.epochs"
 KEY_TIMEOUT = "shifu.application.timeout"
@@ -122,13 +130,11 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
     if KEY_DATA_OUT_OF_CORE in conf:
         import dataclasses
         data = dataclasses.replace(
-            data, out_of_core=conf[KEY_DATA_OUT_OF_CORE].strip().lower()
-            in ("true", "1", "yes"))
+            data, out_of_core=parse_bool(conf[KEY_DATA_OUT_OF_CORE]))
     if KEY_DATA_STAGED in conf:
         import dataclasses
         data = dataclasses.replace(
-            data, staged=conf[KEY_DATA_STAGED].strip().lower()
-            in ("true", "1", "yes"))
+            data, staged=parse_bool(conf[KEY_DATA_STAGED]))
     if KEY_DATA_READ_THREADS in conf:
         import dataclasses
         data = dataclasses.replace(

@@ -176,7 +176,7 @@ def test_remat_with_dropout_initializes():
 
 
 def test_shifu_remat_string_values():
-    from shifu_tpu.config.shifu_compat import _parse_bool
-    assert _parse_bool("true") and _parse_bool("1") and _parse_bool(True)
-    assert not _parse_bool("false") and not _parse_bool("0")
-    assert not _parse_bool("no") and not _parse_bool(False)
+    from shifu_tpu.utils.xmlconfig import parse_bool
+    assert parse_bool("true") and parse_bool("1") and parse_bool(True)
+    assert not parse_bool("false") and not parse_bool("0")
+    assert not parse_bool("no") and not parse_bool(False)

@@ -103,3 +103,76 @@ def test_input_tiers_equivalent(small_job, small_data):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
     assert m_batch.valid_auc == pytest.approx(m_staged.valid_auc, abs=1e-6)
     assert m_batch.valid_auc == pytest.approx(m_res.valid_auc, abs=1e-6)
+
+
+def test_lr_schedules_build_and_train(small_job, small_data):
+    """Each schedule builds a valid optax transform and still learns; the
+    schedule's LR actually changes over steps (cosine end < start)."""
+    import dataclasses
+
+    import optax
+
+    from shifu_tpu.config import ConfigError, OptimizerConfig
+    from shifu_tpu.train.optimizers import _learning_rate
+
+    sched = _learning_rate(OptimizerConfig(
+        name="adam", learning_rate=0.01, schedule="cosine", decay_steps=100))
+    assert float(sched(0)) == pytest.approx(0.01)
+    assert float(sched(100)) == pytest.approx(0.0, abs=1e-9)
+    warm = _learning_rate(OptimizerConfig(
+        name="adam", learning_rate=0.01, schedule="warmup_cosine",
+        warmup_steps=10, decay_steps=50))
+    assert float(warm(0)) == pytest.approx(0.0, abs=1e-9)
+    assert float(warm(10)) == pytest.approx(0.01, rel=1e-3)
+    with pytest.raises(ConfigError):
+        OptimizerConfig(schedule="cosine").validate()  # decay_steps missing
+
+    train_ds, valid_ds = small_data
+    opt = dataclasses.replace(small_job.train.optimizer, name="adam",
+                              learning_rate=5e-3, schedule="warmup_cosine",
+                              warmup_steps=5, decay_steps=200)
+    job = small_job.replace(
+        train=dataclasses.replace(small_job.train, optimizer=opt))
+    result = train(job, train_ds, valid_ds, console=lambda s: None)
+    assert result.history[-1].valid_auc > 0.6
+
+
+def test_early_stopping(small_job, small_data):
+    """With patience=1 and an un-improvable run (lr ~ 0), training stops
+    after the second evaluated epoch instead of running all epochs."""
+    import dataclasses
+
+    train_ds, valid_ds = small_data
+    opt = dataclasses.replace(small_job.train.optimizer, learning_rate=1e-12)
+    job = small_job.replace(train=dataclasses.replace(
+        small_job.train, epochs=8, optimizer=opt, early_stop_patience=1))
+    lines = []
+    result = train(job, train_ds, valid_ds, console=lines.append)
+    assert len(result.history) < 8
+    assert any("Early stop" in l for l in lines)
+
+
+def test_early_stop_restores_best_params(small_job, small_data):
+    """With patience set, the returned state carries the best-measured
+    params, not the last epoch's (re-evaluating it reproduces the best
+    valid_error in the history)."""
+    import dataclasses
+
+    from shifu_tpu.train import evaluate, make_eval_step
+
+    train_ds, valid_ds = small_data
+    opt = dataclasses.replace(small_job.train.optimizer, name="sgd",
+                              learning_rate=50.0)  # drives the model to bounce
+    job = small_job.replace(train=dataclasses.replace(
+        small_job.train, epochs=6, optimizer=opt, early_stop_patience=2))
+    result = train(job, train_ds, valid_ds, console=lambda s: None)
+    err, _ = evaluate(result.state, valid_ds, job, make_eval_step(job))
+    best = min(m.valid_error for m in result.history)
+    assert err == pytest.approx(best, rel=1e-5)
+
+
+def test_warmup_cosine_validation():
+    from shifu_tpu.config import ConfigError, OptimizerConfig
+    with pytest.raises(ConfigError, match="warmup_cosine"):
+        OptimizerConfig(schedule="warmup_cosine", warmup_steps=100,
+                        decay_steps=50).validate()
