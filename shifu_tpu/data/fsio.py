@@ -106,6 +106,13 @@ def file_info(path: str) -> Tuple[Optional[int], Optional[int]]:
     return size, mtime_ns
 
 
+def open_input_file(path: str):
+    """A seekable pyarrow input file for a remote URI (parquet readers need
+    random access, unlike the streaming read_bytes path)."""
+    filesystem, fs_path = _filesystem(path)
+    return filesystem.open_input_file(fs_path)
+
+
 def read_bytes(path: str) -> bytes:
     """Fetch a remote file's raw bytes (gzip detection happens downstream)."""
     filesystem, fs_path = _filesystem(path)  # guards the pyarrow import

@@ -132,11 +132,52 @@ def _parse_bytes(raw: bytes, delimiter: str,
     return parse_rows(raw, delimiter)
 
 
+_PARQUET_EXTS = (".parquet", ".pq")
+
+
+def is_parquet(path: str) -> bool:
+    return path.lower().endswith(_PARQUET_EXTS)
+
+
+def _parquet_source(path: str):
+    """Local path, or a seekable pyarrow file for a remote URI (parquet
+    readers need random access)."""
+    from . import fsio
+    return fsio.open_input_file(path) if fsio.is_remote(path) else path
+
+
+def _read_parquet(path: str) -> np.ndarray:
+    """One parquet file -> the same (N, C) float32 matrix the psv parsers
+    produce.  Column positions (file order) take the place of the psv column
+    indices ColumnConfig refers to, so a parquet export of the normalized
+    table drops in without schema changes; lookups are positional throughout
+    (duplicate field names are legal in the format).  Non-numeric columns
+    are a config/data error, reported by name and position."""
+    import pyarrow.parquet as pq
+
+    table = pq.ParquetFile(_parquet_source(path)).read()
+    cols = []
+    for i in range(table.num_columns):
+        arr = table.column(i).to_numpy(zero_copy_only=False)
+        try:
+            cols.append(np.asarray(arr, dtype=np.float32))
+        except (ValueError, TypeError) as e:
+            field = table.schema.field(i)
+            raise ValueError(
+                f"{path}: parquet column {i} ({field.name!r}) is not "
+                f"numeric (dtype {field.type}); normalized training data "
+                "must be numeric") from e
+    if not cols:
+        return np.zeros((0, 0), dtype=np.float32)
+    return np.ascontiguousarray(np.column_stack(cols))
+
+
 def read_file(path: str, delimiter: str = "|",
               parser_threads: Optional[int] = None) -> np.ndarray:
-    """Read one (possibly gzipped) pipe-delimited file into (N, C) float32.
+    """Read one data file into (N, C) float32: gzip/plain pipe-delimited
+    text, or parquet (by .parquet/.pq extension).
 
-    Uses the native C++ parser (zlib + from_chars, multi-threaded —
+    Text uses the native C++ parser (zlib + from_chars, multi-threaded —
     data/native_parser.py) when buildable; the vectorized numpy path above is
     the fallback.  Both produce identical arrays (tested).  hdfs:// gs://
     s3:// file:// URIs fetch through pyarrow.fs (data/fsio.py) and parse with
@@ -144,6 +185,8 @@ def read_file(path: str, delimiter: str = "|",
     level threading passes 1 so parallelism stays ~cores, not cores^2).
     """
     from . import fsio, native_parser
+    if is_parquet(path):
+        return _read_parquet(path)
     if fsio.is_remote(path):
         return _parse_bytes(_fetch_decompressed(path), delimiter,
                             parser_threads)
@@ -206,6 +249,10 @@ def count_rows(paths: Sequence[str]) -> int:
     use_native = native_parser.available()
     total = 0
     for p in paths:
+        if is_parquet(p):
+            import pyarrow.parquet as pq
+            total += pq.ParquetFile(_parquet_source(p)).metadata.num_rows
+            continue
         if fsio.is_remote(p):
             total += fsio.count_data_lines(p)  # streaming, constant memory
             continue

@@ -9,6 +9,8 @@ import os
 import numpy as np
 import pytest
 
+from shifu_tpu.data import reader
+
 from shifu_tpu.data import (
     batch_iterator,
     load_datasets,
@@ -143,3 +145,63 @@ def test_load_datasets_duplicate_paths_distinct_ids(tmp_path):
     # duplicate files get distinct row-id bases, so the two copies split
     # independently (same mask would give exactly 2x one copy's counts)
     assert train.num_rows + valid.num_rows == 200
+
+
+def _write_parquet(matrix, path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    table = pa.table({f"col_{i}": matrix[:, i] for i in range(matrix.shape[1])})
+    pq.write_table(table, path)
+
+
+def test_parquet_reader_matches_psv(tmp_path):
+    """A parquet export of the normalized table parses to the exact matrix
+    the psv tiers produce (column positions = psv column indices)."""
+    schema = synthetic.make_schema(num_features=6)
+    rows = synthetic.make_rows(300, schema, seed=7)
+    psv_paths = synthetic.write_files(rows, str(tmp_path / "psv"), num_files=1)
+    want = reader.read_file(psv_paths[0])
+    pq_path = str(tmp_path / "part-0.parquet")
+    _write_parquet(want, pq_path)
+
+    got = reader.read_file(pq_path)
+    np.testing.assert_array_equal(got, want)
+    assert reader.count_rows([pq_path]) == 300  # metadata only, no full read
+
+
+def test_parquet_load_datasets_and_split(tmp_path):
+    """Parquet files drive the full dataset path (projection, hash split)
+    identically to psv files holding the same rows."""
+    schema = synthetic.make_schema(num_features=8)
+    rows = synthetic.make_rows(500, schema, seed=8)
+    psv_dir = str(tmp_path / "psv")
+    psv_paths = synthetic.write_files(rows, psv_dir, num_files=2)
+    pq_dir = tmp_path / "pq"
+    pq_dir.mkdir()
+    for i, p in enumerate(psv_paths):
+        _write_parquet(reader.read_file(p), str(pq_dir / f"part-{i}.parquet"))
+
+    cfg = DataConfig(paths=(str(pq_dir),), valid_ratio=0.2, split_seed=3)
+    train, valid = load_datasets(schema, cfg)
+    assert train.num_rows + valid.num_rows == 500
+    assert train.num_features == 8
+
+
+def test_parquet_non_numeric_column_reports_name(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    table = pa.table({"a": [1.0, 2.0], "city": ["sf", "nyc"]})
+    path = str(tmp_path / "bad.parquet")
+    pq.write_table(table, path)
+    with pytest.raises(ValueError, match="city"):
+        reader.read_file(path)
+
+
+def test_parquet_duplicate_column_names_read_positionally(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    m = np.arange(8, dtype=np.float32).reshape(4, 2)
+    table = pa.table([pa.array(m[:, 0]), pa.array(m[:, 1])], names=["x", "x"])
+    path = str(tmp_path / "dup.parquet")
+    pq.write_table(table, path)
+    np.testing.assert_array_equal(reader.read_file(path), m)
