@@ -25,8 +25,10 @@ Kernel design (TPU-first):
 CPU/testing: like ops/pallas_embedding.py, the kernels run `interpret=True`
 off-TPU so the same code path is unit-tested on the CPU backend
 (tests/test_pallas_attention.py validates forward and gradients against the
-XLA reference ops/attention.mha).  On the tunneled TPU dev platform Pallas
-cannot compile (hangs at lowering), so TPU execution is opt-in via
+XLA reference ops/attention.mha).  On real TPU hardware all three kernels
+(forward, dq, dk/dv) compile and match `mha` including the padded
+odd-length path; the tiling-sensitive parts are the rank-4 lse/D residuals
+(singleton minor dim — see _fwd_kernel).  TPU execution stays opt-in via
 SHIFU_TPU_PALLAS=1; `flash_attention` otherwise routes to `mha`.
 """
 
@@ -87,15 +89,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale: float,
     o, m, l = jax.lax.fori_loop(0, nk, step, (o0, m0, l0))
     l = jnp.maximum(l, 1e-30)  # fully-padded query rows (sliced off later)
     o_ref[0, 0] = (o / l).astype(o_ref.dtype)
-    l_ref[0, 0] = (m + jnp.log(l))[:, 0]                      # log-sum-exp
+    # log-sum-exp residual, kept (Bq, 1): the trailing singleton lets the
+    # block equal the array's minor dim, which Mosaic's (8, 128) tiling rule
+    # accepts where a rank-3 (1, 1, Bq) block would not lower on real TPUs
+    l_ref[0, 0] = m + jnp.log(l)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dres_ref, dq_ref, *,
                scale: float, s_real: int, block_k: int):
     qf = q_ref[0, 0].astype(jnp.float32)                      # (Bq, D)
     dof = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, None]                              # (Bq, 1)
-    dres = dres_ref[0, 0][:, None]
+    lse = lse_ref[0, 0]                                       # (Bq, 1)
+    dres = dres_ref[0, 0]
     bq, d = qf.shape
     nk = k_ref.shape[2] // block_k
 
@@ -134,8 +139,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dres_ref,
         dk, dv = carry
         qf = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         dof = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
-        dres = dres_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), :]   # (Bq, 1)
+        dres = dres_ref[0, 0, pl.ds(i * block_q, block_q), :]
         s = jax.lax.dot_general(
             qf, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale       # (Bq, Bk)
@@ -177,14 +182,16 @@ def _flash_fwd_impl(q, k, v, scale, interpret, block_q, block_k):
 
     qspec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0))
     kvspec = pl.BlockSpec((1, 1, s_pad, d), lambda b_, h_, i: (b_, h_, 0, 0))
+    # lse rides as (B, H, S, 1): the singleton minor dim keeps every block's
+    # last-two-dims legal under Mosaic's tiling rule (see _fwd_kernel)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, s_real=s, block_k=bk),
         grid=(b, h, s_pad // bq),
         in_specs=[qspec, kvspec, kvspec],
         out_specs=[qspec,
-                   pl.BlockSpec((1, 1, bq), lambda b_, h_, i: (b_, h_, i))],
+                   pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i: (b_, h_, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((b, h, s_pad, d), q.dtype),
-                   jax.ShapeDtypeStruct((b, h, s_pad), jnp.float32)],
+                   jax.ShapeDtypeStruct((b, h, s_pad, 1), jnp.float32)],
         interpret=interpret,
     )(qp, kp, vp)
     return out[:, :, :s, :], lse
@@ -195,14 +202,16 @@ def _flash_bwd_impl(q, k, v, out, lse, g, scale, interpret, block_q, block_k):
     bq, bk, s_pad = _plan(s, block_q, block_k)
     qp, kp, vp, op, gp = (_pad_seq(x, s_pad) for x in (q, k, v, out, g))
     lsep = (lse if lse.shape[2] == s_pad else
-            jnp.pad(lse, ((0, 0), (0, 0), (0, s_pad - s))))
-    # D_i = rowsum(dO_i * O_i): elementwise, XLA fuses it; zero on padded rows
-    dres = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32), axis=-1)
+            jnp.pad(lse, ((0, 0), (0, 0), (0, s_pad - s), (0, 0))))
+    # D_i = rowsum(dO_i * O_i): elementwise, XLA fuses it; zero on padded
+    # rows; kept (B, H, S, 1) like the lse (tiling-legal singleton minor dim)
+    dres = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32), axis=-1,
+                   keepdims=True)
 
     full = pl.BlockSpec((1, 1, s_pad, d), lambda b_, h_, i: (b_, h_, 0, 0))
-    fullv = pl.BlockSpec((1, 1, s_pad), lambda b_, h_, i: (b_, h_, 0))
+    fullv = pl.BlockSpec((1, 1, s_pad, 1), lambda b_, h_, i: (b_, h_, 0, 0))
     qspec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0))
-    qvec = pl.BlockSpec((1, 1, bq), lambda b_, h_, i: (b_, h_, i))
+    qvec = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i: (b_, h_, i, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, s_real=s, block_k=bk),
         grid=(b, h, s_pad // bq),

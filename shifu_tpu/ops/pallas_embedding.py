@@ -14,7 +14,10 @@ is a pure VMEM copy of one (1, 1, D) row.  The backward pass is a scatter-add
 bandwidth-bound and XLA's implementation is already optimal for it.
 
 CPU/testing: falls back to `interpret=True` off-TPU so the same code path is
-unit-tested on the virtual CPU mesh.
+unit-tested on the virtual CPU mesh.  On real TPU hardware the kernel is
+validated exact vs the XLA gather for 128-lane-aligned embedding dims; for
+smaller dims (tabular default D=16) Mosaic's DMA tiling cannot slice a
+single row, so the XLA gather serves (see _forward).
 """
 
 from __future__ import annotations
@@ -93,8 +96,11 @@ def embedding_lookup(table: jax.Array, ids: jax.Array,
                      use_pallas: Optional[bool] = None) -> jax.Array:
     """(Nc, V, D) table, (B, Nc) int32 ids -> (B, Nc, D).
 
-    use_pallas: None = auto (pallas on TPU, XLA elsewhere); True forces the
-    kernel (interpret mode off-TPU); False forces the XLA gather.
+    use_pallas: None = auto (SHIFU_TPU_PALLAS=1 opt-in); True selects the
+    kernel (interpret mode off-TPU); False forces the XLA gather.  On real
+    TPU hardware the kernel additionally requires D % 128 == 0 (Mosaic DMA
+    tiling cannot slice a narrower HBM row) — other D fall back to the XLA
+    gather even with use_pallas=True.
     """
     return _forward(table, ids, use_pallas)
 
@@ -104,12 +110,16 @@ def _forward(table, ids, use_pallas):
 
     on_tpu = jax.default_backend() == "tpu"
     if use_pallas is None:
-        # Opt-in (SHIFU_TPU_PALLAS=1): the kernel is validated in interpret
-        # mode on CPU, but the tunneled TPU platform this framework is
-        # developed against cannot compile Pallas kernels (hangs at lowering),
-        # so native-TPU validation is deferred to real-slice runs.
+        # Opt-in (SHIFU_TPU_PALLAS=1); validated in interpret mode on CPU
+        # and on a real v5e chip (exact vs the XLA gather).
         use_pallas = pallas_opt_in() and pltpu is not None
     if use_pallas and pltpu is not None:
+        if on_tpu and table.shape[-1] % 128 != 0:
+            # Mosaic DMA tiling: an HBM row slice needs its minor dim
+            # 128-lane aligned, so sub-128 embedding dims (the tabular
+            # default D=16) cannot use the per-row DMA design — the XLA
+            # gather serves those; the kernel pays off for D >= 128 tables.
+            return _xla_lookup(table, ids.astype(jnp.int32))
         return _pallas_lookup(table, ids.astype(jnp.int32), interpret=not on_tpu)
     return _xla_lookup(table, ids.astype(jnp.int32))
 
