@@ -5,18 +5,20 @@ and the long-context path: the reference has no attention at all (SURVEY.md
 section 5.7), so this is a new TPU-native capability, not a port.
 
 Kernel design (TPU-first):
-- Forward: grid (B, H, S/Bq).  Each grid step holds one (Bq, D) query block
-  in VMEM and streams (Bk, D) key/value blocks from the per-(b,h) K/V VMEM
-  block, accumulating a numerically-stable streaming softmax (running max m,
-  normalizer l) in float32.  The (S, S) score matrix never materializes —
-  O(S) memory per head, scores tile onto the MXU as (Bq, Bk) matmuls.
-  The log-sum-exp L = m + log(l) is written as a second output (residual for
-  the backward pass, flash-attention style).
-- Backward: the canonical two-kernel flash backward.  `dq` kernel re-walks
-  K/V blocks per query block; `dk`/`dv` kernel re-walks query blocks per K/V
-  block; both recompute p = exp(s - L) from the saved log-sum-exp instead of
-  storing probabilities.  D = rowsum(dO * O) is a cheap elementwise XLA op
-  computed outside the kernels.
+- Forward: grid (B, H, S/Bq, S/Bk) with the K/V block index innermost.  Each
+  grid step holds ONE (Bq, D) query block and ONE (Bk, D) key/value block in
+  VMEM — O(block) VMEM at any sequence length — and advances a numerically-
+  stable streaming softmax (running max m, normalizer l, unnormalized o) in
+  float32 VMEM scratch across the K/V steps.  The (S, S) score matrix never
+  materializes; scores tile onto the MXU as (Bq, Bk) matmuls.  The last K/V
+  step normalizes in-kernel and writes the output block once in the input
+  dtype, plus the log-sum-exp L = m + log(l) residual for the backward pass
+  (flash-attention style).
+- Backward: the canonical two-kernel flash backward with the same blocked
+  grids.  `dq` kernel streams K/V blocks per query block; `dk`/`dv` kernel
+  streams query blocks per K/V block; both recompute p = exp(s - L) from the
+  saved log-sum-exp instead of storing probabilities.  D = rowsum(dO * O) is
+  a cheap elementwise XLA op computed outside the kernels.
 - Sequence lengths that are not multiples of the block size are zero-padded
   by the wrapper; padded key columns are masked to -1e30 before the softmax
   (exact zeros after exp), padded query rows are sliced off the outputs and
@@ -43,7 +45,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from .attention import mha
-from .pallas_common import pallas_opt_in
+from .pallas_common import pallas_opt_in, pltpu
 
 _NEG_BIG = -1e30  # -inf would make fully-masked rows produce NaN (exp(inf-inf))
 
@@ -55,113 +57,131 @@ def _pad_seq(x: jax.Array, s_pad: int) -> jax.Array:
     return jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - s), (0, 0)))
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale: float,
-                s_real: int, block_k: int):
-    """One (Bq, D) query block vs all key blocks of this (b, h)."""
-    qf = q_ref[0, 0].astype(jnp.float32)                     # (Bq, D)
-    bq, d = qf.shape
-    s_pad = k_ref.shape[2]
-    nk = s_pad // block_k
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc, l_acc, *,
+                scale: float, s_real: int, block_k: int, nk: int):
+    """One (Bq, Bk) tile: the K/V block index is the INNERMOST grid dim, so
+    VMEM holds only one query block and one key/value block at a time —
+    O(block) VMEM regardless of S (the whole-K/V-in-VMEM variant ran out of
+    scoped vmem at S=32k on a v5e).  The streaming-softmax state (running
+    max m, normalizer l, unnormalized o) lives in float32 VMEM scratch
+    across the K/V steps; the last step normalizes and writes the output
+    block ONCE in the output dtype (no post-pass over a float32 HBM copy)."""
+    j = pl.program_id(3)
 
-    def step(j, carry):
-        o, m, l = carry
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            qf, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale       # (Bq, Bk)
-        col = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, block_k), 1)
-        s = jnp.where(col < s_real, s, _NEG_BIG)
-        blk_max = jnp.max(s, axis=-1, keepdims=True)          # (Bq, 1)
-        new_m = jnp.maximum(m, blk_max)
-        corr = jnp.exp(m - new_m)
-        p = jnp.exp(s - new_m)                                # (Bq, Bk)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        o = o * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return o, new_m, l
+    @pl.when(j == 0)
+    def _init():
+        o_acc[...] = jnp.zeros_like(o_acc)
+        m_acc[...] = jnp.full_like(m_acc, _NEG_BIG)
+        l_acc[...] = jnp.zeros_like(l_acc)
 
-    o0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq, 1), _NEG_BIG, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    o, m, l = jax.lax.fori_loop(0, nk, step, (o0, m0, l0))
-    l = jnp.maximum(l, 1e-30)  # fully-padded query rows (sliced off later)
-    o_ref[0, 0] = (o / l).astype(o_ref.dtype)
-    # log-sum-exp residual, kept (Bq, 1): the trailing singleton lets the
-    # block equal the array's minor dim, which Mosaic's (8, 128) tiling rule
-    # accepts where a rank-3 (1, 1, Bq) block would not lower on real TPUs
-    l_ref[0, 0] = m + jnp.log(l)
+    qf = q_ref[0, 0].astype(jnp.float32)                      # (Bq, D)
+    bq = qf.shape[0]
+    k_blk = k_ref[0, 0].astype(jnp.float32)                   # (Bk, D)
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        qf, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale           # (Bq, Bk)
+    col = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, block_k), 1)
+    s = jnp.where(col < s_real, s, _NEG_BIG)
+    m = m_acc[...]                                            # (Bq, 1)
+    blk_max = jnp.max(s, axis=-1, keepdims=True)
+    new_m = jnp.maximum(m, blk_max)
+    corr = jnp.exp(m - new_m)
+    p = jnp.exp(s - new_m)                                    # (Bq, Bk)
+    m_acc[...] = new_m
+    l_acc[...] = l_acc[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    o_acc[...] = o_acc[...] * corr + jax.lax.dot_general(
+        p, v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_acc[...], 1e-30)  # fully-padded rows (sliced off)
+        o_ref[0, 0] = (o_acc[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_acc[...] + jnp.log(l)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dres_ref, dq_ref, *,
-               scale: float, s_real: int, block_k: int):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dres_ref, dq_ref,
+               dq_acc, *, scale: float, s_real: int, block_k: int, nk: int):
+    """dq accumulation: grid (B, H, nq, nk), K/V block innermost; dq
+    accumulates in float32 VMEM scratch, written (pre-scaled) once at the
+    last K/V step."""
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
     qf = q_ref[0, 0].astype(jnp.float32)                      # (Bq, D)
     dof = do_ref[0, 0].astype(jnp.float32)
     lse = lse_ref[0, 0]                                       # (Bq, 1)
     dres = dres_ref[0, 0]
-    bq, d = qf.shape
-    nk = k_ref.shape[2] // block_k
+    bq = qf.shape[0]
+    k_blk = k_ref[0, 0].astype(jnp.float32)                   # (Bk, D)
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        qf, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    col = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, block_k), 1)
+    s = jnp.where(col < s_real, s, _NEG_BIG)
+    p = jnp.exp(s - lse)                                      # (Bq, Bk)
+    dp = jax.lax.dot_general(
+        dof, v_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                   # (Bq, Bk)
+    ds = p * (dp - dres)
+    dq_acc[...] = dq_acc[...] + jax.lax.dot_general(
+        ds, k_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
-    def step(j, dq):
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            qf, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        col = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, block_k), 1)
-        s = jnp.where(col < s_real, s, _NEG_BIG)
-        p = jnp.exp(s - lse)                                  # (Bq, Bk)
-        dp = jax.lax.dot_general(
-            dof, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)               # (Bq, Bk)
-        ds = p * (dp - dres)
-        return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    dq = jax.lax.fori_loop(0, nk, step, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dres_ref,
-                dk_ref, dv_ref, *, scale: float, s_real: int, block_q: int):
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                s_real: int, nq: int):
+    """dk/dv accumulation: grid (B, H, nk, nq), query block innermost; dk/dv
+    accumulate in float32 VMEM scratch, written once at the last query step
+    (dk pre-scaled)."""
+    i = pl.program_id(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
     k_blk = k_ref[0, 0].astype(jnp.float32)                   # (Bk, D)
     v_blk = v_ref[0, 0].astype(jnp.float32)
-    bk, d = k_blk.shape
+    bk = k_blk.shape[0]
     j = pl.program_id(2)
     col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)  # (1, Bk)
-    nq = q_ref.shape[2] // block_q
+    qf = q_ref[0, 0].astype(jnp.float32)                      # (Bq, D)
+    dof = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]                                       # (Bq, 1)
+    dres = dres_ref[0, 0]
+    s = jax.lax.dot_general(
+        qf, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale           # (Bq, Bk)
+    s = jnp.where(col < s_real, s, _NEG_BIG)
+    p = jnp.exp(s - lse)
+    dv_acc[...] = dv_acc[...] + jax.lax.dot_general(          # p^T @ dO
+        p, dof, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(
+        dof, v_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - dres)
+    dk_acc[...] = dk_acc[...] + jax.lax.dot_general(          # ds^T @ q
+        ds, qf, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
-    def step(i, carry):
-        dk, dv = carry
-        qf = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        dof = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), :]   # (Bq, 1)
-        dres = dres_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        s = jax.lax.dot_general(
-            qf, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale       # (Bq, Bk)
-        s = jnp.where(col < s_real, s, _NEG_BIG)
-        p = jnp.exp(s - lse)
-        dv = dv + jax.lax.dot_general(                        # p^T @ dO
-            p, dof, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            dof, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - dres)
-        dk = dk + jax.lax.dot_general(                        # ds^T @ q
-            ds, qf, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return dk, dv
-
-    z = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, nq, step, (z, z))
-    dk_ref[0, 0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _plan(s: int, block_q: int, block_k: int) -> tuple:
@@ -179,19 +199,26 @@ def _flash_fwd_impl(q, k, v, scale, interpret, block_q, block_k):
     b, h, s, d = q.shape
     bq, bk, s_pad = _plan(s, block_q, block_k)
     qp, kp, vp = (_pad_seq(x, s_pad) for x in (q, k, v))
+    nq, nk = s_pad // bq, s_pad // bk
 
-    qspec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0))
-    kvspec = pl.BlockSpec((1, 1, s_pad, d), lambda b_, h_, i: (b_, h_, 0, 0))
-    # lse rides as (B, H, S, 1): the singleton minor dim keeps every block's
-    # last-two-dims legal under Mosaic's tiling rule (see _fwd_kernel)
+    # grid (B, H, nq, nk): K/V blocks stream through the innermost dim, so
+    # VMEM holds one (bq, d) + one (bk, d) block at a time — O(block) VMEM
+    # at any S.  lse rides as (B, H, S, 1): the singleton minor dim keeps
+    # every block's last-two-dims legal under Mosaic's tiling rule.
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kvspec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    vec = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, s_real=s, block_k=bk),
-        grid=(b, h, s_pad // bq),
+        functools.partial(_fwd_kernel, scale=scale, s_real=s, block_k=bk,
+                          nk=nk),
+        grid=(b, h, nq, nk),
         in_specs=[qspec, kvspec, kvspec],
-        out_specs=[qspec,
-                   pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i: (b_, h_, i, 0))],
+        out_specs=[qspec, vec],
         out_shape=[jax.ShapeDtypeStruct((b, h, s_pad, d), q.dtype),
                    jax.ShapeDtypeStruct((b, h, s_pad, 1), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                        pltpu.VMEM((bq, 1), jnp.float32),
+                        pltpu.VMEM((bq, 1), jnp.float32)],
         interpret=interpret,
     )(qp, kp, vp)
     return out[:, :, :s, :], lse
@@ -208,27 +235,35 @@ def _flash_bwd_impl(q, k, v, out, lse, g, scale, interpret, block_q, block_k):
     dres = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32), axis=-1,
                    keepdims=True)
 
-    full = pl.BlockSpec((1, 1, s_pad, d), lambda b_, h_, i: (b_, h_, 0, 0))
-    fullv = pl.BlockSpec((1, 1, s_pad, 1), lambda b_, h_, i: (b_, h_, 0, 0))
-    qspec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0))
-    qvec = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i: (b_, h_, i, 0))
+    nq, nk = s_pad // bq, s_pad // bk
+    # dq: grid (B, H, nq, nk) — K/V blocks innermost (see _dq_kernel)
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kvspec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    qvec = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, s_real=s, block_k=bk),
-        grid=(b, h, s_pad // bq),
-        in_specs=[qspec, full, full, qspec, qvec, qvec],
+        functools.partial(_dq_kernel, scale=scale, s_real=s, block_k=bk,
+                          nk=nk),
+        grid=(b, h, nq, nk),
+        in_specs=[qspec, kvspec, kvspec, qspec, qvec, qvec],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((b, h, s_pad, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(qp, kp, vp, gp, lsep, dres)
 
-    kspec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j: (b_, h_, j, 0))
+    # dk/dv: grid (B, H, nk, nq) — query blocks innermost (see _dkv_kernel)
+    kspec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0))
+    qspec2 = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, j, i: (b_, h_, i, 0))
+    qvec2 = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, j, i: (b_, h_, i, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, s_real=s, block_q=bq),
-        grid=(b, h, s_pad // bk),
-        in_specs=[full, kspec, kspec, full, fullv, fullv],
+        functools.partial(_dkv_kernel, scale=scale, s_real=s, nq=nq),
+        grid=(b, h, nk, nq),
+        in_specs=[qspec2, kspec, kspec, qspec2, qvec2, qvec2],
         out_specs=[kspec, kspec],
         out_shape=[jax.ShapeDtypeStruct((b, h, s_pad, d), k.dtype),
                    jax.ShapeDtypeStruct((b, h, s_pad, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
     )(qp, kp, vp, gp, lsep, dres)
     return (dq[:, :, :s, :], dk[:, :, :s, :], dv[:, :, :s, :])
@@ -271,7 +306,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     on_tpu = jax.default_backend() == "tpu"
     if use_pallas is None:
-        use_pallas = pallas_opt_in()
+        # auto mode degrades gracefully when the tpu pallas ext is missing
+        use_pallas = pallas_opt_in() and pltpu is not None
+    if use_pallas and pltpu is None:
+        raise RuntimeError(
+            "flash_attention(use_pallas=True): jax.experimental.pallas.tpu "
+            "is unavailable on this install (VMEM scratch needs it); use "
+            "use_pallas=None/False to route to the XLA reference")
     if not use_pallas:
         return mha(q, k, v, scale=scale)
     return _flash(q, k, v, scale, not on_tpu, block_q, block_k)

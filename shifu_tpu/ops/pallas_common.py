@@ -1,12 +1,22 @@
-"""Shared gating for the opt-in Pallas kernels.
+"""Shared gating + imports for the opt-in Pallas kernels.
 
-The tunneled TPU dev platform cannot compile Pallas (hangs at lowering), so
-kernels default OFF and engage only when SHIFU_TPU_PALLAS is set truthy.
+Kernels default OFF and engage when SHIFU_TPU_PALLAS is set truthy (they are
+validated in interpret mode on CPU and against the XLA references on a real
+v5e chip; see pallas_attention.py / pallas_embedding.py for their
+hardware-specific constraints).
 """
 
 from __future__ import annotations
 
 import os
+
+try:  # TPU-specific pallas namespace (VMEM scratch, DMA); absent on some
+    # CPU-only installs — kernels that need it must check for None
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["pallas_opt_in", "pltpu"]
 
 
 def pallas_opt_in() -> bool:

@@ -29,10 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # TPU-specific pallas namespace; absent on some CPU-only installs
-    from jax.experimental.pallas import tpu as pltpu
-except Exception:  # pragma: no cover
-    pltpu = None
+from .pallas_common import pltpu
 
 
 def _make_lookup_kernel(nc: int, rows_per_step: int):
