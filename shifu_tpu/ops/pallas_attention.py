@@ -292,15 +292,20 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     scale: Optional[float] = None,
                     use_pallas: Optional[bool] = None,
-                    block_q: int = 128, block_k: int = 128) -> jax.Array:
+                    block_q: int = 512, block_k: int = 512) -> jax.Array:
     """Blockwise flash attention.  q,k,v: (B, H, S, D) -> (B, H, S, D).
 
-    Same math as ops/attention.mha (float32 streaming softmax), O(S) memory
-    per head instead of O(S^2).  Differentiable (flash backward kernels).
+    Same math as ops/attention.mha (float32 streaming softmax), O(block)
+    memory per head instead of O(S^2).  Differentiable (flash backward
+    kernels).  Block sizes are clamped to S; the 512 defaults measured
+    ~2x faster than the fused XLA path at S=8k on a v5e (128-blocks were
+    grid-overhead-bound) while staying inside scoped VMEM for D <= 128 —
+    tune upward for small D / long S if VMEM allows.
 
     use_pallas: None = auto (SHIFU_TPU_PALLAS=1 opt-in, like
     ops/pallas_embedding.py); True forces the kernels (interpret mode
-    off-TPU); False routes to the XLA reference `mha`.
+    off-TPU; raises if the pallas tpu extension is absent); False routes to
+    the XLA reference `mha`.
     """
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
