@@ -63,7 +63,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("score", help="score rows with an exported artifact")
     s.add_argument("--model", required=True, help="artifact dir")
-    s.add_argument("--input", required=True, help="pipe-delimited rows file")
+    s.add_argument("--input", required=True, help="rows file (pipe-delimited or .parquet)")
     s.add_argument("--output", default="-", help="output file (- = stdout)")
     s.add_argument("--native", action="store_true", help="use the C++ engine")
     s.add_argument("--globalconfig", default=None,
