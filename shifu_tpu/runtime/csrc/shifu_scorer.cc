@@ -22,6 +22,8 @@
 //   shifu_scorer_load / _free / _num_features / _num_heads /
 //   shifu_scorer_compute_batch (float rows) / shifu_scorer_compute (double row)
 
+#include "shifu_scorer.h"  // public C ABI: mismatches fail at compile time
+
 #include <algorithm>
 #include <cmath>
 #include <cstdint>
