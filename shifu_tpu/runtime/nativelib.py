@@ -46,6 +46,17 @@ def _flags_tag(*flag_groups: Sequence[str]) -> str:
         f for g in flag_groups for f in g).encode()).hexdigest()[:8]
 
 
+def _source_mtime(src: str) -> float:
+    """Newest mtime among the source and sibling headers it may include —
+    a header-only edit must invalidate the cached artifact too."""
+    mtimes = [os.path.getmtime(src)]
+    src_dir = os.path.dirname(src)
+    for name in os.listdir(src_dir):
+        if name.endswith((".h", ".hpp")):
+            mtimes.append(os.path.getmtime(os.path.join(src_dir, name)))
+    return max(mtimes)
+
+
 def _compile_cached(
     src: str,
     out_path: str,
@@ -60,7 +71,7 @@ def _compile_cached(
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     with _lock:
         if (os.path.exists(out_path) and not force
-                and os.path.getmtime(out_path) >= os.path.getmtime(src)):
+                and os.path.getmtime(out_path) >= _source_mtime(src)):
             return out_path
         for flags in flag_variants:
             cmd = ["g++", *flags, "-o", out_path, src, *tail]

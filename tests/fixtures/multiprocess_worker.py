@@ -69,10 +69,40 @@ def main() -> None:
     loss = float(jax.device_get(metrics["loss"]))
     assert np.isfinite(loss), loss
 
+    # pipeline parallelism across the process boundary: mesh data=2 x
+    # pipe=2 over the same 4 devices — the GPipe ppermute activation hops
+    # (parallel/pipeline.py) ride gloo here, ICI/DCN on a real slice
+    pp_cfg = MeshConfig(data=2, pipe=2)
+    pp_schema = synthetic.make_schema(num_features=5, num_categorical=1,
+                                      vocab_size=8)
+    from shifu_tpu.config.schema import RuntimeConfig
+    pp_job = JobConfig(
+        schema=pp_schema,
+        data=DataConfig(batch_size=16),
+        model=ModelSpec(model_type="ft_transformer", hidden_nodes=(8,),
+                        activations=("relu",), token_dim=8,
+                        num_attention_heads=2, num_layers=2,
+                        pipeline_stages=2, compute_dtype="float32"),
+        train=TrainConfig(epochs=1, loss="weighted_mse",
+                          optimizer=OptimizerConfig(name="adadelta",
+                                                    learning_rate=0.01)),
+        runtime=RuntimeConfig(mesh=pp_cfg),
+    ).validate()
+    pp_mesh = make_mesh(pp_cfg, jax.devices())
+    pp_state = init_state(pp_job, pp_schema.feature_count, pp_mesh)
+    assert pp_state.params["blocks"]["qkv_kernel"].sharding.spec[0] == "pipe"
+    pp_rows = synthetic.make_rows(16, pp_schema, seed=1)
+    pp_batch = shard_batch(reader.project_columns(pp_rows, pp_schema), pp_mesh)
+    pp_step = make_train_step(pp_job, pp_mesh, donate=False)
+    _, pp_metrics = pp_step(pp_state, pp_batch)
+    pp_loss = float(jax.device_get(pp_metrics["loss"]))
+    assert np.isfinite(pp_loss), pp_loss
+
     distributed.barrier()
     print("RESULT " + json.dumps({
         "process": jax.process_index(),
         "loss": loss,
+        "pp_loss": pp_loss,
         "chief": distributed.is_chief(),
     }), flush=True)
 

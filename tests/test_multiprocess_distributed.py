@@ -79,6 +79,11 @@ def test_two_process_train_step_agrees():
     # the SPMD program is one program: both processes observe the same loss
     assert np.isfinite(results[0]["loss"])
     assert results[0]["loss"] == pytest.approx(results[1]["loss"], rel=1e-6)
+    # pipeline-parallel step (data=2 x pipe=2 spanning both processes):
+    # same-loss agreement proves the cross-process ppermute schedule
+    assert np.isfinite(results[0]["pp_loss"])
+    assert results[0]["pp_loss"] == pytest.approx(results[1]["pp_loss"],
+                                                  rel=1e-6)
     # chief election: exactly process 0
     assert results[0]["chief"] is True and results[1]["chief"] is False
 
