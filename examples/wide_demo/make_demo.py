@@ -1,0 +1,121 @@
+"""Generate the bundled wide-table demo: an FT-Transformer attending over a
+~120-token feature axis (BASELINE.md config #5, the stretch rung), wired
+through the unchanged Shifu train surface.
+
+Same artifact set as the other demos (Shifu-normalized gzip part files +
+ModelConfig/ColumnConfig JSON); ModelConfig params select the transformer
+family plus the TPU capabilities this rung showcases:
+
+  - `ModelType: ft_transformer`, `TokenDim`/`NumAttentionHeads`/
+    `NumTransformerLayers` — attention over the feature axis;
+  - `Remat: true` — block activations recompute in the backward pass
+    (O(1)-block activation memory for deep stacks);
+  - `AttentionImpl: flash` engages the Pallas O(block)-VMEM kernel when
+    SHIFU_TPU_PALLAS=1 (otherwise the fused XLA path serves);
+  - with `shifu.mesh.pipe > 1` + `PipelineStages`, the blocks split into
+    pipeline stages (docs/SCALING.md).
+
+Usage: python make_demo.py [--out DIR] [--rows N] [--epochs E]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(os.path.dirname(_HERE))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+NUM_FEATURES = 119  # +1 CLS = 120 attention tokens
+CAT_FEATURES = 16
+VOCAB = 64
+
+
+def write_demo(out_dir: str, rows: int = 4000, epochs: int = 8,
+               seed: int = 23) -> dict[str, str]:
+    from shifu_tpu.data import synthetic
+
+    os.makedirs(out_dir, exist_ok=True)
+    schema = synthetic.make_schema(num_features=NUM_FEATURES,
+                                   num_categorical=CAT_FEATURES,
+                                   vocab_size=VOCAB)
+    data_dir = os.path.join(out_dir, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    matrix = synthetic.make_rows(rows, schema, seed=seed, noise=0.4)
+    synthetic.write_files(matrix, data_dir, num_files=4)
+
+    model_config = {
+        "basic": {"name": "wide_demo", "author": "shifu_tpu",
+                  "version": "0.1.0"},
+        "dataSet": {"dataDelimiter": "|", "targetColumnName": "target"},
+        "normalize": {"normType": "ZSCALE"},
+        "train": {
+            "baggingSampleRate": 1.0,
+            "validSetRate": 0.2,
+            "numTrainEpochs": epochs,
+            "algorithm": "NN",
+            "params": {
+                "ModelType": "ft_transformer",
+                "NumHiddenLayers": 1,
+                "NumHiddenNodes": [32],
+                "ActivationFunc": ["ReLU"],
+                "TokenDim": 32,
+                "NumAttentionHeads": 4,
+                "NumTransformerLayers": 2,
+                "EmbeddingDim": 32,
+                "Remat": True,
+                # flash engages the Pallas kernel under SHIFU_TPU_PALLAS=1
+                # and routes to the fused XLA path otherwise
+                "AttentionImpl": "flash",
+                "LearningRate": 0.002,
+                "Optimizer": "adam",
+                "LearningRateSchedule": "warmup_cosine",
+                "WarmupSteps": 20,
+                "DecaySteps": 400,
+            },
+        },
+    }
+    mc_path = os.path.join(out_dir, "ModelConfig.json")
+    with open(mc_path, "w") as f:
+        json.dump(model_config, f, indent=2)
+
+    column_config = [{
+        "columnNum": 0, "columnName": "target", "columnFlag": "Target",
+        "columnType": "N", "finalSelect": False,
+    }]
+    for i in range(NUM_FEATURES):
+        is_cat = i >= NUM_FEATURES - CAT_FEATURES
+        entry = {
+            "columnNum": 1 + i, "columnName": f"f{i}",
+            "columnFlag": "FinalSelect",
+            "columnType": "C" if is_cat else "N",
+            "finalSelect": True,
+        }
+        if is_cat:
+            entry["columnBinning"] = {
+                "binCategory": [f"v{k}" for k in range(VOCAB - 1)]}
+        column_config.append(entry)
+    cc_path = os.path.join(out_dir, "ColumnConfig.json")
+    with open(cc_path, "w") as f:
+        json.dump(column_config, f, indent=2)
+
+    return {"data": data_dir, "modelconfig": mc_path, "columnconfig": cc_path}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default=os.path.join(_HERE, "generated"))
+    p.add_argument("--rows", type=int, default=4000)
+    p.add_argument("--epochs", type=int, default=8)
+    args = p.parse_args()
+    paths = write_demo(args.out, rows=args.rows, epochs=args.epochs)
+    print(json.dumps(paths, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# Wide-table demo (BASELINE config #5: FT-Transformer over the feature
+# axis, remat + LR schedule; flash/pipeline via env+config) — see ../_run_demo.sh
+exec "$(dirname "$0")/../_run_demo.sh" "$(dirname "$0")" "$@"

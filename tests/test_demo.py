@@ -29,6 +29,10 @@ DEMOS = [
     ("wdbc_demo", {}, 1200, 8, 7, 0.3, 0.8),
     ("ctr_demo", {"num_categorical": "CAT_FEATURES", "vocab_size": "VOCAB"},
      1500, 6, 11, 0.4, 0.6),
+    # config #5 stretch rung: FT-Transformer over the feature-token axis
+    # with remat + warmup-cosine schedule (examples/wide_demo)
+    ("wide_demo", {"num_categorical": "CAT_FEATURES", "vocab_size": "VOCAB"},
+     1200, 4, 23, 0.4, 0.6),
 ]
 
 
