@@ -233,6 +233,24 @@ class StackedBlocks(nn.Module):
         return out.reshape(b, *x.shape[1:])
 
 
+def stack_block_params(params: dict, spec: ModelSpec) -> dict:
+    """Inverse of `canonicalize_params`: fold 'block_{i}/<module>/<leaf>'
+    subtrees into the StackedBlocks 'blocks/<name>' (L, ...) leaves, so a
+    per-block checkpoint restores into a pipeline-stacked trunk (the two
+    layouts are interchangeable views of the same weights)."""
+    if "blocks" in params:
+        return params
+    out = {k: v for k, v in params.items()
+           if not (k.startswith("block_") and k[6:].isdigit())}
+    stacked = {}
+    for name, (module, leaf) in _BLOCK_PARAM_PATHS.items():
+        stacked[name] = np.stack(
+            [np.asarray(params[f"block_{i}"][module][leaf])
+             for i in range(spec.num_layers)])
+    out["blocks"] = stacked
+    return out
+
+
 def canonicalize_params(params: dict, spec: ModelSpec) -> dict:
     """Convert a StackedBlocks ('blocks/<name>' leaves (L, ...)) param tree
     into the canonical per-block tree ('block_{i}/<module>/<leaf>') the

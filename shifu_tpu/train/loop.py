@@ -125,6 +125,65 @@ def init_state(job: JobConfig, num_features: int,
     return state
 
 
+def _restore_across_trunk_layout(manager, state: TrainState, job: JobConfig,
+                                 console: "Console"):
+    """Resume an ft_transformer run from a checkpoint written with the OTHER
+    trunk layout (per-block vs pipeline-stacked — `pipeline_stages` is a
+    layout choice, not part of the model).  Weights convert exactly
+    (models/ft_transformer canonicalize/stack); optimizer slots restart
+    fresh, which the console notes.  Returns (state, extra, step) or None.
+    """
+    if job.model.model_type != "ft_transformer":
+        return None
+    from ..models import ft_transformer as ftt
+    from ..models.registry import build_model
+
+    cur = job.model
+    if cur.pipeline_stages > 1:
+        alt_model = dataclasses.replace(cur, pipeline_stages=1,
+                                        pipeline_microbatches=0)
+        convert = ftt.stack_block_params
+    else:
+        stages = next((s for s in range(2, cur.num_layers + 1)
+                       if cur.num_layers % s == 0), 1)
+        if stages == 1:
+            return None  # single layer: only one layout exists
+        alt_model = dataclasses.replace(cur, pipeline_stages=stages)
+        convert = ftt.canonicalize_params
+    try:
+        # abstract restore target in the alternate layout: eval_shape costs
+        # no compute/memory and skips batch-geometry validation (irrelevant
+        # to the stored tree — only shapes matter to orbax)
+        model = build_model(alt_model, job.schema)
+        tx = build_optimizer(job.train.optimizer)
+
+        def make_template():
+            dummy = jnp.zeros((1, job.schema.feature_count), jnp.float32)
+            variables = model.init(jax.random.PRNGKey(job.train.seed), dummy)
+            return TrainState.create(apply_fn=model.apply,
+                                     params=variables["params"], tx=tx)
+
+        alt_abstract = jax.eval_shape(make_template)
+        restored = ckpt_lib.restore_latest(manager, alt_abstract,
+                                           with_extra=True)
+    except Exception:
+        return None  # not the other layout either: caller re-raises
+    if restored is None:
+        return None
+    r_state, extra, step = restored
+    params = convert(dict(jax.device_get(r_state.params)), cur)
+    placed = jax.tree_util.tree_map(
+        lambda host, curp: jax.device_put(np.asarray(host), curp.sharding),
+        params, state.params)
+    step_val = jax.device_put(jax.device_get(r_state.step),
+                              state.step.sharding)
+    console("Resuming across a trunk-layout change "
+            f"(pipeline_stages {alt_model.pipeline_stages} -> "
+            f"{cur.pipeline_stages}): weights converted exactly, optimizer "
+            "slots reinitialized")
+    return (state.replace(params=placed, step=step_val), extra, step)
+
+
 def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
              eval_step, mesh: Optional[Mesh] = None,
              batch_size: Optional[int] = None) -> tuple[float, float]:
@@ -232,8 +291,19 @@ def train(job: JobConfig,
         manager = ckpt_lib.make_manager(job.runtime.checkpoint.directory,
                                         job.runtime.checkpoint.max_to_keep)
         if job.runtime.checkpoint.resume:
-            restored = ckpt_lib.restore_latest(
-                manager, jax.tree_util.tree_map(lambda x: x, state), with_extra=True)
+            try:
+                restored = ckpt_lib.restore_latest(
+                    manager, jax.tree_util.tree_map(lambda x: x, state),
+                    with_extra=True)
+            except Exception:
+                # tree-structure mismatch: the checkpoint may hold the OTHER
+                # ft_transformer trunk layout (per-block vs pipeline-stacked);
+                # anything else (corrupt file, genuinely incompatible model)
+                # must surface, not silently restart from scratch
+                restored = _restore_across_trunk_layout(manager, state, job,
+                                                        console)
+                if restored is None:
+                    raise
             if restored is not None:
                 r_state, extra, step = restored
                 state = state.replace(params=r_state.params,

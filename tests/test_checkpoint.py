@@ -148,3 +148,77 @@ def test_resume_across_mesh_topologies(tmp_path, small_data):
     r_single = train(job_for(d, 4), tr, va, mesh=None, console=lambda s: None)
     assert r_single.resumed_from_epoch == 3
     assert [m.epoch for m in r_single.history] == [3]
+
+
+def test_resume_across_pipeline_trunk_layout(tmp_path, eight_devices):
+    """A checkpoint written by a pipeline-parallel run (stacked trunk)
+    resumes a non-pipelined run of the same model — and vice versa — with
+    weights converted exactly (pipeline_stages is a layout choice, not part
+    of the model)."""
+    from shifu_tpu.config import (DataConfig, JobConfig, MeshConfig,
+                                  ModelSpec, OptimizerConfig, TrainConfig)
+    from shifu_tpu.data import reader, synthetic
+    from shifu_tpu.data.pipeline import TabularDataset
+    from shifu_tpu.parallel import make_mesh
+
+    schema = synthetic.make_schema(num_features=7, num_categorical=2,
+                                   vocab_size=16)
+    rows = synthetic.make_rows(256, schema, seed=9)
+    cols = reader.project_columns(rows, schema)
+    full = TabularDataset(cols["features"], cols["target"], cols["weight"])
+    train_ds, valid_ds = full.take(np.arange(224)), full.take(np.arange(224, 256))
+
+    def make_job(stages, epochs, mesh_cfg=None):
+        return JobConfig(
+            schema=schema, data=DataConfig(batch_size=16),
+            model=ModelSpec(model_type="ft_transformer", hidden_nodes=(8,),
+                            activations=("relu",), token_dim=8,
+                            num_attention_heads=2, num_layers=2,
+                            pipeline_stages=stages, compute_dtype="float32"),
+            train=TrainConfig(epochs=epochs, loss="weighted_mse",
+                              optimizer=OptimizerConfig(name="adadelta",
+                                                        learning_rate=0.01)),
+            runtime=RuntimeConfig(
+                mesh=mesh_cfg or MeshConfig(),
+                checkpoint=CheckpointConfig(directory=str(tmp_path / "ckpt"),
+                                            save_every_epochs=1)),
+        ).validate()
+
+    # phase 1: pipeline-parallel run writes a stacked-trunk checkpoint
+    mesh_cfg = MeshConfig(data=4, pipe=2)
+    mesh = make_mesh(mesh_cfg, devices=eight_devices)
+    r1 = train(make_job(2, 2, mesh_cfg), train_ds, valid_ds, mesh=mesh,
+               console=lambda s: None)
+    assert len(r1.history) == 2
+
+    # phase 2: non-pipelined run resumes from it (stacked -> per-block)
+    lines = []
+    r2 = train(make_job(1, 3), train_ds, valid_ds, console=lines.append)
+    assert r2.resumed_from_epoch == 2
+    assert any("trunk-layout change" in l for l in lines)
+    assert np.isfinite(r2.history[-1].train_error)
+
+    # phase 3: pipelined run resumes from phase 2's per-block checkpoint
+    # (the reverse conversion)
+    lines3 = []
+    r3 = train(make_job(2, 4, mesh_cfg), train_ds, valid_ds, mesh=mesh,
+               console=lines3.append)
+    assert r3.resumed_from_epoch == 3
+    assert any("trunk-layout change" in l for l in lines3)
+    assert np.isfinite(r3.history[-1].train_error)
+
+
+def test_incompatible_checkpoint_raises(tmp_path, small_job, small_data):
+    """A genuinely incompatible checkpoint (changed topology, no layout
+    conversion available) must surface, not silently restart from scratch
+    and evict the good checkpoints."""
+    train_ds, valid_ds = small_data
+    job = _with_ckpt(small_job, str(tmp_path / "ckpt"), epochs=1)
+    train(job, train_ds, valid_ds, console=lambda s: None)
+
+    import dataclasses
+    bigger = small_job.replace(model=dataclasses.replace(
+        small_job.model, hidden_nodes=(32, 32)))
+    job2 = _with_ckpt(bigger, str(tmp_path / "ckpt"), epochs=2)
+    with pytest.raises(Exception):
+        train(job2, train_ds, valid_ds, console=lambda s: None)

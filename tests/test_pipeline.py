@@ -208,3 +208,25 @@ def test_mesh_config_pipe_validation():
     with pytest.raises(ConfigError):
         ModelSpec(model_type="ft_transformer", num_layers=3,
                   pipeline_stages=2).validate()
+
+
+def test_trunk_layout_conversion_roundtrip():
+    """stack_block_params inverts canonicalize_params exactly (checkpoint
+    layout migration, train/loop._restore_across_trunk_layout)."""
+    from shifu_tpu.models.ft_transformer import (canonicalize_params,
+                                                 stack_block_params)
+    from shifu_tpu.models.registry import build_model
+
+    job = _ft_job(pipeline_stages=2, batch_size=8)
+    model = build_model(job.model, job.schema)
+    x = jnp.zeros((8, job.schema.feature_count), jnp.float32)
+    params = dict(model.init(jax.random.PRNGKey(1), x)["params"])
+    canon = canonicalize_params(params, job.model)
+    back = stack_block_params(canon, job.model)
+    for (ka, a), (kb, b) in zip(
+            sorted(jax.tree_util.tree_flatten_with_path(params)[0],
+                   key=lambda t: str(t[0])),
+            sorted(jax.tree_util.tree_flatten_with_path(back)[0],
+                   key=lambda t: str(t[0]))):
+        assert str(ka) == str(kb)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
