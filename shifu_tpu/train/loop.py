@@ -171,16 +171,24 @@ def _restore_across_trunk_layout(manager, state: TrainState, job: JobConfig,
     if restored is None:
         return None
     r_state, extra, step = restored
-    params = convert(dict(jax.device_get(r_state.params)), cur)
+
+    def to_host(tree):
+        # restored leaves may be cross-process sharded on multi-host runs;
+        # device_get alone would raise "not fully addressable"
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            return multihost_utils.process_allgather(tree)
+        return jax.device_get(tree)
+
+    params = convert(dict(to_host(r_state.params)), cur)
     placed = jax.tree_util.tree_map(
         lambda host, curp: jax.device_put(np.asarray(host), curp.sharding),
         params, state.params)
-    step_val = jax.device_put(jax.device_get(r_state.step),
-                              state.step.sharding)
-    console("Resuming across a trunk-layout change "
-            f"(pipeline_stages {alt_model.pipeline_stages} -> "
-            f"{cur.pipeline_stages}): weights converted exactly, optimizer "
-            "slots reinitialized")
+    step_val = jax.device_put(to_host(r_state.step), state.step.sharding)
+    direction = ("stacked -> per-block" if cur.pipeline_stages == 1
+                 else "per-block -> stacked")
+    console(f"Resuming across a trunk-layout change ({direction}): weights "
+            "converted exactly, optimizer slots reinitialized")
     return (state.replace(params=placed, step=step_val), extra, step)
 
 

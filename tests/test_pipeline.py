@@ -227,6 +227,7 @@ def test_trunk_layout_conversion_roundtrip():
             sorted(jax.tree_util.tree_flatten_with_path(params)[0],
                    key=lambda t: str(t[0])),
             sorted(jax.tree_util.tree_flatten_with_path(back)[0],
-                   key=lambda t: str(t[0]))):
+                   key=lambda t: str(t[0])),
+            strict=True):  # a dropped/extra leaf must fail, not truncate
         assert str(ka) == str(kb)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
