@@ -375,6 +375,13 @@ class RuntimeConfig:
     kerberos_principal: str = ""
     kerberos_keytab: str = ""
     distributed: bool = False       # multi-host: jax.distributed.initialize
+    # tensor-parallel / custom parameter sharding from config: ordered
+    # (param-path regex, per-dim axis names) rules, first match wins, axes
+    # from the mesh ("data"/"seq"/"pipe"/"model") or None for unsharded.
+    # XML: shifu.sharding.rules = "regex=axis,axis;regex2=axis" (see
+    # utils/xmlconfig.parse_sharding_rules).  Applied before the built-in
+    # embedding/pipeline rules in train/loop.init_state.
+    param_sharding_rules: tuple[tuple[str, tuple[Optional[str], ...]], ...] = ()
 
 
 # ---------------------------------------------------------------------------
@@ -417,6 +424,15 @@ class JobConfig:
         return dataclasses.replace(self, **kw)
 
 
+def _deep_tuple(v: Any) -> Any:
+    """Lists (from JSON) to tuples at every nesting level — dataclass tuple
+    fields like param_sharding_rules nest two deep, and equality/hash of the
+    frozen configs requires tuples all the way down."""
+    if isinstance(v, list):
+        return tuple(_deep_tuple(x) for x in v)
+    return v
+
+
 def _from_dict(cls: type, d: Any) -> Any:
     """Recursively build a (possibly nested) dataclass from plain dicts/lists."""
     if not dataclasses.is_dataclass(cls):
@@ -436,7 +452,7 @@ def _from_dict(cls: type, d: Any) -> Any:
             kwargs[key] = tuple(_from_dict(ColumnSpec, v) if isinstance(v, dict) else v
                                 for v in value)
         elif isinstance(value, list):
-            kwargs[key] = tuple(tuple(v) if isinstance(v, list) else v for v in value)
+            kwargs[key] = _deep_tuple(value)
         else:
             kwargs[key] = value
     return cls(**kwargs)

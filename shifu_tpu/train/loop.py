@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import re
 import time
 from typing import Any, Callable, Optional
 
@@ -102,14 +103,28 @@ def init_state(job: JobConfig, num_features: int,
     variables = model.init(rng, dummy)
     state = TrainState.create(apply_fn=model.apply, params=variables["params"], tx=tx)
     if mesh is not None:
+        from jax.sharding import PartitionSpec as P
         rules: tuple = ()
+        # config-supplied rules first (first match wins in param_specs):
+        # the operator's tensor-parallel placements override the built-ins
+        for pattern, axes in job.runtime.param_sharding_rules:
+            try:
+                re.compile(pattern)
+            except re.error as e:
+                raise ConfigError(
+                    f"shifu.sharding.rules: bad path regex {pattern!r}: {e}")
+            for axis in axes:
+                if axis is not None and axis not in mesh.shape:
+                    raise ConfigError(
+                        f"sharding rule {pattern!r}: axis {axis!r} not in "
+                        f"mesh axes {sorted(mesh.shape)}")
+            rules += ((pattern, P(*axes)),)
         if job.runtime.mesh.model > 1:
             rules += tuple(shard_lib.DEFAULT_RULES)
         if (job.model.pipeline_stages > 1
                 and int(mesh.shape.get("pipe", 1)) > 1):
             # stacked trunk layers shard by stage: each device holds (and
             # updates) only its own pipeline stage's parameters
-            from jax.sharding import PartitionSpec as P
             rules += ((r".*\bblocks\b.*", P("pipe")),)
         placed_params = shard_lib.place_params(state.params, mesh, rules)
         # optimizer slots follow their param's sharding (a vocab-sharded

@@ -48,10 +48,36 @@ KEY_MESH_PIPE = "shifu.mesh.pipe"
 # tokens, TensorflowClient.java:481-502)
 KEY_KERBEROS_PRINCIPAL = "shifu.security.kerberos.principal"
 KEY_KERBEROS_KEYTAB = "shifu.security.kerberos.keytab"
+# custom parameter sharding (tensor parallelism from config):
+# "path-regex=axis[,axis...]" entries joined by ";"; axis "none"/"" = that
+# dim unsharded.  Example: ".*hidden_layer0.*kernel.*=none,model"
+KEY_SHARDING_RULES = "shifu.sharding.rules"
 KEY_DATA_CACHE_DIR = "shifu.data.cache-dir"
 KEY_DATA_OUT_OF_CORE = "shifu.data.out-of-core"
 KEY_DATA_STAGED = "shifu.data.staged"
 KEY_DATA_READ_THREADS = "shifu.data.read-threads"
+
+
+def parse_sharding_rules(value: str) -> tuple:
+    """Parse KEY_SHARDING_RULES: ';'-joined "regex=axis[,axis...]" entries
+    into ((regex, (axis|None, ...)), ...) for RuntimeConfig.param_sharding_rules.
+
+    '=' may appear inside the regex — the LAST '=' splits pattern from axes.
+    Axis 'none' (any case) or '' means that dimension stays unsharded.
+    """
+    rules = []
+    for entry in value.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" not in entry:
+            raise ValueError(
+                f"sharding rule {entry!r}: expected 'regex=axis[,axis...]'")
+        pattern, _, axes_s = entry.rpartition("=")
+        axes = tuple(None if a.strip().lower() in ("", "none") else a.strip()
+                     for a in axes_s.split(","))
+        rules.append((pattern.strip(), axes))
+    return tuple(rules)
 
 
 def parse_configuration_xml(path: str) -> dict[str, str]:
@@ -161,6 +187,9 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         rt_kw["kerberos_principal"] = conf[KEY_KERBEROS_PRINCIPAL]
     if KEY_KERBEROS_KEYTAB in conf:
         rt_kw["kerberos_keytab"] = conf[KEY_KERBEROS_KEYTAB]
+    if KEY_SHARDING_RULES in conf:
+        rt_kw["param_sharding_rules"] = parse_sharding_rules(
+            conf[KEY_SHARDING_RULES])
     if (KEY_MESH_DATA in conf or KEY_MESH_MODEL in conf
             or KEY_MESH_SEQ in conf or KEY_MESH_PIPE in conf):
         rt_kw["mesh"] = dataclasses.replace(

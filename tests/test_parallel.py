@@ -135,3 +135,86 @@ def test_multi_epoch_sharded_training_learns(small_job, eight_devices):
     mesh = data_parallel_mesh(8)
     result = train_fn(small_job, train_ds, valid_ds, mesh=mesh, console=lambda s: None)
     assert result.history[-1].valid_auc > 0.65
+
+
+def test_config_wired_tensor_parallel(eight_devices):
+    """Tensor parallelism from the operator config: shifu.sharding.rules
+    places a dense trunk kernel on the model axis, training still matches
+    the single-device update, and bad axes fail with a ConfigError."""
+    from shifu_tpu.config import ConfigError
+    from shifu_tpu.config.schema import RuntimeConfig
+    from shifu_tpu.data import synthetic
+    from shifu_tpu.utils.xmlconfig import parse_sharding_rules
+
+    rules = parse_sharding_rules(
+        ".*hidden_layer0.*kernel.*=none,model; .*hidden_layer1.*kernel.*=model")
+    assert rules == ((".*hidden_layer0.*kernel.*", (None, "model")),
+                     (".*hidden_layer1.*kernel.*", ("model",)))
+
+    from shifu_tpu.config import (DataConfig, JobConfig, ModelSpec,
+                                  OptimizerConfig, TrainConfig)
+    schema = synthetic.make_schema(num_features=30)
+    mesh_cfg = MeshConfig(data=4, model=2)
+    job = JobConfig(
+        schema=schema, data=DataConfig(batch_size=64),
+        model=ModelSpec(model_type="mlp", hidden_nodes=(16, 16),
+                        activations=("tanh", "tanh"), compute_dtype="float32"),
+        train=TrainConfig(epochs=1, loss="weighted_mse",
+                          optimizer=OptimizerConfig(name="adadelta",
+                                                    learning_rate=0.05)),
+        runtime=RuntimeConfig(mesh=mesh_cfg, param_sharding_rules=rules),
+    ).validate()
+    mesh = make_mesh(mesh_cfg, devices=eight_devices)
+    state = init_state(job, 30, mesh)
+    k0 = state.params["trunk"]["hidden_layer0"]["Dense_0"]["kernel"]
+    assert k0.sharding.spec == P(None, "model"), k0.sharding.spec
+    k1 = state.params["trunk"]["hidden_layer1"]["Dense_0"]["kernel"]
+    assert k1.sharding.spec[0] == "model", k1.sharding.spec
+    # optimizer slots follow (place_opt_state)
+    slots = [l.sharding.spec for l in jax.tree_util.tree_leaves(state.opt_state)
+             if getattr(l, "shape", None) == k0.shape]
+    assert slots and all(s == P(None, "model") for s in slots)
+
+    batch = _batch(64, 30, seed=5)
+    step = make_train_step(job, mesh, donate=False)
+    new_tp, m_tp = step(state, shard_batch(batch, mesh))
+
+    state1 = init_state(job, 30)
+    step1 = make_train_step(job, donate=False)
+    new1, m1 = step1(state1, {k: jnp.asarray(v) for k, v in batch.items()})
+    assert float(m1["loss"]) == pytest.approx(float(m_tp["loss"]), rel=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(new1.params),
+                    jax.tree_util.tree_leaves(new_tp.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+    bad = job.replace(runtime=RuntimeConfig(
+        mesh=mesh_cfg, param_sharding_rules=((".*kernel.*", ("bogus",)),)))
+    with pytest.raises(ConfigError, match="bogus"):
+        init_state(bad, 30, mesh)
+
+
+def test_sharding_rules_json_roundtrip_and_bad_regex(eight_devices):
+    from shifu_tpu.config import ConfigError, JobConfig
+    from shifu_tpu.config.schema import RuntimeConfig
+
+    job = JobConfig(runtime=RuntimeConfig(
+        param_sharding_rules=((".*kernel.*", (None, "model")),)))
+    job2 = JobConfig.from_json(job.to_json())
+    assert job2 == job  # tuples all the way down (frozen-config equality)
+
+    from shifu_tpu.config import (DataConfig, ModelSpec, OptimizerConfig,
+                                  TrainConfig)
+    mesh_cfg = MeshConfig(data=8)
+    mesh = make_mesh(mesh_cfg, devices=eight_devices)
+    bad = JobConfig(
+        schema=synthetic.make_schema(num_features=4),
+        data=DataConfig(batch_size=8),
+        model=ModelSpec(model_type="mlp", hidden_nodes=(4,),
+                        activations=("relu",)),
+        train=TrainConfig(epochs=1, optimizer=OptimizerConfig()),
+        runtime=RuntimeConfig(mesh=mesh_cfg,
+                              param_sharding_rules=((".*[kernel=", ("data",)),)),
+    ).validate()
+    with pytest.raises(ConfigError, match="bad path regex"):
+        init_state(bad, 4, mesh)
