@@ -296,6 +296,9 @@ class TrainConfig:
         if self.early_stop_patience < 0 or self.early_stop_min_delta < 0:
             raise ConfigError("early_stop_patience and early_stop_min_delta "
                               "must be >= 0")
+        if not (0.0 < self.bagging_sample_rate <= 1.0):
+            raise ConfigError("bagging_sample_rate must be in (0, 1]: "
+                              f"{self.bagging_sample_rate}")
         if self.loss not in ("weighted_mse", "bce", "weighted_bce"):
             raise ConfigError(f"unknown loss {self.loss!r}")
         self.optimizer.validate()
@@ -402,6 +405,11 @@ class JobConfig:
         self.model.validate()
         self.train.validate()
         self.runtime.mesh.validate()
+        if self.train.bagging_sample_rate < 1.0 and self.data.out_of_core:
+            # subsampling fancy-indexes the dataset, which would materialize
+            # memmap-backed out-of-core shards into RAM
+            raise ConfigError("bagging_sample_rate < 1 is not supported with "
+                              "out-of-core datasets")
         return self
 
     # -- serialization ------------------------------------------------------

@@ -304,6 +304,20 @@ def train(job: JobConfig,
         train_ds, valid_ds = pipe.load_datasets(job.schema, job.data, host, nhosts)
     assert valid_ds is not None
 
+    # Shifu train.baggingSampleRate: deterministic per-run subsample of the
+    # TRAIN partition (valid stays complete).  Positions are stable for a
+    # given dataset order, so resume sees the same subsample.  The reference
+    # carried the field but never honored it.
+    rate = job.train.bagging_sample_rate
+    if 0.0 < rate < 1.0 and train_ds.num_rows > 0:
+        from ..data.split import bagging_mask
+        keep = np.nonzero(bagging_mask(
+            np.arange(train_ds.num_rows, dtype=np.uint64),
+            rate, seed=job.train.seed))[0]
+        console(f"Bagging: {len(keep)}/{train_ds.num_rows} train rows "
+                f"(baggingSampleRate={rate:g})")
+        train_ds = train_ds.take(keep)
+
     num_features = train_ds.num_features or job.schema.feature_count
     state = init_state(job, num_features, mesh)
 

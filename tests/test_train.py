@@ -176,3 +176,38 @@ def test_warmup_cosine_validation():
     with pytest.raises(ConfigError, match="warmup_cosine"):
         OptimizerConfig(schedule="warmup_cosine", warmup_steps=100,
                         decay_steps=50).validate()
+
+
+def test_bagging_sample_rate(small_job, small_data):
+    """baggingSampleRate subsamples the train partition deterministically;
+    the valid set stays complete (the reference carried the field unused)."""
+    import dataclasses
+
+    train_ds, valid_ds = small_data
+    job = small_job.replace(train=dataclasses.replace(
+        small_job.train, epochs=1, bagging_sample_rate=0.5))
+    lines = []
+    r1 = train(job, train_ds, valid_ds, console=lines.append)
+    bag = [l for l in lines if l.startswith("Bagging:")]
+    assert bag, lines
+    kept = int(bag[0].split()[1].split("/")[0])
+    assert 0.3 * train_ds.num_rows < kept < 0.7 * train_ds.num_rows
+    # deterministic: same job -> same subsample -> same result
+    r2 = train(job, train_ds, valid_ds, console=lambda s: None)
+    assert r1.history[-1].train_error == pytest.approx(
+        r2.history[-1].train_error, rel=1e-6)
+
+
+def test_bagging_rate_validation(small_job):
+    import dataclasses
+
+    from shifu_tpu.config import ConfigError
+    for bad in (0.0, -0.5, 1.5):
+        with pytest.raises(ConfigError, match="bagging"):
+            small_job.replace(train=dataclasses.replace(
+                small_job.train, bagging_sample_rate=bad)).validate()
+    with pytest.raises(ConfigError, match="out-of-core"):
+        small_job.replace(
+            train=dataclasses.replace(small_job.train, bagging_sample_rate=0.5),
+            data=dataclasses.replace(small_job.data, out_of_core=True),
+        ).validate()
