@@ -64,6 +64,16 @@ _ALGORITHM_TO_MODEL_TYPE = {
 }
 
 
+def _norm_delimiter(value: Any) -> str:
+    """dataSet.dataDelimiter is a Java regex in Shifu: unescape the common
+    single-char escaped forms ("\\|" -> "|", "\\t" -> tab); empty/missing
+    means the pipe default."""
+    d = str(value or "|")
+    if len(d) == 2 and d[0] == "\\":
+        return {"t": "\t"}.get(d[1], d[1])
+    return d or "|"
+
+
 def _norm_activation(name: Optional[str]) -> str:
     # Reference: unknown/None activation falls back to leaky_relu
     # (ssgd_monitor.py:77-90).
@@ -296,7 +306,13 @@ def job_config_from_shifu(
         if data_path:
             paths = (str(data_path),)
 
-    data_config = DataConfig(paths=paths, valid_ratio=valid_ratio)
+    # dataSet.dataDelimiter rides into the reader (the reference hardcoded
+    # '|' regardless — ssgd_monitor.py row split).  Shifu treats the field
+    # as a Java regex, so configs commonly carry escaped forms ("\\|",
+    # "\\t"); normalize those to the literal character.
+    data_config = DataConfig(paths=paths, valid_ratio=valid_ratio,
+                             delimiter=_norm_delimiter(
+                                 dataset.get("dataDelimiter")))
 
     job = JobConfig(schema=schema, data=data_config, model=model_spec, train=train_config)
     if overrides:

@@ -332,6 +332,14 @@ def project_columns(
     """
     n = rows.shape[0]
     sel = np.asarray(schema.selected_indices, dtype=np.int64)
+    need = max([*schema.selected_indices, *schema.all_target_indices,
+                schema.weight_index]) + 1
+    if n and rows.shape[1] < need:
+        raise ValueError(
+            f"parsed rows have {rows.shape[1]} columns but the schema "
+            f"references column index {need - 1}; the data delimiter "
+            "(dataSet.dataDelimiter / DataConfig.delimiter) probably does "
+            "not match the files")
     features = rows[:, sel] if n else np.zeros((0, len(sel)), np.float32)
     features = np.nan_to_num(features, nan=impute_value)
     tgt_idx = np.asarray(schema.all_target_indices, dtype=np.int64)

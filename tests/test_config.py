@@ -188,3 +188,62 @@ def test_multi_target_mode_from_shifu_json(tmp_path):
     np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
     assert (b >= 0).all() and (b <= 1).all()
     nat.close()
+
+
+def test_data_delimiter_from_model_config(tmp_path):
+    """dataSet.dataDelimiter drives the reader (the reference hardcoded '|');
+    comma-delimited normalized data trains end-to-end from unchanged JSON."""
+    import gzip
+    import json
+
+    import numpy as np
+
+    from shifu_tpu.config import job_config_from_shifu
+    from shifu_tpu.data.pipeline import load_datasets
+
+    rng = np.random.default_rng(3)
+    rows = np.column_stack([
+        (rng.random(200) < 0.5).astype(np.float32),
+        rng.standard_normal((200, 4)).astype(np.float32)])
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    with gzip.open(data_dir / "part-0.csv.gz", "wt") as f:
+        for r in rows:
+            f.write(",".join(f"{v:.6f}" for v in r) + "\n")
+
+    mc = {"dataSet": {"targetColumnName": "target", "dataDelimiter": ","},
+          "train": {"numTrainEpochs": 1, "validSetRate": 0.2,
+                    "algorithm": "NN",
+                    "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [4],
+                               "ActivationFunc": ["relu"],
+                               "LearningRate": 0.01}}}
+    cols = [{"columnNum": 0, "columnName": "target", "columnFlag": "Target"}]
+    cols += [{"columnNum": i, "columnName": f"f{i}", "columnType": "N",
+              "finalSelect": True} for i in range(1, 5)]
+    (tmp_path / "ModelConfig.json").write_text(json.dumps(mc))
+    (tmp_path / "ColumnConfig.json").write_text(json.dumps(cols))
+
+    job = job_config_from_shifu(str(tmp_path / "ModelConfig.json"),
+                                str(tmp_path / "ColumnConfig.json"),
+                                data_paths=(str(data_dir),))
+    assert job.data.delimiter == ","
+    train_ds, valid_ds = load_datasets(job.schema, job.data)
+    assert train_ds.num_rows + valid_ds.num_rows == 200
+    assert train_ds.num_features == 4
+
+
+def test_delimiter_normalization_and_mismatch_error():
+    from shifu_tpu.config.shifu_compat import _norm_delimiter
+    assert _norm_delimiter("\\|") == "|"
+    assert _norm_delimiter("\\t") == "\t"
+    assert _norm_delimiter(",") == ","
+    assert _norm_delimiter(None) == "|"
+
+    # wrong delimiter -> self-diagnosing error, not a bare IndexError
+    import numpy as np
+
+    from shifu_tpu.data import reader, synthetic
+    schema = synthetic.make_schema(num_features=4)
+    one_col = np.full((3, 1), np.nan, np.float32)  # what a bad split yields
+    with pytest.raises(ValueError, match="delimiter"):
+        reader.project_columns(one_col, schema)
