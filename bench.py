@@ -68,6 +68,9 @@ def _ladder_extras(mesh, n_chips: int) -> dict:
                                 activations=("relu", "relu"), num_heads=2,
                                 head_names=("shifu_output_0", "shifu_output_1"),
                                 compute_dtype="bfloat16"), 32768, 32),
+        ("moe_mlp", ModelSpec(model_type="moe_mlp", hidden_nodes=(100, 100),
+                              activations=("relu", "relu"), num_experts=8,
+                              compute_dtype="bfloat16"), 32768, 32),
         ("ft_transformer", ModelSpec(model_type="ft_transformer", token_dim=64,
                                      num_layers=3, num_attention_heads=8,
                                      compute_dtype="bfloat16"), 4096, 16),

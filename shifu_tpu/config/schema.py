@@ -135,7 +135,8 @@ class DataConfig:
 # Model
 # ---------------------------------------------------------------------------
 
-VALID_MODEL_TYPES = ("mlp", "wide_deep", "deepfm", "multitask", "ft_transformer")
+VALID_MODEL_TYPES = ("mlp", "wide_deep", "deepfm", "multitask",
+                     "ft_transformer", "moe_mlp")
 VALID_ACTIVATIONS = ("sigmoid", "tanh", "relu", "leakyrelu")
 
 
@@ -183,6 +184,9 @@ class ModelSpec:
     # microbatches per global batch when pipelined; 0 = pipeline_stages
     # (the minimum that keeps every stage busy at steady state)
     pipeline_microbatches: int = 0
+    # moe_mlp: dense-gated mixture of expert MLP trunks; the expert axis
+    # shards over the `model` mesh axis (true expert parallelism)
+    num_experts: int = 4
     # rematerialization (gradient checkpointing): recompute each transformer
     # block's activations in the backward pass instead of storing them —
     # trades FLOPs for HBM on deep stacks / long token axes (jax.checkpoint)
@@ -206,6 +210,8 @@ class ModelSpec:
             raise ConfigError(
                 f"unknown attention_impl {self.attention_impl!r}; "
                 "expected local|ring|ulysses|flash")
+        if self.model_type == "moe_mlp" and self.num_experts < 2:
+            raise ConfigError("moe_mlp requires num_experts >= 2")
         if self.pipeline_stages < 1 or self.pipeline_microbatches < 0:
             raise ConfigError("pipeline_stages must be >= 1 and "
                               "pipeline_microbatches >= 0")

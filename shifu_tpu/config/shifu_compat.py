@@ -61,16 +61,26 @@ _ALGORITHM_TO_MODEL_TYPE = {
     "MULTITASK": "multitask",
     "FTTRANSFORMER": "ft_transformer",
     "FT_TRANSFORMER": "ft_transformer",
+    "MOE": "moe_mlp",
+    "MOE_MLP": "moe_mlp",
 }
 
 
 def _norm_delimiter(value: Any) -> str:
-    """dataSet.dataDelimiter is a Java regex in Shifu: unescape the common
-    single-char escaped forms ("\\|" -> "|", "\\t" -> tab); empty/missing
-    means the pipe default."""
+    """dataSet.dataDelimiter is a Java regex in Shifu: unescape escaped
+    literal characters ("\\|" -> "|", "\\t" -> tab); empty/missing means
+    the pipe default.  Regex character classes ("\\s", "\\d", ...) have no
+    literal-delimiter equivalent and are rejected up front rather than
+    silently splitting rows on a letter."""
     d = str(value or "|")
     if len(d) == 2 and d[0] == "\\":
-        return {"t": "\t"}.get(d[1], d[1])
+        if d[1] == "t":
+            return "\t"
+        if not d[1].isalnum():  # escaped punctuation: the literal char
+            return d[1]
+        raise ConfigError(
+            f"dataSet.dataDelimiter {d!r} is a regex character class; use a "
+            "literal delimiter character instead")
     return d or "|"
 
 
@@ -224,6 +234,7 @@ def parse_model_config(model_config: dict[str, Any]) -> tuple[ModelSpec, TrainCo
         hidden_nodes=tuple(hidden_nodes),
         activations=tuple(activations),
         embedding_dim=int(params.get("EmbeddingDim", 16)),
+        num_experts=int(params.get("NumExperts", 4)),
         num_heads=num_heads,
         head_names=tuple(head_names),
         num_layers=int(params.get("NumTransformerLayers",

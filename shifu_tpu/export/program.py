@@ -30,6 +30,9 @@ Op set (all scoring math is float32):
   select_token  (B,S,D) -> (B,D)      take token at index
   transformer_block                   pre-LN MHA + residual + pre-LN MLP
                                       (models/ft_transformer.py TransformerBlock)
+  expert_dense  (B,I)|(B,E,I) -> (B,E,O)  per-expert x @ K[e] + b[e], fused
+                                      activation (models/moe.py expert trunks)
+  moe_combine   (B,E,H) x (B,E) -> (B,H)  gate-weighted expert combination
 """
 
 from __future__ import annotations
@@ -50,6 +53,7 @@ WEIGHT_FIELDS: dict[str, tuple[str, ...]] = {
     "numeric_embed": ("weight", "bias"),
     "cls_prepend": ("token",),
     "layernorm": ("scale", "bias"),
+    "expert_dense": ("kernel", "bias"),
     "transformer_block": (
         "ln_attn_scale", "ln_attn_bias", "qkv_kernel", "qkv_bias",
         "proj_kernel", "proj_bias", "ln_mlp_scale", "ln_mlp_bias",
@@ -190,6 +194,24 @@ def _multitask_program(spec: ModelSpec, layout: FieldLayout) -> list[Op]:
     return ops
 
 
+def _moe_mlp_program(spec: ModelSpec, layout: FieldLayout) -> list[Op]:
+    """models/moe.py MoEMLP: softmax gate + stacked expert trunks +
+    gate-weighted combine + shared head."""
+    ops: list[Op] = [_dense("input", "gate_logits", "gate/Dense_0", None)]
+    ops.append({"op": "activation", "src": "gate_logits", "out": "gate",
+                "fn": "softmax"})
+    cur = "input"
+    for i, act in enumerate(spec.activations):
+        ops.append({"op": "expert_dense", "src": cur, "out": f"eh{i}",
+                    "kernel": f"experts/kernel{i}",
+                    "bias": f"experts/bias{i}", "activation": act})
+        cur = f"eh{i}"
+    ops.append({"op": "moe_combine", "srcs": [cur, "gate"], "out": "combined"})
+    ops.append(_dense("combined", "logits", "shifu_output_0/Dense_0", None))
+    ops.append(_sigmoid("logits"))
+    return ops
+
+
 def _ft_transformer_program(spec: ModelSpec, layout: FieldLayout) -> list[Op]:
     """models/ft_transformer.py FTTransformer: tokenize -> CLS -> blocks ->
     final LN -> head."""
@@ -242,6 +264,7 @@ _BUILDERS = {
     "deepfm": _deepfm_program,
     "multitask": _multitask_program,
     "ft_transformer": _ft_transformer_program,
+    "moe_mlp": _moe_mlp_program,
 }
 
 
@@ -256,8 +279,8 @@ def build_program_v2(spec: ModelSpec,
     if builder is None:
         return None
     if schema is None:
-        if spec.model_type != "mlp":
-            return None
+        if spec.model_type not in ("mlp", "moe_mlp"):
+            return None  # layout-dependent models need the schema
         layout = FieldLayout((), (), ())
     else:
         layout = field_layout(schema)

@@ -42,6 +42,8 @@ def _act(name: str, x: np.ndarray) -> np.ndarray:
         # tanh approximation — flax nn.gelu default (approximate=True)
         c = np.float32(np.sqrt(2.0 / np.pi))
         return 0.5 * x * (1.0 + np.tanh(c * (x + 0.044715 * x * x * x)))
+    if name == "softmax":
+        return _softmax(x)  # rowwise over the last axis (moe gate)
     if name in (None, "", "linear"):
         return x
     raise ValueError(f"unknown activation {name!r}")
@@ -137,6 +139,16 @@ def run_program(program: list[dict], weights: dict[str, np.ndarray],
             out = src[:, int(op["index"]), :]
         elif kind == "transformer_block":
             out = _transformer_block(op, w, src)
+        elif kind == "expert_dense":
+            kernel = w[op["kernel"]]              # (E, I, O)
+            if src.ndim == 2:                     # first layer: shared input
+                out = np.einsum("bi,eio->beo", src, kernel)
+            else:                                 # (B, E, I) per-expert
+                out = np.einsum("bei,eio->beo", src, kernel)
+            out = _act(op.get("activation"), out + w[op["bias"]][None])
+        elif kind == "moe_combine":
+            h, gate = (bufs[s] for s in op["srcs"])  # (B,E,H), (B,E)
+            out = np.einsum("beh,be->bh", h, gate)
         else:
             raise ValueError(f"unknown op {kind!r}")
         out = np.asarray(out, dtype=np.float32)

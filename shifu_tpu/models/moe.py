@@ -1,0 +1,77 @@
+"""Mixture-of-experts MLP — the true expert-parallel (EP) ladder rung.
+
+Nothing like this exists in the reference (SURVEY.md §2.4 marks EP absent);
+it extends the tabular ladder with capacity scaling: E expert MLP trunks
+(the ModelConfig NumHiddenLayers/NumHiddenNodes topology each), a dense
+softmax gate over the input, gate-weighted combination of expert outputs,
+and the shared `shifu_output_0` scoring head.
+
+TPU-first design notes:
+- Dense (soft) gating, not top-k dispatch: every expert processes the
+  batch, so the computation is static-shape einsums that tile straight onto
+  the MXU — no data-dependent routing, no capacity-factor drops, and the
+  model lowers exactly to the scoring artifact's op list (expert_dense /
+  moe_combine in export/program.py, executed by the numpy interpreter and
+  the native C++ engine).
+- Expert parallelism: expert params are stacked on a leading E axis
+  ('experts/*' leaves (E, ...)); with a `model` mesh axis they shard by
+  expert (train/loop.init_state rule), each device computing only its own
+  experts' einsum slice — XLA inserts the psum of the gate-weighted
+  combine.  The EP analog of vocab-sharded embedding tables, but over
+  whole sub-networks.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.nn import initializers as jinit
+
+from ..config.schema import ModelSpec
+from ..ops.activations import get_activation
+from .base import ShifuDense, dtype_of
+
+
+class MoEMLP(nn.Module):
+    spec: ModelSpec
+
+    @nn.compact
+    def __call__(self, features: jax.Array, *, train: bool = False) -> jax.Array:
+        spec = self.spec
+        cdt = dtype_of(spec.compute_dtype)
+        pdt = dtype_of(spec.param_dtype)
+        e = spec.num_experts
+        x = features.astype(cdt)
+
+        # dense softmax gate over the raw features (B, E); float32 softmax
+        gate_logits = ShifuDense(
+            features=e, activation=None, xavier_bias=spec.xavier_bias_init,
+            param_dtype=spec.param_dtype, compute_dtype=spec.compute_dtype,
+            name="gate")(x)
+        gate = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+        # expert trunks: stacked (E, in, out) kernels, per-layer einsum —
+        # one batched matmul per layer covering every expert (MXU-friendly)
+        stacked_xavier = jinit.variance_scaling(
+            1.0, "fan_avg", "uniform", in_axis=-2, out_axis=-1, batch_axis=(0,))
+        h = jnp.broadcast_to(x[:, None, :], (x.shape[0], e, x.shape[1]))
+        d_in = x.shape[1]
+        for i, (n, act) in enumerate(zip(spec.hidden_nodes, spec.activations)):
+            kernel = self.param(f"experts/kernel{i}", stacked_xavier,
+                                (e, d_in, n), pdt)
+            bias = self.param(f"experts/bias{i}", jinit.zeros, (e, n), pdt)
+            h = jnp.einsum("bei,eio->beo", h, kernel.astype(cdt))
+            h = h + bias.astype(cdt)[None]
+            h = get_activation(act)(h)
+            d_in = n
+
+        # gate-weighted combine (B, E, H) x (B, E) -> (B, H)
+        combined = jnp.einsum("beh,be->bh", h.astype(jnp.float32),
+                              gate).astype(cdt)
+
+        return ShifuDense(
+            features=spec.num_heads, activation=None,
+            xavier_bias=spec.xavier_bias_init, param_dtype=spec.param_dtype,
+            compute_dtype=spec.compute_dtype,
+            name="shifu_output_0")(combined).astype(jnp.float32)

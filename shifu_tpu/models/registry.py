@@ -66,6 +66,13 @@ def _build_multitask(spec: ModelSpec, schema: DataSchema,
     return MultiTask(spec=spec)
 
 
+@register("moe_mlp")
+def _build_moe_mlp(spec: ModelSpec, schema: DataSchema,
+                   mesh=None) -> nn.Module:
+    from .moe import MoEMLP
+    return MoEMLP(spec=spec)
+
+
 @register("ft_transformer")
 def _build_ft_transformer(spec: ModelSpec, schema: DataSchema,
                           mesh=None) -> nn.Module:

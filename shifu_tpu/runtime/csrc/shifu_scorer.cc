@@ -48,7 +48,8 @@ enum Activation : uint32_t {
   kTanh = 2,
   kRelu = 3,
   kLeakyRelu = 4,
-  kGelu = 5,  // tanh approximation (flax nn.gelu default)
+  kGelu = 5,     // tanh approximation (flax nn.gelu default)
+  kSoftmax = 6,  // rowwise over the last axis; kActivation only (moe gate)
 };
 
 enum OpCode : uint32_t {
@@ -66,6 +67,8 @@ enum OpCode : uint32_t {
   kLayerNorm = 11,
   kSelectToken = 12,
   kTransformerBlock = 13,
+  kExpertDense = 14,   // per-expert dense over stacked (E, I, O) kernels
+  kMoeCombine = 15,    // gate-weighted expert combination
 };
 
 struct Op {
@@ -271,7 +274,7 @@ bool infer_shapes(Model* m) {
   for (const Op& op : m->ops) {
     if (op.dst == 0 || defined[op.dst]) return false;
     if (op.src != kNoBuf && !defined[op.src]) return false;
-    if (op.code == kConcat || op.code == kAdd)
+    if (op.code == kConcat || op.code == kAdd || op.code == kMoeCombine)
       for (uint32_t sb : op.idx)
         if (sb >= s.size() || !defined[sb]) return false;
     defined[op.dst] = true;
@@ -361,6 +364,24 @@ bool infer_shapes(Model* m) {
         if (op.b < 1 || op.a % op.b != 0) return false;  // heads must divide d
         out = in;
         break;
+      case kExpertDense:
+        // a=experts, b=in, c=out; rank-2 input broadcasts to every expert
+        if (in.rank == 2) {
+          if (in.d1 != op.b) return false;
+        } else if (in.rank == 3) {
+          if (in.d1 != op.a || in.d2 != op.b) return false;
+        } else {
+          return false;
+        }
+        out = {3, op.a, op.c};
+        break;
+      case kMoeCombine: {
+        if (op.idx.size() != 2) return false;
+        const Shape h = s[op.idx[0]], g = s[op.idx[1]];
+        if (h.rank != 3 || g.rank != 2 || g.d1 != h.d1) return false;
+        out = {2, h.d2, 0};
+        break;
+      }
       default:
         return false;
     }
@@ -380,8 +401,9 @@ bool read_op(FILE* f, Op* op) {
     return false;
   switch (op->code) {
     case kDense:
-      return read_u32(f, &op->act) && read_u32(f, &op->a) &&
-             read_u32(f, &op->b) &&
+      // act bounded to elementwise fns (softmax is kActivation-only)
+      return read_u32(f, &op->act) && op->act <= kGelu &&
+             read_u32(f, &op->a) && read_u32(f, &op->b) &&
              read_f32s(f, &op->w0, uint64_t(op->a) * op->b) &&
              read_f32s(f, &op->w1, op->b);
     case kGatherCols: {
@@ -416,7 +438,7 @@ bool read_op(FILE* f, Op* op) {
     case kFmPair:
       return true;
     case kActivation:
-      return read_u32(f, &op->act);
+      return read_u32(f, &op->act) && op->act <= kSoftmax;
     case kClsPrepend:
       // a=dim
       return read_u32(f, &op->a) && read_f32s(f, &op->w0, op->a);
@@ -439,6 +461,24 @@ bool read_op(FILE* f, Op* op) {
       for (int i = 0; i < 12; ++i)
         if (!read_f32s(f, &op->tw[i], sizes[i])) return false;
       return true;
+    }
+    case kExpertDense: {
+      // act; a=experts, b=in, c=out — staged overflow-safe product checks
+      if (!(read_u32(f, &op->act) && op->act <= kGelu &&
+            read_u32(f, &op->a) && read_u32(f, &op->b) &&
+            read_u32(f, &op->c)))
+        return false;
+      if (op->a == 0 || op->a > 65536 || op->b > kMaxArrayElems ||
+          op->c > kMaxArrayElems)
+        return false;
+      const uint64_t ein = uint64_t(op->a) * op->b;
+      if (ein > kMaxArrayElems || ein * op->c > kMaxArrayElems) return false;
+      return read_f32s(f, &op->w0, ein * op->c) &&
+             read_f32s(f, &op->w1, uint64_t(op->a) * op->c);
+    }
+    case kMoeCombine: {
+      uint32_t n = 0;
+      return read_u32(f, &n) && n == 2 && read_u32s(f, &op->idx, n);
     }
     default:
       return false;
@@ -631,6 +671,23 @@ int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
       case kActivation:
         if (op.code == kFlatten) {
           std::memcpy(dst, src, dst_n * sizeof(float));
+        } else if (op.act == kSoftmax) {
+          // rowwise stable softmax over the last axis (moe gate)
+          const size_t width = os.rank == 3 ? os.d2 : os.d1;
+          if (width == 0) return 2;  // crafted zero-width buffer: clean error
+          for (size_t r = 0; r < dst_n / width; ++r) {
+            const float* xr = src + r * width;
+            float* dr = dst + r * width;
+            float mx = xr[0];
+            for (size_t k = 1; k < width; ++k) mx = std::max(mx, xr[k]);
+            float sum = 0.0f;
+            for (size_t k = 0; k < width; ++k) {
+              dr[k] = std::exp(xr[k] - mx);
+              sum += dr[k];
+            }
+            const float inv = 1.0f / sum;
+            for (size_t k = 0; k < width; ++k) dr[k] *= inv;
+          }
         } else {
           apply_act_rows(op.act, src, dst, dst_n);
         }
@@ -695,6 +752,49 @@ int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
       case kTransformerBlock:
         exec_transformer_block(op, src, dst, batch, in.d1);
         break;
+      case kExpertDense: {
+        // per-expert matmul over stacked (E, I, O) kernels; output laid out
+        // (B, E, O).  Rank-2 input feeds every expert the same rows; rank-3
+        // gathers each expert's strided rows into a contiguous block so the
+        // register-blocked matmul_bias serves both cases.
+        const size_t e = op.a, din = op.b, dout = op.c;
+        std::vector<float> xin(in.rank == 3 ? batch * din : 0);
+        std::vector<float> tmp(batch * dout);
+        for (size_t ex = 0; ex < e; ++ex) {
+          const float* wk = op.w0.data() + ex * din * dout;
+          const float* wb = op.w1.data() + ex * dout;
+          const float* xsrc = src;
+          if (in.rank == 3) {
+            for (size_t b = 0; b < batch; ++b)
+              std::memcpy(&xin[b * din], src + (b * e + ex) * din,
+                          din * sizeof(float));
+            xsrc = xin.data();
+          }
+          matmul_bias(xsrc, wk, wb, tmp.data(), batch, din, dout);
+          if (op.act != kLinear)
+            apply_act_rows(op.act, tmp.data(), tmp.data(), batch * dout);
+          for (size_t b = 0; b < batch; ++b)
+            std::memcpy(dst + (b * e + ex) * dout, &tmp[b * dout],
+                        dout * sizeof(float));
+        }
+        break;
+      }
+      case kMoeCombine: {
+        const float* h = buf(op.idx[0]);
+        const float* g = buf(op.idx[1]);
+        const Shape& hs = m.shapes[op.idx[0]];
+        const size_t e = hs.d1, hd = hs.d2;
+        for (size_t b = 0; b < batch; ++b) {
+          float* o = dst + b * hd;
+          std::fill(o, o + hd, 0.0f);
+          for (size_t ex = 0; ex < e; ++ex) {
+            const float gv = g[b * e + ex];
+            const float* hrow = h + (b * e + ex) * hd;
+            for (size_t k = 0; k < hd; ++k) o[k] += gv * hrow[k];
+          }
+        }
+        break;
+      }
       default:
         return 2;
     }

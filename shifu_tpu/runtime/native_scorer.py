@@ -20,12 +20,13 @@ from typing import Optional, Sequence
 import numpy as np
 
 _ACT_IDS = {"linear": 0, None: 0, "": 0, "sigmoid": 1, "tanh": 2,
-            "relu": 3, "leakyrelu": 4, "gelu": 5}
+            "relu": 3, "leakyrelu": 4, "gelu": 5, "softmax": 6}
 
 _OP_CODES = {"dense": 0, "gather_cols": 1, "embed_lookup": 2,
              "numeric_embed": 3, "concat": 4, "flatten": 5, "sum_fields": 6,
              "add": 7, "fm_pair": 8, "activation": 9, "cls_prepend": 10,
-             "layernorm": 11, "select_token": 12, "transformer_block": 13}
+             "layernorm": 11, "select_token": 12, "transformer_block": 13,
+             "expert_dense": 14, "moe_combine": 15}
 
 _MAGIC = 0x55464853  # "SHFU"
 _VERSION = 2  # model.bin format — must match kVersion in shifu_scorer.cc
@@ -78,7 +79,8 @@ def pack_native(export_dir: str) -> str:
         src = bid(op["src"]) if "src" in op else (prev_dst if records else 0)
         dst = bid(op["out"]) if "out" in op else bid(f"__chain{len(records)}")
         parts = [struct.pack("<3I", code, dst,
-                             _NO_BUF if kind in ("concat", "add") else src)]
+                             _NO_BUF if kind in ("concat", "add",
+                                                 "moe_combine") else src)]
         if kind == "dense":
             kernel, bias = weights[op["kernel"]], weights[op["bias"]]
             if kernel.ndim != 2 or bias.shape != (kernel.shape[1],):
@@ -109,7 +111,7 @@ def pack_native(export_dir: str) -> str:
             parts.append(struct.pack("<2I", w.shape[0], w.shape[1]))
             parts.append(np.ascontiguousarray(w).tobytes())
             parts.append(np.ascontiguousarray(b).tobytes())
-        elif kind in ("concat", "add"):
+        elif kind in ("concat", "add", "moe_combine"):
             srcs = np.asarray([bid(s) for s in op["srcs"]], np.uint32)
             parts.append(struct.pack("<I", len(srcs)))
             parts.append(srcs.tobytes())
@@ -128,6 +130,17 @@ def pack_native(export_dir: str) -> str:
             parts.append(np.ascontiguousarray(bias).tobytes())
         elif kind == "select_token":
             parts.append(struct.pack("<I", int(op["index"])))
+        elif kind == "expert_dense":
+            kernel = weights[op["kernel"]]   # (E, I, O)
+            bias = weights[op["bias"]]       # (E, O)
+            if kernel.ndim != 3 or bias.shape != (kernel.shape[0],
+                                                  kernel.shape[2]):
+                raise ValueError(f"bad shapes for {op['kernel']}: "
+                                 f"{kernel.shape} / {bias.shape}")
+            parts.append(struct.pack("<4I", _act_id(op.get("activation")),
+                                     *kernel.shape))
+            parts.append(np.ascontiguousarray(kernel).tobytes())
+            parts.append(np.ascontiguousarray(bias).tobytes())
         elif kind == "transformer_block":
             d = weights[op["ln_attn_scale"]].shape[0]
             mh = weights[op["mlp_in_kernel"]].shape[1]

@@ -121,6 +121,10 @@ def init_state(job: JobConfig, num_features: int,
             rules += ((pattern, P(*axes)),)
         if job.runtime.mesh.model > 1:
             rules += tuple(shard_lib.DEFAULT_RULES)
+            if job.model.model_type == "moe_mlp":
+                # expert parallelism: stacked expert trunks shard by expert
+                # over `model`; XLA inserts the psum of the gated combine
+                rules += ((r".*\bexperts/.*", P("model")),)
         if (job.model.pipeline_stages > 1
                 and int(mesh.shape.get("pipe", 1)) > 1):
             # stacked trunk layers shard by stage: each device holds (and

@@ -238,6 +238,9 @@ def test_delimiter_normalization_and_mismatch_error():
     assert _norm_delimiter("\\t") == "\t"
     assert _norm_delimiter(",") == ","
     assert _norm_delimiter(None) == "|"
+    from shifu_tpu.config import ConfigError
+    with pytest.raises(ConfigError, match="character class"):
+        _norm_delimiter("\\s")
 
     # wrong delimiter -> self-diagnosing error, not a bare IndexError
     import numpy as np

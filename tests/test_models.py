@@ -76,7 +76,8 @@ def test_multitask_learns_both_heads():
 def test_all_ladder_models_forward_shapes():
     schema = synthetic.make_schema(num_features=8, num_categorical=3, vocab_size=10)
     feats = jnp.asarray(synthetic.make_rows(16, schema, seed=1)[:, 1:9])
-    for model_type in ("mlp", "wide_deep", "deepfm", "ft_transformer"):
+    for model_type in ("mlp", "wide_deep", "deepfm", "ft_transformer",
+                       "moe_mlp"):
         spec = ModelSpec(model_type=model_type, hidden_nodes=(8,),
                          activations=("relu",), embedding_dim=4,
                          token_dim=16, num_attention_heads=4, num_layers=1,
@@ -180,3 +181,11 @@ def test_shifu_remat_string_values():
     assert parse_bool("true") and parse_bool("1") and parse_bool(True)
     assert not parse_bool("false") and not parse_bool("0")
     assert not parse_bool("no") and not parse_bool(False)
+
+
+def test_moe_mlp_learns():
+    schema = synthetic.make_schema(num_features=10)
+    job = _job(schema, "moe_mlp", epochs=6, num_experts=4)
+    train_ds, valid_ds = _datasets(schema)
+    result = train(job, train_ds, valid_ds, console=lambda s: None)
+    assert result.history[-1].valid_auc > 0.62, result.history[-1]

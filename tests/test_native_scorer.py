@@ -107,7 +107,7 @@ def test_native_single_row_double_contract(artifact_dir):
 
 
 @pytest.mark.parametrize("model_type", ["wide_deep", "deepfm", "multitask",
-                                        "ft_transformer"])
+                                        "ft_transformer", "moe_mlp"])
 def test_native_full_ladder(tmp_path, model_type):
     """Every ladder model lowers to the v2 op-list and scores natively at
     float32-roundoff parity with both the numpy interpreter and the Flax

@@ -218,3 +218,46 @@ def test_sharding_rules_json_roundtrip_and_bad_regex(eight_devices):
     ).validate()
     with pytest.raises(ConfigError, match="bad path regex"):
         init_state(bad, 4, mesh)
+
+
+def test_expert_parallel_matches_single_device(eight_devices):
+    """True expert parallelism: moe_mlp's stacked expert trunks shard by
+    expert over the model axis (each device computes only its experts),
+    optimizer slots follow, and the update equals the single-device one."""
+    from shifu_tpu.config import (DataConfig, JobConfig, ModelSpec,
+                                  OptimizerConfig, TrainConfig)
+    from shifu_tpu.config.schema import RuntimeConfig
+
+    schema = synthetic.make_schema(num_features=12)
+    mesh_cfg = MeshConfig(data=2, model=4)
+    job = JobConfig(
+        schema=schema, data=DataConfig(batch_size=32),
+        model=ModelSpec(model_type="moe_mlp", hidden_nodes=(16, 8),
+                        activations=("relu", "relu"), num_experts=4,
+                        compute_dtype="float32"),
+        train=TrainConfig(epochs=1, loss="weighted_mse",
+                          optimizer=OptimizerConfig(name="adadelta",
+                                                    learning_rate=0.05)),
+        runtime=RuntimeConfig(mesh=mesh_cfg),
+    ).validate()
+    mesh = make_mesh(mesh_cfg, devices=eight_devices)
+    state = init_state(job, 12, mesh)
+    ek = state.params["experts/kernel0"]
+    assert ek.sharding.spec[0] == "model", ek.sharding.spec
+    slots = [l.sharding.spec for l in jax.tree_util.tree_leaves(state.opt_state)
+             if getattr(l, "shape", None) == ek.shape]
+    assert slots and all(s[0] == "model" for s in slots)
+
+    rows = synthetic.make_rows(32, schema, seed=4)
+    batch_np = reader.project_columns(rows, schema)
+    step = make_train_step(job, mesh, donate=False)
+    new_ep, m_ep = step(state, shard_batch(batch_np, mesh))
+
+    state1 = init_state(job, 12)
+    step1 = make_train_step(job, donate=False)
+    new1, m1 = step1(state1, {k: jnp.asarray(v) for k, v in batch_np.items()})
+    assert float(m1["loss"]) == pytest.approx(float(m_ep["loss"]), rel=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(new1.params),
+                    jax.tree_util.tree_leaves(new_ep.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
