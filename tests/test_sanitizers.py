@@ -82,11 +82,13 @@ def packed_model(tmp_path_factory):
     from shifu_tpu.runtime import pack_native
     from shifu_tpu.train import init_state
 
+    # moe_mlp covers the widest op set (dense, softmax activation,
+    # expert_dense, moe_combine), so mutations reach every record reader
     schema = synthetic.make_schema(num_features=8)
     job = JobConfig(
         schema=schema, data=DataConfig(batch_size=32),
-        model=ModelSpec(model_type="mlp", hidden_nodes=(16,),
-                        activations=("relu",)),
+        model=ModelSpec(model_type="moe_mlp", hidden_nodes=(16, 8),
+                        activations=("relu", "tanh"), num_experts=3),
         train=TrainConfig(epochs=1, loss="weighted_mse",
                           optimizer=OptimizerConfig(name="adadelta")),
     ).validate()
