@@ -69,6 +69,17 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--globalconfig", default=None,
                    help="Hadoop-style XML (shifu.security.* for secured HDFS)")
 
+    x = sub.add_parser(
+        "export", help="re-export the scoring artifact from a checkpoint "
+                       "(no retraining; crash-after-train recovery)")
+    x.add_argument("--modelconfig", required=True, help="Shifu ModelConfig.json")
+    x.add_argument("--columnconfig", required=True, help="Shifu ColumnConfig.json")
+    x.add_argument("--checkpoint-dir", required=True,
+                   help="orbax checkpoint dir (the job's tmp_model)")
+    x.add_argument("--output", required=True, help="artifact output dir")
+    x.add_argument("--globalconfig", default=None,
+                   help="Hadoop-style XML (same layering as train)")
+
     e = sub.add_parser(
         "eval", help="score labeled rows and report AUC/error (the Shifu "
                      "eval step against this backend's artifacts)")
@@ -318,9 +329,8 @@ def run_train(args) -> int:
               "are per-process)", file=sys.stderr, flush=True)
         return EXIT_FAIL
 
-    from ..export import save_artifact
     from ..parallel import data_parallel_mesh
-    from ..train import make_forward_fn, train
+    from ..train import train
     from .console import ConsoleBoard
 
     if chief:
@@ -417,16 +427,8 @@ def run_train(args) -> int:
             lambda t: t, out_shardings=NamedSharding(mesh, PartitionSpec()))
         params = jax.device_get(replicate(params))
     if chief:
-        forward = make_forward_fn(job)  # meshless rebuild: single-host export
-        export_dir = save_artifact(params, job,
-                                   job.runtime.final_model_path,
-                                   forward_fn=forward)
-        try:
-            from ..runtime import pack_native
-            pack_native(export_dir)
-        except Exception as e:  # native pack is best-effort at train time
-            board(f"native pack skipped: {e}")
-        board(f"model exported to {export_dir}")
+        # make_forward_fn inside: meshless rebuild for single-host export
+        _export_and_pack(params, job, job.runtime.final_model_path, board)
         _write_metrics_jsonl(result, os.path.join(out_dir, "metrics.jsonl"))
         if result.history:
             last = result.history[-1]
@@ -653,6 +655,63 @@ def run_eval(args) -> int:
     return EXIT_OK
 
 
+def _export_and_pack(params, job, out_dir, console) -> str:
+    """The one export sequence (artifact + best-effort native pack) shared
+    by the train tail and the export recovery command — divergence here
+    would give the recovery path different artifacts than training."""
+    from ..export import save_artifact
+    from ..train import make_forward_fn
+
+    export_dir = save_artifact(params, job, out_dir,
+                               forward_fn=make_forward_fn(job))
+    try:
+        from ..runtime import pack_native
+        pack_native(export_dir)
+    except Exception as e:  # native pack is best-effort
+        console(f"native pack skipped: {e}")
+    console(f"model exported to {export_dir}")
+    return export_dir
+
+
+def run_export(args) -> int:
+    """Rebuild the scoring artifact from the newest checkpoint — the
+    recovery path when a job trained but died before (or during) export,
+    and the way to ship a resumed/early-stopped state without retraining."""
+    import jax
+
+    from ..config import job_config_from_shifu
+    from ..train import init_state
+    from ..train import checkpoint as ckpt_lib
+    from ..utils import xmlconfig
+
+    job = job_config_from_shifu(args.modelconfig, args.columnconfig)
+    if args.globalconfig:
+        job = xmlconfig.apply_to_job(
+            job, xmlconfig.parse_configuration_xml(args.globalconfig))
+
+    if not os.path.isdir(args.checkpoint_dir):
+        # restore-only path: never materialize an empty orbax tree at a
+        # typo'd location as a side effect of the manager
+        print(f"no checkpoint directory: {args.checkpoint_dir}",
+              file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    manager = ckpt_lib.make_manager(args.checkpoint_dir)
+    state = init_state(job, job.schema.feature_count)
+    from ..train.loop import restore_latest_any_layout
+    restored = restore_latest_any_layout(manager, state, job,
+                                         lambda s: print(s, flush=True))
+    if restored is None:
+        print(f"no checkpoint found under {args.checkpoint_dir}",
+              file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    r_state, extra, step = restored
+    print(f"exporting checkpoint step {step} "
+          f"(epoch {(extra or {}).get('epoch', '?')})", flush=True)
+    _export_and_pack(jax.device_get(r_state.params), job, args.output,
+                     lambda s: print(s, flush=True))
+    return EXIT_OK
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     _apply_platform_env()
     args = build_parser().parse_args(argv)
@@ -662,6 +721,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_score(args)
     if args.command == "eval":
         return run_eval(args)
+    if args.command == "export":
+        return run_export(args)
     return EXIT_FAIL
 
 

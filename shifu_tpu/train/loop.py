@@ -144,6 +144,23 @@ def init_state(job: JobConfig, num_features: int,
     return state
 
 
+def restore_latest_any_layout(manager, state: TrainState, job: JobConfig,
+                              console: "Console"):
+    """restore_latest with the ft_transformer trunk-layout fallback: returns
+    (state_like, extra, step) or None (no checkpoint); re-raises the original
+    restore error when the checkpoint is genuinely incompatible.  Shared by
+    the train loop's resume and the export CLI's recovery path."""
+    try:
+        return ckpt_lib.restore_latest(
+            manager, jax.tree_util.tree_map(lambda x: x, state),
+            with_extra=True)
+    except Exception:
+        restored = _restore_across_trunk_layout(manager, state, job, console)
+        if restored is None:
+            raise
+        return restored
+
+
 def _restore_across_trunk_layout(manager, state: TrainState, job: JobConfig,
                                  console: "Console"):
     """Resume an ft_transformer run from a checkpoint written with the OTHER
@@ -332,19 +349,7 @@ def train(job: JobConfig,
         manager = ckpt_lib.make_manager(job.runtime.checkpoint.directory,
                                         job.runtime.checkpoint.max_to_keep)
         if job.runtime.checkpoint.resume:
-            try:
-                restored = ckpt_lib.restore_latest(
-                    manager, jax.tree_util.tree_map(lambda x: x, state),
-                    with_extra=True)
-            except Exception:
-                # tree-structure mismatch: the checkpoint may hold the OTHER
-                # ft_transformer trunk layout (per-block vs pipeline-stacked);
-                # anything else (corrupt file, genuinely incompatible model)
-                # must surface, not silently restart from scratch
-                restored = _restore_across_trunk_layout(manager, state, job,
-                                                        console)
-                if restored is None:
-                    raise
+            restored = restore_latest_any_layout(manager, state, job, console)
             if restored is not None:
                 r_state, extra, step = restored
                 state = state.replace(params=r_state.params,

@@ -339,3 +339,63 @@ def test_eval_cli_multi_target_per_head(tmp_path):
         assert h["weighted_error"] is not None
     # head 0 of the per-head block matches the top-level summary
     assert heads[0]["auc"] == summary["auc"]
+
+
+def test_export_cli_from_checkpoint(tmp_path, small_job, small_data):
+    """`shifu-tpu export` rebuilds the artifact from the newest checkpoint
+    without retraining — the crash-after-train recovery path."""
+    import json
+
+    import numpy as np
+
+    from shifu_tpu.config import CheckpointConfig, RuntimeConfig
+    from shifu_tpu.export import load_scorer
+    from shifu_tpu.launcher import cli
+    from shifu_tpu.train import train
+
+    train_ds, valid_ds = small_data
+    ckpt = str(tmp_path / "ckpt")
+    job = small_job.replace(
+        train=small_job.train.__class__(epochs=2,
+                                        optimizer=small_job.train.optimizer),
+        runtime=RuntimeConfig(checkpoint=CheckpointConfig(directory=ckpt)))
+    r = train(job, train_ds, valid_ds, console=lambda s: None)
+
+    # Shifu configs matching small_job's 30-feature schema
+    mc = {"dataSet": {"targetColumnName": "target"},
+          "train": {"numTrainEpochs": 2, "validSetRate": 0.1,
+                    "algorithm": "NN",
+                    "params": {"NumHiddenLayers": 2,
+                               "NumHiddenNodes": [16, 16],
+                               "ActivationFunc": ["tanh", "tanh"],
+                               "Optimizer": "adam",
+                               "LearningRate": 0.003}}}
+    cols = [{"columnNum": 0, "columnName": "target", "columnFlag": "Target"}]
+    cols += [{"columnNum": i, "columnName": f"f{i}", "columnType": "N",
+              "finalSelect": True} for i in range(1, 31)]
+    (tmp_path / "ModelConfig.json").write_text(json.dumps(mc))
+    (tmp_path / "ColumnConfig.json").write_text(json.dumps(cols))
+
+    out = str(tmp_path / "artifact")
+    rc = cli.main(["export", "--modelconfig", str(tmp_path / "ModelConfig.json"),
+                   "--columnconfig", str(tmp_path / "ColumnConfig.json"),
+                   "--checkpoint-dir", ckpt, "--output", out])
+    assert rc == 0
+    scorer = load_scorer(out)
+    scores = np.asarray(scorer.compute_batch(valid_ds.features))
+    # the exported artifact IS the trained state: scores match its forward
+    from shifu_tpu.train import make_eval_step
+    import jax.numpy as jnp
+    want = np.asarray(make_eval_step(job)(r.state, {
+        "features": jnp.asarray(valid_ds.features),
+        "target": jnp.asarray(valid_ds.target),
+        "weight": jnp.asarray(valid_ds.weight)}))
+    np.testing.assert_allclose(scores, want, rtol=1e-4, atol=1e-5)
+
+    rc_missing = cli.main(["export", "--modelconfig",
+                           str(tmp_path / "ModelConfig.json"),
+                           "--columnconfig",
+                           str(tmp_path / "ColumnConfig.json"),
+                           "--checkpoint-dir", str(tmp_path / "nope"),
+                           "--output", out])
+    assert rc_missing == 1
