@@ -71,8 +71,13 @@ def main() -> None:
 
     # pipeline parallelism across the process boundary: mesh data=2 x
     # pipe=2 over the same 4 devices — the GPipe ppermute activation hops
-    # (parallel/pipeline.py) ride gloo here, ICI/DCN on a real slice
-    pp_cfg = MeshConfig(data=2, pipe=2)
+    # (parallel/pipeline.py) ride gloo here, ICI/DCN on a real slice.
+    # `pipe` leads the axis order so it is the OUTERMOST (slowest-varying)
+    # axis: stage peers are then (p0d0,p1d0)/(p0d1,p1d1), i.e. the hops
+    # genuinely cross processes — with the default order the stage pairs
+    # would sit inside one process and prove nothing about gloo
+    pp_cfg = MeshConfig(data=2, pipe=2,
+                        axis_order=("pipe", "data", "seq", "model"))
     pp_schema = synthetic.make_schema(num_features=5, num_categorical=1,
                                       vocab_size=8)
     from shifu_tpu.config.schema import RuntimeConfig
@@ -98,11 +103,41 @@ def main() -> None:
     pp_loss = float(jax.device_get(pp_metrics["loss"]))
     assert np.isfinite(pp_loss), pp_loss
 
+    # expert parallelism across the process boundary: moe_mlp's expert
+    # trunks shard over a model axis spanning both processes; the psum of
+    # the gate-weighted combine rides gloo (ICI/DCN on a real slice).
+    # `model` leads the axis order for the same cross-process reason as
+    # the pipeline block above
+    ep_cfg = MeshConfig(data=2, model=2,
+                        axis_order=("model", "data", "seq", "pipe"))
+    ep_schema = synthetic.make_schema(num_features=6)
+    ep_job = JobConfig(
+        schema=ep_schema,
+        data=DataConfig(batch_size=16),
+        model=ModelSpec(model_type="moe_mlp", hidden_nodes=(8,),
+                        activations=("relu",), num_experts=4,
+                        compute_dtype="float32"),
+        train=TrainConfig(epochs=1, loss="weighted_mse",
+                          optimizer=OptimizerConfig(name="adadelta",
+                                                    learning_rate=0.05)),
+        runtime=RuntimeConfig(mesh=ep_cfg),
+    ).validate()
+    ep_mesh = make_mesh(ep_cfg, jax.devices())
+    ep_state = init_state(ep_job, ep_schema.feature_count, ep_mesh)
+    assert ep_state.params["experts/kernel0"].sharding.spec[0] == "model"
+    ep_rows = synthetic.make_rows(16, ep_schema, seed=2)
+    ep_batch = shard_batch(reader.project_columns(ep_rows, ep_schema), ep_mesh)
+    ep_step = make_train_step(ep_job, ep_mesh, donate=False)
+    _, ep_metrics = ep_step(ep_state, ep_batch)
+    ep_loss = float(jax.device_get(ep_metrics["loss"]))
+    assert np.isfinite(ep_loss), ep_loss
+
     distributed.barrier()
     print("RESULT " + json.dumps({
         "process": jax.process_index(),
         "loss": loss,
         "pp_loss": pp_loss,
+        "ep_loss": ep_loss,
         "chief": distributed.is_chief(),
     }), flush=True)
 

@@ -84,6 +84,11 @@ def test_two_process_train_step_agrees():
     assert np.isfinite(results[0]["pp_loss"])
     assert results[0]["pp_loss"] == pytest.approx(results[1]["pp_loss"],
                                                   rel=1e-6)
+    # expert-parallel step (experts sharded over a model axis spanning both
+    # processes): same-loss agreement proves the cross-process combine psum
+    assert np.isfinite(results[0]["ep_loss"])
+    assert results[0]["ep_loss"] == pytest.approx(results[1]["ep_loss"],
+                                                  rel=1e-6)
     # chief election: exactly process 0
     assert results[0]["chief"] is True and results[1]["chief"] is False
 
