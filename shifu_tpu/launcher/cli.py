@@ -66,6 +66,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--input", required=True, help="rows file (pipe-delimited or .parquet)")
     s.add_argument("--output", default="-", help="output file (- = stdout)")
     s.add_argument("--native", action="store_true", help="use the C++ engine")
+    s.add_argument("--engine", default="auto",
+                   choices=["auto", "native", "numpy", "stablehlo", "jax"],
+                   help="scoring engine tier (auto = best available)")
     s.add_argument("--globalconfig", default=None,
                    help="Hadoop-style XML (shifu.security.* for secured HDFS)")
 
@@ -93,6 +96,9 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--scores-output", default=None,
                    help="also write per-row scores to this file")
     e.add_argument("--native", action="store_true", help="use the C++ engine")
+    e.add_argument("--engine", default="auto",
+                   choices=["auto", "native", "numpy", "stablehlo", "jax"],
+                   help="scoring engine tier (auto = best available)")
     e.add_argument("--globalconfig", default=None,
                    help="Hadoop-style XML (shifu.security.* for secured HDFS)")
     return p
@@ -475,10 +481,33 @@ def _maybe_inject_fault(metrics, board) -> None:
         os._exit(17)
 
 
-def _load_scorer(model_dir: str, native: bool):
-    if native:
+def _load_scorer(model_dir: str, native: bool, engine: str = "auto"):
+    """Pick a scoring engine: `--native` or --engine native = the C++
+    op-list engine; numpy / stablehlo / jax select an explicit tier
+    (debugging, cross-engine verification); auto = best available
+    (export.load_scorer's order).  Raises ValueError with the fix spelled
+    out on contradictory flags or a tier the artifact cannot serve."""
+    if native and engine not in ("auto", "native"):
+        raise ValueError(
+            f"--native contradicts --engine {engine}; drop one of them")
+    if native or engine == "native":
         from ..runtime import NativeScorer
         return NativeScorer(model_dir)
+    if engine == "numpy":
+        from ..export.scorer import Scorer
+        sc = Scorer(model_dir)
+        if not sc.program:
+            raise ValueError(
+                "artifact has no op-list program (model_type="
+                f"{sc.topology.get('model_type')!r}); use --engine "
+                "stablehlo or jax")
+        return sc
+    if engine == "stablehlo":
+        from ..export.scorer import StableHloScorer
+        return StableHloScorer(model_dir)
+    if engine == "jax":
+        from ..export.scorer import JaxScorer
+        return JaxScorer(model_dir)
     from ..export import load_scorer
     return load_scorer(model_dir)
 
@@ -514,7 +543,11 @@ def run_score(args) -> int:
     if rc != EXIT_OK:
         return rc
     rows = reader.read_file(args.input)
-    scorer = _load_scorer(args.model, args.native)
+    try:
+        scorer = _load_scorer(args.model, args.native, args.engine)
+    except ValueError as e:
+        print(f"scorer: {e}", file=sys.stderr, flush=True)
+        return EXIT_FAIL
     feats = _project_features(rows, args.model, scorer)
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     # chunked scoring + incremental writes: peak memory stays bounded by the
@@ -579,7 +612,11 @@ def run_eval(args) -> int:
     if not paths:
         print("eval: no data files found", file=sys.stderr)
         return EXIT_FAIL
-    scorer = _load_scorer(args.model, args.native)
+    try:
+        scorer = _load_scorer(args.model, args.native, args.engine)
+    except ValueError as e:
+        print(f"scorer: {e}", file=sys.stderr, flush=True)
+        return EXIT_FAIL
     # Stream file by file: metrics accumulate out-of-core (exact weighted
     # error; binned weighted AUC over the [0,1] sigmoid range, error <1e-6)
     # so eval-set size is bounded by disk, not RAM — the reference's eval

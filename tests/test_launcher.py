@@ -399,3 +399,53 @@ def test_export_cli_from_checkpoint(tmp_path, small_job, small_data):
                            "--checkpoint-dir", str(tmp_path / "nope"),
                            "--output", out])
     assert rc_missing == 1
+
+
+def test_score_cli_engine_tiers(tmp_path, small_job, small_data):
+    """--engine selects an explicit scorer tier; every tier reproduces the
+    auto tier's scores on the same artifact."""
+    import numpy as np
+
+    from shifu_tpu.export import save_artifact
+    from shifu_tpu.launcher import cli
+    from shifu_tpu.train import init_state, make_forward_fn
+
+    import jax
+
+    state = init_state(small_job, 30)
+    art = str(tmp_path / "artifact")
+    save_artifact(jax.device_get(state.params), small_job, art,
+                  forward_fn=make_forward_fn(small_job))
+    train_ds, _ = small_data
+    rows = train_ds.features[:32]
+    inp = tmp_path / "rows.psv"
+    inp.write_text("\n".join("|".join(f"{v:.6f}" for v in r) for r in rows))
+
+    outs = {}
+    for engine in ("auto", "native", "numpy", "stablehlo", "jax"):
+        out = tmp_path / f"scores_{engine}.txt"
+        rc = cli.main(["score", "--model", art, "--input", str(inp),
+                       "--output", str(out), "--engine", engine])
+        assert rc == 0, engine
+        outs[engine] = np.loadtxt(out)
+    for engine, s in outs.items():
+        np.testing.assert_allclose(s, outs["auto"], rtol=1e-4, atol=1e-5,
+                                   err_msg=engine)
+
+
+def test_score_cli_engine_conflicts_and_missing_program(tmp_path, small_job):
+    import jax
+
+    from shifu_tpu.export import save_artifact
+    from shifu_tpu.launcher import cli
+    from shifu_tpu.train import init_state
+
+    state = init_state(small_job, 30)
+    art = str(tmp_path / "artifact")
+    save_artifact(jax.device_get(state.params), small_job, art)
+    inp = tmp_path / "rows.psv"
+    inp.write_text("|".join(["0.1"] * 30) + "\n")
+
+    rc = cli.main(["score", "--model", art, "--input", str(inp),
+                   "--native", "--engine", "jax"])
+    assert rc == 1  # contradictory flags fail loudly, not silently
