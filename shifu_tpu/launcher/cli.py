@@ -545,7 +545,9 @@ def run_score(args) -> int:
     rows = reader.read_file(args.input)
     try:
         scorer = _load_scorer(args.model, args.native, args.engine)
-    except ValueError as e:
+    except (ValueError, OSError, KeyError) as e:
+        # a tier the artifact cannot serve (missing jaxexport/model_spec)
+        # or contradictory flags: report, don't traceback
         print(f"scorer: {e}", file=sys.stderr, flush=True)
         return EXIT_FAIL
     feats = _project_features(rows, args.model, scorer)
@@ -614,7 +616,9 @@ def run_eval(args) -> int:
         return EXIT_FAIL
     try:
         scorer = _load_scorer(args.model, args.native, args.engine)
-    except ValueError as e:
+    except (ValueError, OSError, KeyError) as e:
+        # a tier the artifact cannot serve (missing jaxexport/model_spec)
+        # or contradictory flags: report, don't traceback
         print(f"scorer: {e}", file=sys.stderr, flush=True)
         return EXIT_FAIL
     # Stream file by file: metrics accumulate out-of-core (exact weighted

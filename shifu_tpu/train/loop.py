@@ -452,6 +452,53 @@ def train(job: JobConfig,
     profile_dir = os.environ.get("SHIFU_TPU_PROFILE_DIR")
     timing_on = bool(os.environ.get("SHIFU_TPU_TIMING")) or job.train.log_every_steps > 0
 
+    # Preemption awareness: on SIGTERM (TPU preemption, scheduler kill) save
+    # a checkpoint at the next safe point and exit 75 (EX_TEMPFAIL) so the
+    # supervisor restarts the job elsewhere — the SPMD successor of hot
+    # standbys absorbing container revocation.  Single-host main thread
+    # only: a multihost gang must NOT catch SIGTERM (one host draining
+    # while its peers keep issuing collectives would deadlock the step, and
+    # divergent exits are worse than the default immediate terminate).
+    import signal as _signal
+    term_flag = {"hit": False}
+    old_term = None
+    if not multihost:
+        try:
+            old_term = _signal.signal(
+                _signal.SIGTERM, lambda *_: term_flag.update(hit=True))
+        except ValueError:
+            pass  # not the main thread (tests/embedded use): no handler
+
+    save_secs = job.runtime.checkpoint.save_every_seconds
+    last_save = time.monotonic()
+
+    def maybe_midtrain_save(epoch: int) -> None:
+        """Mid-epoch save point: time-based cadence + SIGTERM drain.  A
+        mid-epoch save records the CURRENT epoch, so resume replays the
+        interrupted epoch from its start — a bounded re-application window,
+        the price of mid-epoch durability (the reference's Supervisor
+        restore had equally coarse step semantics)."""
+        nonlocal last_save
+        if term_flag["hit"]:
+            if manager is not None:
+                cur = int(jax.device_get(state.step))
+                if ckpt_lib.latest_step(manager) != cur:
+                    ckpt_lib.save(manager, cur, state,
+                                  extra={"epoch": epoch}, block=True)
+                ckpt_lib.finalize(manager)
+                console("SIGTERM: checkpoint saved, exiting for restart")
+            else:
+                console("SIGTERM: exiting (no checkpoint directory)")
+            raise SystemExit(75)
+        if manager is None or save_secs <= 0:
+            return
+        if time.monotonic() - last_save >= save_secs:
+            cur = int(jax.device_get(state.step))
+            if ckpt_lib.latest_step(manager) != cur:  # step already durable?
+                ckpt_lib.save(manager, cur, state, extra={"epoch": epoch},
+                              block=True)
+            last_save = time.monotonic()
+
     history: list[EpochMetrics] = []
     # early stopping (TrainConfig.early_stop_patience): best valid error seen
     # and evaluated epochs since it improved by at least min_delta.  Counters
@@ -526,6 +573,8 @@ def train(job: JobConfig,
                     loss_acc = loss if loss_acc is None else loss_acc + loss
                     loss_n += 1
                     timer.mark_step_done()
+                    if not multihost:  # collectives forbid divergent exits
+                        maybe_midtrain_save(epoch)
         if loss_n == 0:
             raise ValueError(
                 f"epoch {epoch} produced 0 batches "
@@ -563,6 +612,11 @@ def train(job: JobConfig,
             ckpt_lib.save(manager, int(jax.device_get(state.step)), state,
                           extra={"epoch": epoch + 1},
                           block=not job.runtime.checkpoint.async_save)
+            last_save = time.monotonic()
+        if not multihost:
+            # epoch boundary is the safe SIGTERM drain point for the
+            # on-device scan tiers (the epoch itself is one dispatch)
+            maybe_midtrain_save(epoch + 1)
 
         if epoch_callback is not None:
             epoch_callback(m)
@@ -595,6 +649,8 @@ def train(job: JobConfig,
             lambda host, cur: jax.device_put(host, cur.sharding),
             best_params_host, state.params))
     finally:
+      if old_term is not None:
+          _signal.signal(_signal.SIGTERM, old_term)
       if manager is not None:
         # async saves must be durable (and their errors surfaced) no matter
         # how the loop exits — a mid-loop exception must not abandon an

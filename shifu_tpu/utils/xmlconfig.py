@@ -35,6 +35,9 @@ KEY_PS_INSTANCES = "shifu.ps.instances"
 KEY_BACKUP_INSTANCES = "shifu.worker.instances.backup"
 KEY_BATCH_SIZE = "shifu.application.batch-size"
 KEY_MAX_RESTARTS = "shifu.application.max-restarts"
+# time-based checkpoint cadence (reference parity: Supervisor
+# save_model_secs — ssgd.py:124-128)
+KEY_CKPT_SAVE_SECONDS = "shifu.checkpoint.save-seconds"
 KEY_HEARTBEAT_INTERVAL = "shifu.task.heartbeat-interval-ms"
 KEY_MAX_MISSED_HEARTBEATS = "shifu.task.max-missed-heartbeats"
 # device mesh topology (successor of shifu.{ps,worker}.instances container
@@ -137,12 +140,11 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
     runtime = job.runtime
 
     if KEY_EPOCHS in conf:
-        train = train.__class__(
-            epochs=int(conf[KEY_EPOCHS]), loss=train.loss,
-            optimizer=train.optimizer, seed=train.seed,
-            eval_every_epochs=train.eval_every_epochs,
-            log_every_steps=train.log_every_steps,
-            bagging_sample_rate=train.bagging_sample_rate)
+        import dataclasses
+        # replace, not field-by-field reconstruction: an explicit list here
+        # silently dropped newer TrainConfig fields (early stopping) when
+        # the epochs key was set
+        train = dataclasses.replace(train, epochs=int(conf[KEY_EPOCHS]))
     if KEY_BATCH_SIZE in conf:
         import dataclasses
         data = dataclasses.replace(data, batch_size=int(conf[KEY_BATCH_SIZE]))
@@ -183,6 +185,10 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         rt_kw["checkpoint"] = ck
     if KEY_MAX_RESTARTS in conf:
         rt_kw["max_restarts"] = int(conf[KEY_MAX_RESTARTS])
+    if KEY_CKPT_SAVE_SECONDS in conf:
+        ck = rt_kw.get("checkpoint", runtime.checkpoint)
+        rt_kw["checkpoint"] = dataclasses.replace(
+            ck, save_every_seconds=int(conf[KEY_CKPT_SAVE_SECONDS]))
     if KEY_KERBEROS_PRINCIPAL in conf:
         rt_kw["kerberos_principal"] = conf[KEY_KERBEROS_PRINCIPAL]
     if KEY_KERBEROS_KEYTAB in conf:

@@ -222,3 +222,108 @@ def test_incompatible_checkpoint_raises(tmp_path, small_job, small_data):
     job2 = _with_ckpt(bigger, str(tmp_path / "ckpt"), epochs=2)
     with pytest.raises(Exception):
         train(job2, train_ds, valid_ds, console=lambda s: None)
+
+
+def test_time_based_checkpoint_cadence(tmp_path, small_job, small_data):
+    """save_every_seconds adds mid-epoch saves on the per-batch tier —
+    reference parity with Supervisor(save_model_secs=10), ssgd.py:124-128."""
+    import dataclasses
+
+    from shifu_tpu.config import DataConfig
+    from shifu_tpu.train import checkpoint as ckpt_lib
+
+    train_ds, valid_ds = small_data
+    d = str(tmp_path / "ckpt")
+    job = small_job.replace(
+        # per-batch tier (staged off) with a 0-second cadence: every batch
+        # boundary is "due", so mid-epoch steps get checkpointed
+        data=dataclasses.replace(small_job.data, staged=False,
+                                 device_resident_bytes=0),
+        train=small_job.train.__class__(epochs=1,
+                                        optimizer=small_job.train.optimizer),
+        runtime=RuntimeConfig(checkpoint=CheckpointConfig(
+            directory=d, save_every_epochs=1, save_every_seconds=1)))
+    import time as time_mod
+    orig = time_mod.monotonic
+    # monotonic time advances 10s per call: every cadence check fires
+    tick = {"t": 0.0}
+    def fake_monotonic():
+        tick["t"] += 10.0
+        return tick["t"]
+    time_mod.monotonic = fake_monotonic
+    try:
+        train(job, train_ds, valid_ds, console=lambda s: None)
+    finally:
+        time_mod.monotonic = orig
+    mgr = ckpt_lib.make_manager(d)
+    steps = sorted(mgr.all_steps())
+    # mid-epoch steps present, not just the end-of-epoch save
+    assert len(steps) > 1, steps
+
+
+def test_sigterm_saves_and_exits_75(tmp_path, small_job, small_data):
+    """SIGTERM mid-training checkpoints the current state and exits with
+    code 75 so the supervisor restarts the job (preemption awareness)."""
+    import dataclasses
+    import os
+    import signal
+    import threading
+
+    train_ds, valid_ds = small_data
+    d = str(tmp_path / "ckpt")
+    job = small_job.replace(
+        train=small_job.train.__class__(epochs=50,
+                                        optimizer=small_job.train.optimizer),
+        runtime=RuntimeConfig(checkpoint=CheckpointConfig(directory=d)))
+
+    # prewarm jit caches so the handler is installed before the timer fires
+    warm = small_job.replace(train=small_job.train.__class__(
+        epochs=1, optimizer=small_job.train.optimizer))
+    train(warm, train_ds, valid_ds, console=lambda s: None)
+    lines = []
+    killer = threading.Timer(1.5, lambda: os.kill(os.getpid(), signal.SIGTERM))
+    killer.start()
+    try:
+        with pytest.raises(SystemExit) as exc:
+            train(job, train_ds, valid_ds, console=lines.append)
+    finally:
+        killer.cancel()
+    assert exc.value.code == 75
+    assert any("SIGTERM" in l for l in lines)
+    from shifu_tpu.train import checkpoint as ckpt_lib
+    mgr = ckpt_lib.make_manager(d)
+    assert mgr.latest_step() is not None
+    # and the job resumes from that checkpoint
+    job2 = job.replace(train=small_job.train.__class__(
+        epochs=3, optimizer=small_job.train.optimizer),
+        runtime=RuntimeConfig(checkpoint=CheckpointConfig(directory=d)))
+    r = train(job2, train_ds, valid_ds, console=lambda s: None)
+    assert r.resumed_from_epoch >= 1
+
+
+def test_sigterm_without_checkpoint_dir_still_exits(small_job, small_data):
+    """SIGTERM must terminate the run even when no checkpoint manager is
+    configured (the drain point fires without a save)."""
+    import os
+    import signal
+    import threading
+
+    train_ds, valid_ds = small_data
+    job = small_job.replace(train=small_job.train.__class__(
+        epochs=200, optimizer=small_job.train.optimizer))
+    # prewarm the jit caches so train() reaches its handler install well
+    # before the timer fires (a SIGTERM during init takes the default
+    # terminate action, by design)
+    warm = small_job.replace(train=small_job.train.__class__(
+        epochs=1, optimizer=small_job.train.optimizer))
+    train(warm, train_ds, valid_ds, console=lambda s: None)
+    lines = []
+    killer = threading.Timer(1.0, lambda: os.kill(os.getpid(), signal.SIGTERM))
+    killer.start()
+    try:
+        with pytest.raises(SystemExit) as exc:
+            train(job, train_ds, valid_ds, console=lines.append)
+    finally:
+        killer.cancel()
+    assert exc.value.code == 75
+    assert any("no checkpoint directory" in l for l in lines)

@@ -250,3 +250,20 @@ def test_delimiter_normalization_and_mismatch_error():
     one_col = np.full((3, 1), np.nan, np.float32)  # what a bad split yields
     with pytest.raises(ValueError, match="delimiter"):
         reader.project_columns(one_col, schema)
+
+
+def test_xml_epochs_override_preserves_other_train_fields(tmp_path):
+    """shifu.application.epochs must not reset unrelated TrainConfig fields
+    (a field-by-field reconstruction silently dropped early stopping)."""
+    import dataclasses
+
+    from shifu_tpu.config import JobConfig
+    from shifu_tpu.utils import xmlconfig
+
+    job = JobConfig()
+    job = job.replace(train=dataclasses.replace(
+        job.train, early_stop_patience=3, early_stop_min_delta=0.01))
+    out = xmlconfig.apply_to_job(job, {"shifu.application.epochs": "7"})
+    assert out.train.epochs == 7
+    assert out.train.early_stop_patience == 3
+    assert out.train.early_stop_min_delta == 0.01

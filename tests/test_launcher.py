@@ -449,3 +449,22 @@ def test_score_cli_engine_conflicts_and_missing_program(tmp_path, small_job):
     rc = cli.main(["score", "--model", art, "--input", str(inp),
                    "--native", "--engine", "jax"])
     assert rc == 1  # contradictory flags fail loudly, not silently
+
+
+def test_score_cli_unavailable_tier_reports(tmp_path, small_job):
+    """A tier the artifact cannot serve exits 1 with a message, not a
+    traceback (e.g. stablehlo without scoring.jaxexport)."""
+    import jax
+
+    from shifu_tpu.export import save_artifact
+    from shifu_tpu.launcher import cli
+    from shifu_tpu.train import init_state
+
+    state = init_state(small_job, 30)
+    art = str(tmp_path / "artifact")
+    save_artifact(jax.device_get(state.params), small_job, art)  # no forward_fn
+    inp = tmp_path / "rows.psv"
+    inp.write_text("|".join(["0.1"] * 30) + "\n")
+    rc = cli.main(["score", "--model", art, "--input", str(inp),
+                   "--engine", "stablehlo"])
+    assert rc == 1
