@@ -71,17 +71,46 @@ def _norm_delimiter(value: Any) -> str:
     literal characters ("\\|" -> "|", "\\t" -> tab); empty/missing means
     the pipe default.  Regex character classes ("\\s", "\\d", ...) have no
     literal-delimiter equivalent and are rejected up front rather than
-    silently splitting rows on a letter."""
+    silently splitting rows on a letter; likewise anything that unescapes
+    to more than one character (e.g. "\\|\\|") is a regex pattern, not a
+    delimiter, and would silently split nothing if taken literally."""
     d = str(value or "|")
-    if len(d) == 2 and d[0] == "\\":
-        if d[1] == "t":
-            return "\t"
-        if not d[1].isalnum():  # escaped punctuation: the literal char
-            return d[1]
+    out: list[str] = []
+    unescaped_meta = False
+    i = 0
+    while i < len(d):
+        c = d[i]
+        if c == "\\" and i + 1 < len(d):
+            nxt = d[i + 1]
+            if nxt == "t":
+                out.append("\t")
+            elif not nxt.isalnum():  # escaped punctuation: the literal char
+                out.append(nxt)
+            else:
+                raise ConfigError(
+                    f"dataSet.dataDelimiter {d!r} contains the regex "
+                    f"character class \\{nxt}; use a literal delimiter "
+                    "character instead")
+            i += 2
+            continue
+        if c in "|.*+?()[]{}^$":
+            unescaped_meta = True
+        out.append(c)
+        i += 1
+    lit = "".join(out)
+    # metachar-free multi-char strings ("::", or fully escaped "\\|\\|")
+    # are literal delimiters under Java regex too — the reader's multi-char
+    # split path handles them.  Multi-char strings with UNESCAPED
+    # metacharacters ("||" = alternation) are genuine regex patterns with
+    # no literal-delimiter equivalent: reject rather than split on the
+    # wrong literal.  (A lone unescaped metachar keeps its historical
+    # literal reading — "|" is the default delimiter.)
+    if len(lit) > 1 and unescaped_meta:
         raise ConfigError(
-            f"dataSet.dataDelimiter {d!r} is a regex character class; use a "
-            "literal delimiter character instead")
-    return d or "|"
+            f"dataSet.dataDelimiter {d!r} is a multi-character regex "
+            "pattern with unescaped metacharacters; escape them "
+            "(e.g. '\\\\|\\\\|') or use a literal delimiter")
+    return lit
 
 
 def _norm_activation(name: Optional[str]) -> str:

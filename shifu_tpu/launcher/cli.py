@@ -545,7 +545,7 @@ def run_score(args) -> int:
     rows = reader.read_file(args.input)
     try:
         scorer = _load_scorer(args.model, args.native, args.engine)
-    except (ValueError, OSError, KeyError) as e:
+    except (ValueError, OSError, KeyError, RuntimeError) as e:
         # a tier the artifact cannot serve (missing jaxexport/model_spec)
         # or contradictory flags: report, don't traceback
         print(f"scorer: {e}", file=sys.stderr, flush=True)
@@ -616,7 +616,7 @@ def run_eval(args) -> int:
         return EXIT_FAIL
     try:
         scorer = _load_scorer(args.model, args.native, args.engine)
-    except (ValueError, OSError, KeyError) as e:
+    except (ValueError, OSError, KeyError, RuntimeError) as e:
         # a tier the artifact cannot serve (missing jaxexport/model_spec)
         # or contradictory flags: report, don't traceback
         print(f"scorer: {e}", file=sys.stderr, flush=True)

@@ -156,10 +156,17 @@ def place_opt_state(opt_state: PyTree, params: PyTree, mesh: Mesh,
         if not hasattr(leaf, "shape"):
             return leaf
         keys = tuple(str(k) for k in kp)
+        # optax slots embed the param tree, so a slot's path always ends
+        # with its param's FULL path; shorter suffixes can collide with an
+        # unrelated same-named, same-shaped param (e.g. a 1-key ('kernel',)
+        # suffix hitting a top-level param) — take the longest param-path
+        # suffix only, never fall back to shorter ones
         for n in range(len(keys), 0, -1):
             hit = by_path.get(keys[-n:])
-            if hit is not None and hit[0] == leaf.shape:
-                return jax.device_put(leaf, hit[1])
+            if hit is not None:
+                if hit[0] == leaf.shape:
+                    return jax.device_put(leaf, hit[1])
+                break
         return jax.device_put(leaf, replicated(mesh))
 
     return jax.tree_util.tree_map_with_path(place, opt_state)

@@ -352,11 +352,22 @@ def train(job: JobConfig,
             restored = restore_latest_any_layout(manager, state, job, console)
             if restored is not None:
                 r_state, extra, step = restored
+                fresh_opt = state.opt_state  # before the restore discards it
                 state = state.replace(params=r_state.params,
                                       opt_state=r_state.opt_state,
                                       step=r_state.step)
                 start_epoch = int((extra or {}).get("epoch", 0))
                 console(f"Resumed from checkpoint step {step} (epoch {start_epoch})")
+                if ((extra or {}).get("best_restored")
+                        and start_epoch < job.train.epochs):
+                    # the terminal checkpoint's params were rolled back to
+                    # the best-measured epoch, but its optimizer moments
+                    # belong to the LAST trajectory — continuing training
+                    # (epochs budget raised) with that pairing would apply
+                    # mismatched updates; restart the optimizer fresh
+                    state = state.replace(opt_state=fresh_opt)
+                    console("Resuming past a best-params terminal "
+                            "checkpoint: optimizer state reinitialized")
 
     # multi-host: every process holds a disjoint file shard, so batches are
     # assembled process-locally into global arrays and the step count is
@@ -603,15 +614,57 @@ def train(job: JobConfig,
         if timing_on:
             console(timer.console_line())
 
+        # early-stopping bookkeeping runs BEFORE the terminal checkpoint
+        # save so that checkpoint holds the same best-measured params the
+        # returned/exported state does — the export CLI recovery path
+        # restores from the checkpoint, and it must ship the same artifact
+        # the train tail exports (docs/CONFIG.md "best params are restored")
+        patience = job.train.early_stop_patience
+        early_stop_now = False
+        if patience > 0 and valid_error == valid_error:  # evaluated, not NaN
+            if valid_error < best_valid - job.train.early_stop_min_delta:
+                best_valid = valid_error
+                evals_since_best = 0
+                best_params_host = jax.device_get(state.params)
+            else:
+                evals_since_best += 1
+                if evals_since_best >= patience:
+                    early_stop_now = True
+                    console(f"Early stop at epoch {epoch}: no valid_error "
+                            f"improvement > {job.train.early_stop_min_delta:g} "
+                            f"in {patience} evaluated epochs "
+                            f"(best {best_valid:.6f})")
+
+        terminal = early_stop_now or epoch == job.train.epochs - 1
+        best_restored = False
+        if (terminal and best_params_host is not None
+                and best_valid < float("inf")):
+            best_restored = True
+            # restore the best-measured params (same shardings as the
+            # current state's leaves) before the terminal save, so
+            # checkpoint, returned state, and export all agree.  The
+            # terminal checkpoint records epoch=epochs (training COMPLETE,
+            # even when stopping early): the rolled-back params ride with
+            # the last trajectory's optimizer moments, so resuming training
+            # from this state would apply mismatched updates — an
+            # early-stopped run must resume as done, not as epoch E+1
+            state = state.replace(params=jax.tree_util.tree_map(
+                lambda host, cur: jax.device_put(host, cur.sharding),
+                best_params_host, state.params))
+
         # save before the callback so external kills (timeout, fault
         # injection, preemption) never lose the completed epoch; async_save
         # trades that guarantee for overlap with the next epoch's compute
         if manager is not None and (
-                (epoch + 1) % job.runtime.checkpoint.save_every_epochs == 0
-                or epoch == job.train.epochs - 1):
+                terminal
+                or (epoch + 1) % job.runtime.checkpoint.save_every_epochs == 0):
+            extra = {"epoch": (job.train.epochs if terminal else epoch + 1)}
+            if best_restored:
+                extra["best_restored"] = True
             ckpt_lib.save(manager, int(jax.device_get(state.step)), state,
-                          extra={"epoch": epoch + 1},
-                          block=not job.runtime.checkpoint.async_save)
+                          extra=extra,
+                          block=(early_stop_now
+                                 or not job.runtime.checkpoint.async_save))
             last_save = time.monotonic()
         if not multihost:
             # epoch boundary is the safe SIGTERM drain point for the
@@ -621,33 +674,8 @@ def train(job: JobConfig,
         if epoch_callback is not None:
             epoch_callback(m)
 
-        patience = job.train.early_stop_patience
-        if patience > 0 and valid_error == valid_error:  # evaluated, not NaN
-            if valid_error < best_valid - job.train.early_stop_min_delta:
-                best_valid = valid_error
-                evals_since_best = 0
-                best_params_host = jax.device_get(state.params)
-            else:
-                evals_since_best += 1
-                if evals_since_best >= patience:
-                    console(f"Early stop at epoch {epoch}: no valid_error "
-                            f"improvement > {job.train.early_stop_min_delta:g} "
-                            f"in {patience} evaluated epochs "
-                            f"(best {best_valid:.6f})")
-                    # the break below skips the loop's end-of-training save;
-                    # persist the stopping state so resume/export never fall
-                    # back to an older checkpoint
-                    if manager is not None:
-                        ckpt_lib.save(manager,
-                                      int(jax.device_get(state.step)), state,
-                                      extra={"epoch": epoch + 1}, block=True)
-                    break
-      if best_params_host is not None and best_valid < float("inf"):
-        # restore the best-measured params (same shardings as the current
-        # state's leaves) for the returned / exported model
-        state = state.replace(params=jax.tree_util.tree_map(
-            lambda host, cur: jax.device_put(host, cur.sharding),
-            best_params_host, state.params))
+        if early_stop_now:
+            break
     finally:
       if old_term is not None:
           _signal.signal(_signal.SIGTERM, old_term)

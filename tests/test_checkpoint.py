@@ -57,6 +57,51 @@ def test_resume_continues_training(tmp_path, small_job, small_data):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
 
 
+def test_terminal_checkpoint_holds_best_params(tmp_path, small_job, small_data):
+    """With early stopping, the checkpoint written at the stop must hold the
+    same best-measured params the returned state does — the export CLI's
+    recovery path restores from that checkpoint and must ship the identical
+    artifact the train tail exports (ADVICE round 1, train/loop.py)."""
+    import dataclasses
+
+    from shifu_tpu.train import checkpoint as ckpt_lib
+
+    train_ds, valid_ds = small_data
+    d = str(tmp_path / "ckpt")
+    opt = dataclasses.replace(small_job.train.optimizer, name="sgd",
+                              learning_rate=50.0)  # bounces: best != last
+    job = _with_ckpt(small_job, d, epochs=6)
+    job = job.replace(train=dataclasses.replace(
+        job.train, optimizer=opt, early_stop_patience=2))
+    result = train(job, train_ds, valid_ds, console=lambda s: None)
+    assert len(result.history) < 6  # early stop actually fired
+
+    mgr = ckpt_lib.make_manager(d)
+    restored, _ = ckpt_lib.restore_latest(mgr, result.state)
+    for a, b in zip(jax.tree_util.tree_leaves(restored.params),
+                    jax.tree_util.tree_leaves(result.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # an early-stopped run is COMPLETE: re-running must resume as done (the
+    # rolled-back params carry the last trajectory's optimizer moments, so
+    # continuing training from them would apply mismatched updates)
+    r2 = train(job, *small_data, console=lambda s: None)
+    assert r2.resumed_from_epoch == 6
+    assert len(r2.history) == 0
+
+    # raising the epochs budget past the terminal checkpoint continues
+    # training — with a FRESH optimizer (the saved moments belong to the
+    # last trajectory, not the rolled-back best params)
+    job10 = job.replace(train=dataclasses.replace(job.train, epochs=10,
+                                                  early_stop_patience=0))
+    lines = []
+    r3 = train(job10, *small_data, console=lines.append)
+    assert r3.resumed_from_epoch == 6
+    assert any("optimizer state reinitialized" in l for l in lines)
+    assert len(r3.history) == 4
+    assert np.isfinite(r3.history[-1].train_error)
+
+
 def test_resume_disabled(tmp_path, small_job, small_data):
     train_ds, valid_ds = small_data
     d = str(tmp_path / "ckpt")

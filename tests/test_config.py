@@ -241,6 +241,15 @@ def test_delimiter_normalization_and_mismatch_error():
     from shifu_tpu.config import ConfigError
     with pytest.raises(ConfigError, match="character class"):
         _norm_delimiter("\\s")
+    # fully-escaped / metachar-free multi-char strings are literal
+    # delimiters; unescaped-metachar multi-char strings are regex patterns
+    # with no literal equivalent and must fail loudly
+    assert _norm_delimiter("\\|\\|") == "||"
+    assert _norm_delimiter("::") == "::"
+    with pytest.raises(ConfigError, match="multi-character"):
+        _norm_delimiter("||")
+    with pytest.raises(ConfigError, match="multi-character"):
+        _norm_delimiter("a|b")
 
     # wrong delimiter -> self-diagnosing error, not a bare IndexError
     import numpy as np

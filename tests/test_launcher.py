@@ -468,3 +468,30 @@ def test_score_cli_unavailable_tier_reports(tmp_path, small_job):
     rc = cli.main(["score", "--model", art, "--input", str(inp),
                    "--engine", "stablehlo"])
     assert rc == 1
+
+
+def test_score_cli_bad_native_artifact_reports(tmp_path, small_job):
+    """A corrupt/unloadable native model.bin exits 1 with the clean
+    'scorer: ...' message instead of a RuntimeError traceback (ADVICE
+    round 1, launcher/cli.py)."""
+    import struct
+
+    import jax
+
+    from shifu_tpu.export import save_artifact
+    from shifu_tpu.launcher import cli
+    from shifu_tpu.runtime import native_scorer as ns
+    from shifu_tpu.train import init_state
+
+    state = init_state(small_job, 30)
+    art = str(tmp_path / "artifact")
+    save_artifact(jax.device_get(state.params), small_job, art)
+    # current magic+version so NativeScorer skips the repack path, but a
+    # truncated body the C loader must reject
+    with open(tmp_path / "artifact" / ns.MODEL_BIN, "wb") as f:
+        f.write(struct.pack("<2I", ns._MAGIC, ns._VERSION))
+    inp = tmp_path / "rows.psv"
+    inp.write_text("|".join(["0.1"] * 30) + "\n")
+    rc = cli.main(["score", "--model", art, "--input", str(inp),
+                   "--engine", "native"])
+    assert rc == 1

@@ -121,6 +121,35 @@ def test_opt_state_follows_param_sharding(eight_devices):
     assert opt_specs and all(s[0] == "model" for s in opt_specs), opt_specs
 
 
+def test_opt_state_no_short_suffix_collision(eight_devices):
+    """place_opt_state must match a slot to its param by FULL path suffix
+    only: a slot whose path ends with ('kernel',) for a deep param must not
+    inherit the sharding of an unrelated top-level 'kernel' param of equal
+    shape (ADVICE round 1, parallel/sharding.py)."""
+    import numpy as np
+
+    from shifu_tpu.parallel.sharding import place_opt_state
+
+    mesh = make_mesh(MeshConfig(data=4, model=2), devices=eight_devices)
+    # top-level 'kernel' sharded over model; nested dense/kernel replicated
+    # and a DIFFERENT shape than the top-level param
+    params = {
+        "kernel": np.zeros((64, 8), np.float32),
+        "dense": {"kernel": np.zeros((32, 8), np.float32)},
+    }
+    rules = ((r"^\['kernel'\]$", ("model", None)),)
+    # a slot whose longest param-path suffix ('dense','kernel') exists but
+    # whose shape does not match it (factored-optimizer style): it must
+    # replicate, NOT fall through to the 1-key ('kernel',) suffix whose
+    # unrelated top-level param happens to have the matching (64, 8) shape
+    opt_state = ({"kernel": np.zeros((64, 8), np.float32),
+                  "dense": {"kernel": np.zeros((64, 8), np.float32)}},)
+    placed = place_opt_state(opt_state, params, mesh, rules=rules)
+    assert placed[0]["kernel"].sharding.spec[0] == "model"
+    nested_spec = placed[0]["dense"]["kernel"].sharding.spec
+    assert len(nested_spec) == 0 or nested_spec[0] is None, nested_spec
+
+
 def test_multi_epoch_sharded_training_learns(small_job, eight_devices):
     """Full loop over the mesh: learns on synthetic data like single-device."""
     from shifu_tpu.train import train as train_fn
