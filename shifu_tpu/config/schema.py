@@ -382,6 +382,14 @@ class RuntimeConfig:
     app_name: str = "shifu_tpu"
     timeout_seconds: int = 0        # 0: no timeout; reference client kills the YARN app on timeout (TensorflowClient.java:625-658)
     max_restarts: int = 2           # checkpoint-restart budget; successor of backup-worker promotion (TensorflowApplicationMaster.java:410-426)
+    # Supervisor liveness window (`shifu.liveness.seconds`): if the console
+    # board stops growing for this long the child is presumed hung, killed,
+    # and restarted (charging the restart budget) — successor of the AM's
+    # heartbeat-expiry monitor (TensorflowApplicationMaster.java:63-112,
+    # 1s x 25 misses).  Default 0 = off: the board is written once per
+    # EPOCH, so a sane window must exceed the job's epoch time — a fixed
+    # 25s default would false-kill any long epoch.
+    liveness_seconds: float = 0.0
     final_model_path: str = ""      # FINAL_MODEL_PATH env in the reference
     tmp_model_path: str = ""        # TMP_MODEL_PATH env in the reference
     # Kerberos for secured HDFS access — successor of the reference client's

@@ -293,13 +293,14 @@ def run_train(args) -> int:
         from .supervisor import supervise
         out_dir = _resolve_out_dir(args)
         os.makedirs(out_dir, exist_ok=True)
+        sup_job = _assemble_job(args, write_files=False)[0]
         max_restarts = (args.max_restarts if args.max_restarts >= 0
-                        else _assemble_job(args, write_files=False)[0]
-                        .runtime.max_restarts)
+                        else sup_job.runtime.max_restarts)
         child_args = _child_train_args(
             args, out_dir, num_processes=getattr(args, "num_processes", 0))
         return supervise(child_args, max_restarts=max_restarts,
-                         board_path=os.path.join(out_dir, "console.board"))
+                         board_path=os.path.join(out_dir, "console.board"),
+                         liveness_seconds=sup_job.runtime.liveness_seconds)
 
     if getattr(args, "num_processes", 0) > 1:
         return _spawn_processes(args, _resolve_out_dir(args))
@@ -479,6 +480,13 @@ def _maybe_inject_fault(metrics, board) -> None:
     if fault_epoch is not None and metrics.epoch == int(fault_epoch):
         board(f"FAULT INJECTION: killing process after epoch {metrics.epoch}")
         os._exit(17)
+    # hang (vs crash) injection: stall forever after epoch k so the
+    # supervisor's board-progress liveness monitor has something to detect
+    hang_epoch = os.environ.get("SHIFU_TPU_HANG_EPOCH")
+    if hang_epoch is not None and metrics.epoch == int(hang_epoch):
+        board(f"HANG INJECTION: stalling after epoch {metrics.epoch}")
+        while True:
+            time.sleep(3600)
 
 
 def _load_scorer(model_dir: str, native: bool, engine: str = "auto"):

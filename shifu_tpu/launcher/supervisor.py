@@ -19,8 +19,11 @@ import sys
 import time
 from typing import Optional, Sequence
 
-DEFAULT_LIVENESS_SECONDS = 25.0  # reference: 1s heartbeat x 25 allowed misses
-                                 # (GlobalConfigurationKeys.java:76-79)
+# The reference's default liveness window was 1s heartbeat x 25 allowed
+# misses (GlobalConfigurationKeys.java:76-79).  Here the heartbeat is the
+# per-EPOCH board write, so a fixed 25s default would false-kill any epoch
+# longer than that; liveness is off unless `shifu.liveness.seconds` (or the
+# reference heartbeat key pair) sets a window sized to the job's epochs.
 
 
 def supervise(child_argv: Sequence[str],
@@ -34,9 +37,11 @@ def supervise(child_argv: Sequence[str],
     Returns the child's final exit code (0 on eventual success).  A child that
     fails (nonzero exit / killed) is restarted up to max_restarts times;
     checkpoint auto-resume makes the restart continue, not repeat.  If
-    liveness_seconds > 0 and the board file stops growing for that long, the
-    child is presumed hung, killed, and the restart budget is charged —
-    heartbeat-expiry parity.
+    liveness_seconds > 0 and the board file stops growing for that long
+    (a still-missing board counts as no progress, catching children wedged
+    before their first write), the child is presumed hung, killed, and the
+    restart budget is charged — heartbeat-expiry parity.  Size the window
+    above startup (jax import + first compile) plus one epoch.
     """
     python = python or sys.executable
     cmd = [python, "-m", "shifu_tpu.launcher.cli", *child_argv]
@@ -52,8 +57,14 @@ def supervise(child_argv: Sequence[str],
             rc = proc.poll()
             if rc is not None:
                 break
-            if liveness_seconds > 0 and board_path and os.path.exists(board_path):
-                size = os.path.getsize(board_path)
+            if liveness_seconds > 0 and board_path:
+                # a missing board counts as "no progress since attempt
+                # start": a child wedged BEFORE its first board write (a
+                # stuck distributed rendezvous, a hung kinit) must be
+                # detected too — the window therefore has to cover startup
+                # (jax import + first compile) as well as an epoch
+                size = (os.path.getsize(board_path)
+                        if os.path.exists(board_path) else -1)
                 if size != last_size:
                     last_size = size
                     last_progress = time.monotonic()

@@ -47,12 +47,15 @@ class ShifuDense(nn.Module):
 
 class MLPTrunk(nn.Module):
     """The hidden stack from ModelConfig (NumHiddenLayers/NumHiddenNodes/
-    ActivationFunc — reference: ssgd_monitor.py:93-110)."""
+    ActivationFunc — reference: ssgd_monitor.py:93-110).  When
+    `spec.dropout_rate > 0` (ModelConfig DropoutRate) each hidden layer's
+    activation is followed by dropout, active only under `train=True` —
+    eval/export stay deterministic."""
 
     spec: ModelSpec
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         for i, (n, act) in enumerate(zip(self.spec.hidden_nodes, self.spec.activations)):
             x = ShifuDense(
                 features=n,
@@ -62,6 +65,9 @@ class MLPTrunk(nn.Module):
                 compute_dtype=self.spec.compute_dtype,
                 name=f"hidden_layer{i}",
             )(x)
+            if self.spec.dropout_rate > 0:
+                x = nn.Dropout(self.spec.dropout_rate,
+                               deterministic=not train)(x)
         return x
 
 

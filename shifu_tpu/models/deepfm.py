@@ -33,7 +33,6 @@ class DeepFM(nn.Module):
 
     @nn.compact
     def __call__(self, features: jax.Array, *, train: bool = False) -> jax.Array:
-        del train
         numeric, ids = split_features(features, self.layout)
 
         # field vectors (B, F, k): numeric + categorical share the FM space
@@ -71,7 +70,8 @@ class DeepFM(nn.Module):
         fm = 0.5 * jnp.sum(sum_sq - sq_sum, axis=-1, keepdims=True)
 
         # deep over flattened field vectors
-        deep = MLPTrunk(spec=self.spec, name="trunk")(v.reshape(v.shape[0], -1))
+        deep = MLPTrunk(spec=self.spec, name="trunk")(v.reshape(v.shape[0], -1),
+                                                      train=train)
         deep = ShifuDense(features=self.spec.num_heads, activation=None,
                           xavier_bias=self.spec.xavier_bias_init,
                           param_dtype=self.spec.param_dtype,

@@ -20,7 +20,6 @@ class ShifuMLP(nn.Module):
 
     @nn.compact
     def __call__(self, features: jax.Array, *, train: bool = False) -> jax.Array:
-        del train  # no dropout/batchnorm in the parity MLP
         x = features.astype(dtype_of(self.spec.compute_dtype))
-        x = MLPTrunk(spec=self.spec, name="trunk")(x)
+        x = MLPTrunk(spec=self.spec, name="trunk")(x, train=train)
         return ScoringHead(spec=self.spec, name="head")(x)

@@ -64,6 +64,8 @@ class MoEMLP(nn.Module):
             h = jnp.einsum("bei,eio->beo", h, kernel.astype(cdt))
             h = h + bias.astype(cdt)[None]
             h = get_activation(act)(h)
+            if spec.dropout_rate > 0:
+                h = nn.Dropout(spec.dropout_rate, deterministic=not train)(h)
             d_in = n
 
         # gate-weighted combine (B, E, H) x (B, E) -> (B, H)

@@ -22,9 +22,8 @@ class MultiTask(nn.Module):
 
     @nn.compact
     def __call__(self, features: jax.Array, *, train: bool = False) -> jax.Array:
-        del train
         x = features.astype(dtype_of(self.spec.compute_dtype))
-        trunk = MLPTrunk(spec=self.spec, name="trunk")(x)
+        trunk = MLPTrunk(spec=self.spec, name="trunk")(x, train=train)
         logits = []
         tower_width = max(self.spec.hidden_nodes[-1] // 2, 4)
         for h in range(self.spec.num_heads):

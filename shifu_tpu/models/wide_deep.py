@@ -26,7 +26,6 @@ class WideDeep(nn.Module):
 
     @nn.compact
     def __call__(self, features: jax.Array, *, train: bool = False) -> jax.Array:
-        del train
         cdt = dtype_of(self.spec.compute_dtype)
         numeric, ids = split_features(features, self.layout)
         numeric = numeric.astype(cdt)
@@ -54,7 +53,7 @@ class WideDeep(nn.Module):
                                    name="deep_embedding")(ids)
             deep_in = jnp.concatenate(
                 [numeric, emb.reshape(emb.shape[0], -1)], axis=-1)
-        deep = MLPTrunk(spec=self.spec, name="trunk")(deep_in)
+        deep = MLPTrunk(spec=self.spec, name="trunk")(deep_in, train=train)
         deep = ShifuDense(features=self.spec.num_heads, activation=None,
                           xavier_bias=self.spec.xavier_bias_init,
                           param_dtype=self.spec.param_dtype,

@@ -27,13 +27,28 @@ Batch = dict[str, jax.Array]
 
 
 def make_loss_fn(job: JobConfig):
+    """Training loss.  With ModelConfig DropoutRate > 0 the forward pass
+    runs with `train=True` and a per-update dropout rng derived from
+    (train.seed, global step) — deterministic across resume/replay, distinct
+    every optimizer step.  Eval/export never pass `train`, so scoring stays
+    deterministic."""
     base = losses_lib.get_loss(job.train.loss)
     if job.model.num_heads > 1:
         base = losses_lib.multitask_loss(base)
     l2 = job.model.l2_scale
+    use_dropout = job.model.dropout_rate > 0
+    drop_seed = job.train.seed ^ 0x6B0_D0_1  # distinct from init's key stream
 
-    def loss_fn(params, apply_fn, batch: Batch) -> jax.Array:
-        logits = apply_fn({"params": params}, batch["features"])
+    def loss_fn(params, apply_fn, batch: Batch,
+                step: Optional[jax.Array] = None) -> jax.Array:
+        if use_dropout:
+            rng = jax.random.fold_in(
+                jax.random.PRNGKey(drop_seed),
+                step if step is not None else jnp.int32(0))
+            logits = apply_fn({"params": params}, batch["features"],
+                              train=True, rngs={"dropout": rng})
+        else:
+            logits = apply_fn({"params": params}, batch["features"])
         loss = base(logits, batch["target"], batch["weight"])
         if l2 > 0:
             loss = loss + losses_lib.l2_penalty(params, l2)
@@ -53,7 +68,8 @@ def make_train_step(job: JobConfig, mesh: Optional[Mesh] = None,
     loss_fn = make_loss_fn(job)
 
     def step(state: TrainState, batch: Batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, state.apply_fn, batch)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, state.apply_fn, batch, state.step)
         new_state = state.apply_gradients(grads)
         return new_state, {"loss": loss}
 
@@ -84,7 +100,8 @@ def make_epoch_scan_step(job: JobConfig, mesh: Optional[Mesh] = None,
     def epoch_step(state: TrainState, blocks: Batch):
         def body(carry, xs):
             st, acc = carry
-            loss, grads = jax.value_and_grad(loss_fn)(st.params, st.apply_fn, xs)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                st.params, st.apply_fn, xs, st.step)
             st = st.apply_gradients(grads)
             return (st, acc + loss), None
 
@@ -119,7 +136,8 @@ def make_device_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
                 lambda a: jax.lax.dynamic_index_in_dim(a, idx, axis=0,
                                                        keepdims=False),
                 blocks)
-            loss, grads = jax.value_and_grad(loss_fn)(st.params, st.apply_fn, xs)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                st.params, st.apply_fn, xs, st.step)
             st = st.apply_gradients(grads)
             return (st, acc + loss), None
 

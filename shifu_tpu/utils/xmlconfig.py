@@ -40,6 +40,13 @@ KEY_MAX_RESTARTS = "shifu.application.max-restarts"
 KEY_CKPT_SAVE_SECONDS = "shifu.checkpoint.save-seconds"
 KEY_HEARTBEAT_INTERVAL = "shifu.task.heartbeat-interval-ms"
 KEY_MAX_MISSED_HEARTBEATS = "shifu.task.max-missed-heartbeats"
+# supervisor hang detection: board-progress window in seconds (successor of
+# the AM heartbeat monitor, TensorflowApplicationMaster.java:63-112).  The
+# reference heartbeat pair above is deliberately NOT mapped here: its
+# semantics (1s task heartbeat x misses) don't transfer to a per-epoch
+# board heartbeat — a migrated config carrying the reference defaults
+# (1000ms x 25) would false-kill any epoch longer than 25s
+KEY_LIVENESS_SECONDS = "shifu.liveness.seconds"
 # device mesh topology (successor of shifu.{ps,worker}.instances container
 # counts: the logical axes the one SPMD program shards over)
 KEY_MESH_DATA = "shifu.mesh.data"
@@ -185,6 +192,8 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         rt_kw["checkpoint"] = ck
     if KEY_MAX_RESTARTS in conf:
         rt_kw["max_restarts"] = int(conf[KEY_MAX_RESTARTS])
+    if KEY_LIVENESS_SECONDS in conf:
+        rt_kw["liveness_seconds"] = float(conf[KEY_LIVENESS_SECONDS])
     if KEY_CKPT_SAVE_SECONDS in conf:
         ck = rt_kw.get("checkpoint", runtime.checkpoint)
         rt_kw["checkpoint"] = dataclasses.replace(

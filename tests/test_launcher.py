@@ -138,6 +138,56 @@ def test_supervisor_recovers_from_injected_fault(job_dir):
     assert (out / "final_model" / "weights.npz").exists()
 
 
+def test_supervisor_liveness_kills_hung_child(job_dir):
+    """Heartbeat-liveness parity (TensorflowApplicationMaster.java:63-112):
+    a child that stops writing board progress for shifu.liveness.seconds is
+    killed and restarted; checkpoint-resume finishes the job."""
+    from shifu_tpu.utils import xmlconfig
+    xml = job_dir / "global.xml"
+    xmlconfig.write_configuration_xml({"shifu.liveness.seconds": "30"},
+                                      str(xml))
+    out = job_dir / "out_h"
+    env = _cli_env()
+    env["SHIFU_TPU_HANG_EPOCH"] = "0"
+    r = _run_cli(["train",
+                  "--modelconfig", str(job_dir / "ModelConfig.json"),
+                  "--columnconfig", str(job_dir / "ColumnConfig.json"),
+                  "--data", str(job_dir / "normalized"),
+                  "--globalconfig", str(xml),
+                  "--output", str(out), "--epochs", "3",
+                  "--supervise", "--max-restarts", "3"],
+                 env=env, timeout=600)
+    # attempt 1 hangs after epoch 0 (checkpoint already saved), the
+    # supervisor's liveness monitor kills it; attempt 2 resumes at epoch 1
+    # where the hang injection no longer fires, and finishes.  The 30s
+    # window must exceed jax import+compile time on a loaded host — the
+    # board is silent until the first epoch line
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no progress for 30" in r.stdout, r.stdout
+    assert "liveness kill" in r.stdout
+    board = (out / "console.board").read_text()
+    assert "HANG INJECTION" in board
+    assert "Resumed from checkpoint" in board
+    assert (out / "final_model" / "weights.npz").exists()
+
+
+def test_liveness_config_keys():
+    """shifu.liveness.seconds wires through; the reference heartbeat pair is
+    preserved but deliberately NOT mapped (its 1s-heartbeat semantics would
+    false-kill long epochs on a per-epoch board heartbeat)."""
+    from shifu_tpu.config import JobConfig
+    from shifu_tpu.utils import xmlconfig
+
+    job = JobConfig()
+    out = xmlconfig.apply_to_job(job, {"shifu.liveness.seconds": "40"})
+    assert out.runtime.liveness_seconds == 40.0
+    out2 = xmlconfig.apply_to_job(job, {
+        "shifu.task.heartbeat-interval-ms": "1000",
+        "shifu.task.max-missed-heartbeats": "25"})
+    assert out2.runtime.liveness_seconds == 0.0
+    assert job.runtime.liveness_seconds == 0.0  # default: off
+
+
 def test_supervisor_budget_exhausted(job_dir):
     out = job_dir / "out_b"
     env = _cli_env()
