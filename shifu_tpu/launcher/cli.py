@@ -58,6 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(multi-host simulation / multi-process training); "
                         "on a real pod run one process per host with the "
                         "SHIFU_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID env")
+    t.add_argument("--hosts", default=None,
+                   help="pod-scale launch: dispatch one process per host "
+                        "with whole-gang supervised restart. Forms: "
+                        "'h1,h2,...' (ssh, list in TPU worker order — the "
+                        "TPU_WORKER_HOSTNAMES value), '@hostfile', or "
+                        "'local:N' (simulated pod on this machine). Env "
+                        "spelling: SHIFU_TPU_HOSTS")
     t.add_argument("--max-restarts", type=int, default=-1,
                    help="supervisor restart budget (-1 = from config)")
 
@@ -216,15 +223,12 @@ def _child_train_args(args, out_dir: str,
 
 
 def _spawn_processes(args, out_dir: str) -> int:
-    """Local multi-process mode: spawn N coordinated `train` children wired
-    through the SHIFU_TPU_* rendezvous contract (parallel/distributed.py) —
-    the single-machine analog of one-process-per-host on a pod, and the
-    successor of the AM's container orchestration (TensorflowSession.java:
-    202-318).  Child 0 is the chief (output streams through); the other
-    processes log to <out_dir>/process-<i>.log.  If any child dies the rest
-    are torn down — a half-gang would block in collectives forever."""
-    import subprocess
-    import socket
+    """Local multi-process mode (`--num-processes N`): a simulated pod on
+    this machine — the single-machine spelling of `--hosts local:N`,
+    delegating to the pod launcher for the spawn/stream/teardown mechanics
+    (one gang attempt; restarts come from the outer `--supervise` wrapper,
+    which re-enters here with a fresh gang)."""
+    from . import pod as pod_lib
 
     if args.devices:
         # a device *prefix* of the global list would strand non-chief
@@ -234,54 +238,11 @@ def _spawn_processes(args, out_dir: str) -> int:
               file=sys.stderr, flush=True)
         return EXIT_FAIL
 
-    with socket.socket() as sck:
-        sck.bind(("127.0.0.1", 0))
-        port = sck.getsockname()[1]
-
     os.makedirs(out_dir, exist_ok=True)
-    child_args = _child_train_args(args, out_dir)
-    n = args.num_processes
-    procs, logs = [], []
-    for pid in range(n):
-        env = dict(os.environ)
-        env.update({"SHIFU_TPU_COORDINATOR": f"127.0.0.1:{port}",
-                    "SHIFU_TPU_NUM_PROCESSES": str(n),
-                    "SHIFU_TPU_PROCESS_ID": str(pid)})
-        log = (None if pid == 0 else
-               open(os.path.join(out_dir, f"process-{pid}.log"), "w"))
-        logs.append(log)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "shifu_tpu.launcher.cli", *child_args],
-            env=env, stdout=log, stderr=subprocess.STDOUT if log else None))
-
-    status = EXIT_OK
-    try:
-        remaining = set(range(n))
-        while remaining:
-            for pid in sorted(remaining):
-                rc = procs[pid].poll()
-                if rc is None:
-                    continue
-                remaining.discard(pid)
-                if rc != 0:
-                    print(f"process {pid} exited rc={rc}"
-                          + (f" (see {out_dir}/process-{pid}.log)"
-                             if pid else ""),
-                          file=sys.stderr, flush=True)
-                    status = status or rc
-                    # tear the rest down: they would block in collectives
-                    for other in sorted(remaining):
-                        procs[other].terminate()
-            if remaining:
-                time.sleep(0.5)
-    finally:
-        for proc in procs:
-            if proc.poll() is None:
-                proc.kill()
-        for log in logs:
-            if log:
-                log.close()
-    return status
+    spec = pod_lib.PodSpec(hosts=("local",) * args.num_processes,
+                           transport="local")
+    return pod_lib.launch_gang(spec, _child_train_args(args, out_dir),
+                               out_dir, attempt=1)
 
 
 def run_train(args) -> int:
@@ -289,6 +250,35 @@ def run_train(args) -> int:
     # rendezvous (its child re-registers the same process id), and a
     # supervised multi-process job restarts as a whole gang — supervisor
     # wraps the spawner, spawner wraps the worker processes.
+
+    # pod-scale launch (successor of the YARN submit/monitor path): the
+    # dispatcher routes here only in the PARENT — dispatched children carry
+    # the SHIFU_TPU_PROCESS_ID env and run the plain train path below.
+    # Gang supervision (restart budget + liveness) is built into the pod
+    # path, so --supervise is implied.
+    from ..parallel.distributed import ENV_PROCESS_ID
+    from . import pod as pod_lib
+    pod_hosts = getattr(args, "hosts", None) or pod_lib.detect_hosts_env()
+    if pod_hosts and ENV_PROCESS_ID not in os.environ:
+        try:
+            spec = pod_lib.parse_hosts(pod_hosts)
+        except (ValueError, OSError) as e:
+            print(f"--hosts: {e}", file=sys.stderr, flush=True)
+            return EXIT_FAIL
+        if getattr(args, "num_processes", 0) > 1:
+            print("--hosts and --num-processes are alternative spellings of "
+                  "a process gang; use one", file=sys.stderr, flush=True)
+            return EXIT_FAIL
+        out_dir = _resolve_out_dir(args)
+        os.makedirs(out_dir, exist_ok=True)
+        sup_job = _assemble_job(args, write_files=False)[0]
+        max_restarts = (args.max_restarts if args.max_restarts >= 0
+                        else sup_job.runtime.max_restarts)
+        return pod_lib.supervise_pod(
+            spec, _child_train_args(args, out_dir), out_dir,
+            max_restarts=max_restarts,
+            liveness_seconds=sup_job.runtime.liveness_seconds)
+
     if args.supervise:
         from .supervisor import supervise
         out_dir = _resolve_out_dir(args)
@@ -476,8 +466,18 @@ def _maybe_inject_fault(metrics, board) -> None:
     """Deliberate fault injection for resilience tests — the always-on version
     of the reference's commented-out PS-killer (yarn/util/CommonUtils.java:
     265-274).  SHIFU_TPU_FAULT_EPOCH=k hard-kills the process after epoch k."""
+    # SHIFU_TPU_FAULT_PROCESS=i limits the injection to one rank of a gang
+    # (exercising single-host-failure -> whole-gang teardown + restart)
+    fault_proc = os.environ.get("SHIFU_TPU_FAULT_PROCESS")
+    if fault_proc is not None and os.environ.get(
+            "SHIFU_TPU_PROCESS_ID", "0") != fault_proc:
+        return
     fault_epoch = os.environ.get("SHIFU_TPU_FAULT_EPOCH")
     if fault_epoch is not None and metrics.epoch == int(fault_epoch):
+        # print as well: a non-chief rank's board is silent, but its stdout
+        # is captured into the per-host log by the pod launcher
+        print(f"FAULT INJECTION: killing process after epoch {metrics.epoch}",
+              flush=True)
         board(f"FAULT INJECTION: killing process after epoch {metrics.epoch}")
         os._exit(17)
     # hang (vs crash) injection: stall forever after epoch k so the

@@ -1,0 +1,229 @@
+"""Pod-scale launch: one command → one training process per host, per-host
+log collection, and whole-gang supervised restart from checkpoint.
+
+Successor of the reference's compute-acquisition path — the YARN client's
+createApplication/submitApplication/monitorApplication loop
+(yarn/client/TensorflowClient.java:339-426), the AM's container allocation
+(yarn/appmaster/AMRMCallbackHandler.java:148-190), and its failed-worker
+recovery (yarn/appmaster/TensorflowApplicationMaster.java:410-426).  On TPU
+the accelerators are already attached to the pod's hosts, so "provisioning"
+collapses to: derive the host list (explicit --hosts, SHIFU_TPU_HOSTS, or the
+TPU runtime's own metadata), dispatch one SPMD process per host with ranks
+assigned from list order, stream every host's output back into per-host log
+files under the job dir, and supervise the gang as a unit: the first host
+failure tears the rest down (a half-gang would block in collectives forever —
+the SPMD analog of "any failed worker breaks the monitor loop",
+TensorflowApplicationMaster.java:363-371) and the whole gang restarts from
+the shared checkpoint, bounded by the same restart budget the single-host
+supervisor uses.  Hot-standby backup containers have no SPMD equivalent;
+checkpoint-restart of the full gang is the recovery story (SURVEY.md §5.3).
+
+Transports:
+- ``local`` (``--hosts local:N``): N coordinated processes on this machine —
+  the simulated pod used by tests and dev runs (virtual CPU devices per
+  process).
+- ``ssh`` (``--hosts h1,h2,...`` or ``--hosts @hostfile``): one process per
+  host over ``ssh -tt`` (the tty makes a parent-side kill propagate as HUP).
+  Host order defines the jax.distributed process id, so list hosts in the
+  TPU runtime's worker order (TPU_WORKER_HOSTNAMES order on Cloud TPU).
+  Checkpoint/export paths must live on storage all hosts share (gs://,
+  hdfs://, NFS) — the same contract the reference had with HDFS model paths.
+
+The operator UX stays the reference's: one command, per-epoch lines on the
+console (rank 0's stream is echoed live, every rank is captured to
+``<out>/logs/host-<rank>.attempt-<k>.log``), per-host log locations printed,
+exit status 0/1/3.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+ENV_HOSTS = "SHIFU_TPU_HOSTS"
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclass(frozen=True)
+class PodSpec:
+    hosts: tuple[str, ...]           # rank i runs on hosts[i]
+    transport: str                   # "local" | "ssh"
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT
+    remote_python: str = sys.executable  # interpreter on the hosts
+
+
+def parse_hosts(value: str) -> PodSpec:
+    """``local:N`` → N simulated hosts here; ``@file`` → newline-separated
+    host list; ``h1,h2,...`` → ssh to each host."""
+    value = value.strip()
+    if value.startswith("local:"):
+        n = int(value.split(":", 1)[1])
+        if n < 1:
+            raise ValueError(f"--hosts {value!r}: need at least 1 process")
+        return PodSpec(hosts=("local",) * n, transport="local")
+    if value.startswith("@"):
+        with open(value[1:]) as f:
+            hosts = tuple(h.strip() for h in f if h.strip()
+                          and not h.lstrip().startswith("#"))
+    else:
+        hosts = tuple(h.strip() for h in value.split(",") if h.strip())
+    if not hosts:
+        raise ValueError(f"--hosts {value!r}: no hosts")
+    return PodSpec(hosts=hosts, transport="ssh")
+
+
+def detect_hosts_env() -> Optional[str]:
+    """The no-flag spelling: SHIFU_TPU_HOSTS.  Deliberately NOT
+    TPU_WORKER_HOSTNAMES: the TPU runtime sets that on EVERY pod worker, and
+    the established managed-pod pattern is to run the plain train command on
+    all workers at once (`gcloud ... --worker=all`), each auto-joining via
+    jax.distributed — auto-dispatching there would turn every worker into a
+    dispatcher and launch N colliding gangs.  Dispatching is an explicit
+    opt-in; `--hosts` docs point operators at the TPU_WORKER_HOSTNAMES value
+    when they want driver-style launch from one machine."""
+    return os.environ.get(ENV_HOSTS) or None
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _host_command(spec: PodSpec, rank: int, child_args: Sequence[str],
+                  env_contract: dict[str, str]) -> tuple[list[str], Optional[dict]]:
+    """(argv, env-or-None): local runs inherit+extend the parent env; ssh
+    carries the contract inline (`env K=V ...`) so no remote shell profile
+    can drop it."""
+    module_argv = ["-m", "shifu_tpu.launcher.cli", *child_args]
+    if spec.transport == "local":
+        env = dict(os.environ)
+        env.update(env_contract)
+        return [sys.executable, *module_argv], env
+    assigns = [f"{k}={v}" for k, v in env_contract.items()]
+    remote = " ".join(
+        shlex.quote(p) for p in
+        ["env", *assigns, spec.remote_python, *module_argv])
+    return (["ssh", "-tt", "-o", "BatchMode=yes", spec.hosts[rank], remote],
+            None)
+
+
+def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
+                attempt: int, liveness_seconds: float = 0.0,
+                echo=print) -> int:
+    """Run one gang attempt: dispatch every rank, stream rank 0 to the
+    console, capture all ranks to per-host logs, tear everyone down on the
+    first failure (or on a liveness stall), return the gang's exit code."""
+    n = len(spec.hosts)
+    log_dir = os.path.join(out_dir, "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    if spec.transport == "local":
+        coordinator = f"127.0.0.1:{_free_port()}"
+    else:
+        coordinator = f"{spec.hosts[0]}:{spec.coordinator_port}"
+
+    procs: list[subprocess.Popen] = []
+    threads: list[threading.Thread] = []
+    log_paths: list[str] = []
+    # per-rank monotonic timestamp of the last output line — any rank's
+    # output counts as gang progress for the liveness monitor (epoch lines
+    # come from rank 0; other ranks are quiet when healthy)
+    progress = [time.monotonic()] * n
+    lock = threading.Lock()
+
+    def pump(rank: int, proc: subprocess.Popen, log_path: str) -> None:
+        with open(log_path, "w") as log:
+            for line in proc.stdout:  # text mode; closes on child exit
+                log.write(line)
+                log.flush()
+                with lock:
+                    progress[rank] = time.monotonic()
+                if rank == 0:
+                    echo(line.rstrip("\n"))
+
+    for rank in range(n):
+        env_contract = {
+            "SHIFU_TPU_COORDINATOR": coordinator,
+            "SHIFU_TPU_NUM_PROCESSES": str(n),
+            "SHIFU_TPU_PROCESS_ID": str(rank),
+        }
+        argv, env = _host_command(spec, rank, child_args, env_contract)
+        log_path = os.path.join(log_dir, f"host-{rank}.attempt-{attempt}.log")
+        log_paths.append(log_path)
+        proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        procs.append(proc)
+        t = threading.Thread(target=pump, args=(rank, proc, log_path),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+
+    echo(f"pod: attempt {attempt}: {n} processes "
+         f"({spec.transport}), coordinator {coordinator}, "
+         f"logs {log_dir}/host-*.attempt-{attempt}.log")
+
+    status = 0
+    try:
+        remaining = set(range(n))
+        while remaining:
+            for rank in sorted(remaining):
+                rc = procs[rank].poll()
+                if rc is None:
+                    continue
+                remaining.discard(rank)
+                if rc != 0:
+                    echo(f"pod: host {rank} ({spec.hosts[rank]}) exited "
+                         f"rc={rc} — tearing down the gang "
+                         f"(see {log_paths[rank]})")
+                    status = status or rc
+                    for other in sorted(remaining):
+                        procs[other].terminate()
+            if liveness_seconds > 0 and remaining:
+                with lock:
+                    newest = max(progress)
+                if time.monotonic() - newest > liveness_seconds:
+                    echo(f"pod: no output from any host for "
+                         f"{liveness_seconds}s — killing the gang")
+                    status = status or -9
+                    for other in sorted(remaining):
+                        procs[other].kill()
+            if remaining:
+                time.sleep(0.5)
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for t in threads:
+            t.join(timeout=5)
+    return status
+
+
+def supervise_pod(spec: PodSpec, child_args: Sequence[str], out_dir: str,
+                  max_restarts: int = 2, liveness_seconds: float = 0.0,
+                  echo=print) -> int:
+    """Whole-gang restart supervision: any host failure restarts the ENTIRE
+    gang (checkpoint auto-resume continues the job), up to max_restarts —
+    the cross-host successor of `supervise()` and of the reference's
+    backup-promotion recovery."""
+    attempts = 0
+    while True:
+        attempts += 1
+        start = time.monotonic()
+        rc = launch_gang(spec, child_args, out_dir, attempts,
+                         liveness_seconds=liveness_seconds, echo=echo)
+        if rc == 0:
+            if attempts > 1:
+                echo(f"pod: succeeded after {attempts} attempts")
+            return 0
+        echo(f"pod: attempt {attempts} failed rc={rc} after "
+             f"{time.monotonic() - start:.1f}s")
+        if attempts > max_restarts:
+            echo(f"pod: restart budget exhausted ({max_restarts} restarts)")
+            return rc if isinstance(rc, int) and rc > 0 else 1
