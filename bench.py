@@ -29,6 +29,26 @@ import numpy as np
 
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 10_000_000 / 16  # v5e-16 north star
 
+# peak dense bf16 TFLOP/s per chip by device kind substring (public specs);
+# used for the MFU estimate — tabular MLPs are bandwidth-bound, so MFU is
+# reported for context, not as the target
+_PEAK_BF16_TFLOPS = (
+    ("v6", 918.0),       # Trillium / v6e
+    ("v5p", 459.0),
+    ("v5", 197.0),       # v5e / "TPU v5 lite"
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def _peak_tflops(device_kind: str):
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_BF16_TFLOPS:
+        if sub in kind:
+            return peak
+    return None
+
 
 
 def _best_rate(fn, units_per_call: int, trials: int = 3, reps: int = 10) -> float:
@@ -214,9 +234,32 @@ def main() -> None:
             steps * batch_size / (time.perf_counter() - t0) / n_chips)
 
     extras = {}
-    if os.environ.get("SHIFU_TPU_BENCH_LADDER"):
-        # device-resident training throughput for the rest of the BASELINE
-        # model ladder (configs 2-5); opt-in because each rung pays a compile
+
+    # -- MFU estimate for the headline tier ---------------------------------
+    # analytic matmul FLOPs (fwd 2mk n per dense; bwd ~= 2x fwd).  XLA:TPU's
+    # compiled cost_analysis under-reports ~40x on this backend (3.4k vs a
+    # 46k-FLOP forward) AND forces a second full compile of the epoch
+    # program, so the analytic count is used directly.
+    dims = [num_features, *job.model.hidden_nodes, 1]
+    fwd_flops = sum(2 * a * b for a, b in zip(dims, dims[1:]))
+    flops_per_sample = 3.0 * fwd_flops  # fwd + dgrad + wgrad
+    achieved_tflops = resident_per_chip * flops_per_sample / 1e12
+    extras["train_flops_per_sample"] = round(flops_per_sample, 1)
+    extras["train_tflops_per_sec_per_chip"] = round(achieved_tflops, 2)
+    peak = _peak_tflops(jax.devices()[0].device_kind)
+    if peak:
+        # bandwidth-bound context: a 3x100 tabular MLP at batch 64k moves
+        # ~2.4x more HBM bytes than MXU-tile FLOP-equivalents, so single-
+        # digit MFU is the expected regime; the number is tracked to catch
+        # regressions, not chased to 50%
+        extras["mfu"] = round(achieved_tflops / peak, 4)
+        extras["mfu_peak_tflops_assumed"] = peak
+        extras["device_kind"] = jax.devices()[0].device_kind
+
+    # device-resident training throughput for the rest of the BASELINE
+    # model ladder (configs 2-5); each rung pays a compile, so the whole
+    # ladder runs by default but can be skipped with SHIFU_TPU_BENCH_FAST
+    if not os.environ.get("SHIFU_TPU_BENCH_FAST"):
         try:
             extras.update(_ladder_extras(mesh, n_chips))
         except Exception as e:
@@ -306,6 +349,77 @@ def main() -> None:
             shutil.rmtree(cdir, ignore_errors=True)
     except Exception:
         pass
+
+    try:
+        # -- end-to-end from disk: the full loop a real epoch pays ----------
+        # gzip|psv on disk -> parse (cold) or columnar cache (steady state)
+        # -> block stacking -> H2D -> one full device-resident training
+        # epoch -> sync.  This is the number the 10M samples/sec north star
+        # actually constrains; the headline tier above isolates the compute
+        # celling on resident data.
+        import shutil
+        import tempfile
+
+        from shifu_tpu.data import reader
+        from shifu_tpu.data.cache import read_file_cached
+
+        nb_e2e = 8
+        rows_e2e = nb_e2e * batch_size
+        tmp = tempfile.mkdtemp(prefix="bench_e2e_")
+        cdir = tempfile.mkdtemp(prefix="bench_e2e_cache_")
+        try:
+            e_schema = synthetic.make_schema(num_features=num_features)
+            e_rows = synthetic.make_rows(rows_e2e, e_schema, seed=2)
+            paths = synthetic.write_files(e_rows, tmp, num_files=8)
+            del e_rows
+
+            def stack(mat):
+                feats = mat[:, 1:1 + num_features]
+                tgt = mat[:, :1]
+                n = (mat.shape[0] // batch_size) * batch_size
+                return {
+                    "features": feats[:n].reshape(-1, batch_size, num_features),
+                    "target": tgt[:n].reshape(-1, batch_size, 1),
+                    "weight": np.ones((n // batch_size, batch_size, 1),
+                                      np.float32),
+                }
+
+            e2e_state = init_state(job, num_features, mesh)
+
+            def one_epoch_from(read_fn):
+                # device_epoch donates the state: rebind the returned one
+                nonlocal e2e_state
+                mat = np.concatenate([read_fn(p) for p in paths], axis=0)
+                hb = stack(mat)
+                db = (shard_blocks(hb, mesh) if mesh is not None
+                      else {k: jax.device_put(v) for k, v in hb.items()})
+                nb = db["features"].shape[0]
+                e2e_state, l2 = device_epoch(e2e_state, db,
+                                             jnp.arange(nb, dtype=jnp.int32))
+                float(l2)
+                return nb * batch_size
+            for p in paths:
+                read_file_cached(p, cache_dir=cdir)  # populate cache
+            one_epoch_from(lambda p: read_file_cached(p, cache_dir=cdir))  # warm compile (nb_e2e shape)
+
+            # reader.read_file never consults the cache env var, so the
+            # cold tier needs no masking — it re-parses the gzip each call
+            t0 = time.perf_counter()
+            n_done = one_epoch_from(reader.read_file)
+            extras["e2e_cold_disk_samples_per_sec_per_chip"] = round(
+                n_done / (time.perf_counter() - t0) / n_chips, 1)
+            best = 0.0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                n_done = one_epoch_from(
+                    lambda p: read_file_cached(p, cache_dir=cdir))
+                best = max(best, n_done / (time.perf_counter() - t0) / n_chips)
+            extras["e2e_cached_disk_samples_per_sec_per_chip"] = round(best, 1)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+            shutil.rmtree(cdir, ignore_errors=True)
+    except Exception as e:
+        extras["e2e_error"] = str(e)[:200]
 
     print(json.dumps({
         "metric": "tabular_train_samples_per_sec_per_chip",

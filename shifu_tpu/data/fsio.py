@@ -110,88 +110,149 @@ def open_input_file(path: str):
     """A seekable pyarrow input file for a remote URI (parquet readers need
     random access, unlike the streaming read_bytes path)."""
     filesystem, fs_path = _filesystem(path)
-    return filesystem.open_input_file(fs_path)
+    return _retry_transient(lambda: filesystem.open_input_file(fs_path),
+                            _classifier(filesystem, fs_path, path))
 
 
-def read_bytes(path: str) -> bytes:
-    """Fetch a remote file's raw bytes (gzip detection happens downstream)."""
-    filesystem, fs_path = _filesystem(path)  # guards the pyarrow import
-    from pyarrow import fs as pafs
+def _retry_attempts() -> int:
+    """Total tries for a transient remote failure: 1 + retries.
+    SHIFU_TPU_FS_RETRIES tunes it (0 disables).  The reference leaned on the
+    HDFS client's own retry policy; pyarrow surfaces transient datanode /
+    network errors to the caller, so the equivalent lives here."""
+    import os
     try:
-        with filesystem.open_input_stream(fs_path) as stream:
-            return stream.read()
-    except Exception as e:
-        # classify after the fact: one stat only on the failure path
-        info = filesystem.get_file_info(fs_path)
+        return max(0, int(os.environ.get("SHIFU_TPU_FS_RETRIES", "2"))) + 1
+    except ValueError:
+        return 3
+
+
+# error-message markers that make a remote failure NOT worth retrying —
+# auth/permission problems fail the same way on every attempt, and across a
+# 1000-shard dataset pointless retries turn a clear error into minutes of
+# backoff.  Best-effort string match: pyarrow raises plain OSError for most
+# filesystem failures, so the type alone cannot classify.
+_TERMINAL_MARKERS = ("permission denied", "access denied", "accessdenied",
+                     "forbidden", "unauthorized", "authentication",
+                     "kerberos", "credential", "token expired")
+
+
+def _retry_transient(op, classify=None):
+    """Run `op()` retrying transient remote errors with bounded backoff.
+
+    `classify(exc)` may raise a terminal error (FileNotFoundError /
+    IsADirectoryError) instead of letting the retry proceed; auth-shaped
+    errors (see _TERMINAL_MARKERS) never retry.  Every remote operation —
+    read, streaming count, listing, parquet open — goes through here, so a
+    transient namenode/datanode hiccup can't kill job startup."""
+    import time
+
+    attempts = _retry_attempts()
+    for attempt in range(attempts):
+        try:
+            return op()
+        except (FileNotFoundError, IsADirectoryError):
+            raise
+        except Exception as e:
+            if classify is not None:
+                classify(e)  # may raise the terminal classification
+            msg = str(e).lower()
+            if any(m in msg for m in _TERMINAL_MARKERS):
+                raise
+            if attempt == attempts - 1:
+                raise
+            time.sleep(0.1 * (2 ** attempt))
+    raise AssertionError("unreachable")
+
+
+def _classifier(filesystem, fs_path: str, path: str):
+    """classify-after-the-fact for _retry_transient: one stat on the failure
+    path turns missing-file/directory errors terminal."""
+    from pyarrow import fs as pafs
+
+    def classify(e: Exception) -> None:
+        try:
+            info = filesystem.get_file_info(fs_path)
+        except Exception:
+            return  # stat itself flaky: let the retry decide
         if info.type == pafs.FileType.NotFound:
             raise FileNotFoundError(f"no such data file: {path}") from e
         if info.type == pafs.FileType.Directory:
             raise IsADirectoryError(
                 f"expected a file, got a directory: {path}") from e
-        raise
+
+    return classify
+
+
+def read_bytes(path: str) -> bytes:
+    """Fetch a remote file's raw bytes (gzip detection happens downstream).
+    Transient stream errors are retried with backoff; NotFound/Directory and
+    auth failures classify immediately and never retry."""
+    filesystem, fs_path = _filesystem(path)  # guards the pyarrow import
+
+    def op() -> bytes:
+        with filesystem.open_input_stream(fs_path) as stream:
+            return stream.read()
+
+    return _retry_transient(op, _classifier(filesystem, fs_path, path))
 
 
 def count_data_lines(path: str, chunk_bytes: int = 1 << 20) -> int:
     """Count non-blank lines of a (possibly gzipped) remote file, streaming —
     constant memory regardless of file size (the local analog streams too,
-    reader.count_rows)."""
+    reader.count_rows).  A transient mid-stream error restarts the whole
+    count (the state is per-attempt, so a retry can't double-count)."""
     import zlib
 
     filesystem, fs_path = _filesystem(path)
-    from pyarrow import fs as pafs
 
-    count = 0
-    line_has_content = False
+    def op() -> int:
+        count = 0
+        line_has_content = False
 
-    def feed(data: bytes) -> None:
-        # count newline-terminated non-blank lines; carry blank/content state
-        # across chunk borders
-        nonlocal count, line_has_content
-        parts = data.split(b"\n")
-        for piece in parts[:-1]:
-            if line_has_content or piece.strip():
-                count += 1
-            line_has_content = False
-        if parts[-1].strip():
-            line_has_content = True
+        def feed(data: bytes) -> None:
+            # count newline-terminated non-blank lines; carry blank/content
+            # state across chunk borders
+            nonlocal count, line_has_content
+            parts = data.split(b"\n")
+            for piece in parts[:-1]:
+                if line_has_content or piece.strip():
+                    count += 1
+                line_has_content = False
+            if parts[-1].strip():
+                line_has_content = True
 
-    decomp = None
-    first = True
-    try:
-        stream = filesystem.open_input_stream(fs_path)
-    except Exception as e:
-        info = filesystem.get_file_info(fs_path)
-        if info.type == pafs.FileType.NotFound:
-            raise FileNotFoundError(f"no such data file: {path}") from e
-        raise
-    with stream:
-        while True:
-            chunk = stream.read(chunk_bytes)
-            if not chunk:
-                break
-            chunk = bytes(chunk)
-            if first:
-                first = False
-                if chunk[:2] == b"\x1f\x8b":
-                    decomp = zlib.decompressobj(wbits=31)  # gzip wrapper
-            if decomp is None:
-                feed(chunk)
-                continue
-            # multi-member (concatenated) gzip: each member ends the
-            # decompressobj with the remainder in unused_data — restart a
-            # fresh decompressor per member (gzip.decompress semantics)
-            data = chunk
-            while data:
-                feed(decomp.decompress(data))
-                if not decomp.eof:
+        decomp = None
+        first = True
+        with filesystem.open_input_stream(fs_path) as stream:
+            while True:
+                chunk = stream.read(chunk_bytes)
+                if not chunk:
                     break
-                data = decomp.unused_data
-                decomp = zlib.decompressobj(wbits=31)
-    if decomp:
-        feed(decomp.flush())
-    if line_has_content:
-        count += 1  # final unterminated line
-    return count
+                chunk = bytes(chunk)
+                if first:
+                    first = False
+                    if chunk[:2] == b"\x1f\x8b":
+                        decomp = zlib.decompressobj(wbits=31)  # gzip wrapper
+                if decomp is None:
+                    feed(chunk)
+                    continue
+                # multi-member (concatenated) gzip: each member ends the
+                # decompressobj with the remainder in unused_data — restart a
+                # fresh decompressor per member (gzip.decompress semantics)
+                data = chunk
+                while data:
+                    feed(decomp.decompress(data))
+                    if not decomp.eof:
+                        break
+                    data = decomp.unused_data
+                    decomp = zlib.decompressobj(wbits=31)
+        if decomp:
+            feed(decomp.flush())
+        if line_has_content:
+            count += 1  # final unterminated line
+        return count
+
+    return _retry_transient(op, _classifier(filesystem, fs_path, path))
 
 
 def list_files(root: str) -> list[str]:
@@ -202,7 +263,7 @@ def list_files(root: str) -> list[str]:
     through pyarrow."""
     filesystem, fs_path = _filesystem(root)  # guards the pyarrow import
     from pyarrow import fs as pafs
-    info = filesystem.get_file_info(fs_path)
+    info = _retry_transient(lambda: filesystem.get_file_info(fs_path))
     if info.type == pafs.FileType.NotFound:
         raise FileNotFoundError(f"no such data path: {root}")
     scheme, rest = root.split("://", 1)
@@ -223,7 +284,8 @@ def list_files(root: str) -> list[str]:
         return [root]
     selector = pafs.FileSelector(fs_path, recursive=False)
     out = []
-    for child in sorted(filesystem.get_file_info(selector), key=lambda i: i.path):
+    children = _retry_transient(lambda: filesystem.get_file_info(selector))
+    for child in sorted(children, key=lambda i: i.path):
         if child.type != pafs.FileType.File:
             continue
         base = child.base_name

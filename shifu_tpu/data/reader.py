@@ -39,7 +39,7 @@ def open_maybe_gzip(path: str) -> io.BufferedReader:
 def parse_rows(text: bytes | str, delimiter: str = "|") -> np.ndarray:
     """Parse delimited float rows into an (N, C) float32 array.
 
-    Vectorized: one `np.fromstring`-style C parse over the whole buffer.
+    Vectorized: one C-level tokenize + bulk conversion over the whole buffer.
     Non-numeric cells become NaN (the reference logged-and-skipped them,
     ssgd_monitor.py:404-408; NaN keeps row alignment and is imputed downstream).
     """
@@ -79,16 +79,30 @@ def parse_rows(text: bytes | str, delimiter: str = "|") -> np.ndarray:
 
 
 def _fast_parse(text: str, delimiter: str) -> Optional[np.ndarray]:
-    unified = text.replace(delimiter, " ").replace("\n", " ")
+    # C-level split + bulk float conversion (np.fromstring's text mode is
+    # deprecated-for-removal), processed in newline-aligned slabs so the
+    # per-token str objects exist only for one slab at a time — a whole-file
+    # split would transiently allocate ~6x the text size in cell objects.
+    # A non-numeric cell raises and routes the caller to the ragged parse.
+    slab = 1 << 24  # ~16 MB of text per slab
+    out = []
+    pos, n = 0, len(text)
     try:
-        import warnings
-        with warnings.catch_warnings():
-            # unmatched trailing data (a non-numeric cell) truncates the parse;
-            # the size check in parse_rows routes that to the ragged fallback
-            warnings.simplefilter("ignore")
-            return np.fromstring(unified, dtype=np.float32, sep=" ")
-    except Exception:
+        while pos < n:
+            if n - pos <= slab:
+                end = n
+            else:
+                end = text.rfind("\n", pos, pos + slab)
+                if end <= pos:
+                    end = n  # one line longer than the slab: take it whole
+            chunk = text[pos:end].replace(delimiter, " ")
+            out.append(np.array(chunk.split(), dtype=np.float32))
+            pos = end + 1
+    except (ValueError, OverflowError):
         return None  # caller falls back to the ragged parse
+    if not out:
+        return np.zeros((0,), dtype=np.float32)
+    return out[0] if len(out) == 1 else np.concatenate(out)
 
 
 def _parse_ragged(text: str, delimiter: str, ncols: int) -> np.ndarray:

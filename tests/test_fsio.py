@@ -94,6 +94,128 @@ def test_percent_encoded_uri_after_endpoint_warm(tmp_path):
     assert read_file(f"file://{enc}").shape == (7, 3)
 
 
+@pytest.fixture
+def mock_fs(tmp_path):
+    """pyarrow's in-memory _MockFileSystem behind mock:// URIs — a stand-in
+    namenode: bucket-style paths, remote metadata, no local files.  Populates
+    a data dir with gzip shards + marker files and returns (fs, uri_root)."""
+    from pyarrow import fs as pafs
+
+    filesystem, _ = pafs.FileSystem.from_uri("mock://seed")
+    # endpoint cache would reuse a previous test's (empty) mock instance —
+    # pin THIS one for the ('mock', '') endpoint
+    with fsio._fs_lock:
+        fsio._fs_cache[("mock", "")] = filesystem
+    rng = np.random.default_rng(1)
+    filesystem.create_dir("bucket/data")
+    rows_by_file = {}
+    for i in range(3):
+        rows = rng.standard_normal((10, 4))
+        rows_by_file[f"part-{i:05d}.gz"] = rows
+        text = "\n".join("|".join(f"{v:.6g}" for v in r) for r in rows) + "\n"
+        with filesystem.open_output_stream(f"bucket/data/part-{i:05d}.gz") as s:
+            s.write(gzip.compress(text.encode()))
+    with filesystem.open_output_stream("bucket/data/_SUCCESS") as s:
+        s.write(b"")
+    yield filesystem, "mock://bucket/data", rows_by_file
+    with fsio._fs_lock:
+        fsio._fs_cache.pop(("mock", ""), None)
+
+
+def test_mock_remote_listing_and_read(mock_fs):
+    """The full remote path over a non-local filesystem: list (skipping
+    markers, bucket-style URI rebuild), read+gunzip, stream-count."""
+    filesystem, root, rows_by_file = mock_fs
+    files = list_data_files(root)
+    assert [f.rsplit("/", 1)[1] for f in files] == sorted(rows_by_file)
+    assert all(f.startswith("mock://bucket/data/") for f in files)
+    mat = read_file(files[0])
+    np.testing.assert_allclose(mat, rows_by_file["part-00000.gz"], rtol=1e-5)
+    assert fsio.count_data_lines(files[1]) == 10
+    with pytest.raises(FileNotFoundError):
+        fsio.read_bytes(root + "/missing.gz")
+    with pytest.raises(FileNotFoundError):
+        list_data_files("mock://bucket/absent")
+
+
+def test_mock_remote_cache_identity_on_mtime(mock_fs, tmp_path):
+    """The parse-once cache keys remote URIs by (size, mtime): an in-place
+    overwrite with NEW metadata must invalidate; an unchanged file must hit."""
+    filesystem, root, _ = mock_fs
+    uri = root + "/part-00000.gz"
+    cdir = str(tmp_path / "cache")
+    first = read_file_cached(uri, cache_dir=cdir)
+    hit = read_file_cached(uri, cache_dir=cdir)
+    np.testing.assert_array_equal(first, hit)
+
+    # overwrite in place with different contents (mock fs advances mtime)
+    import time as _time
+    _time.sleep(0.01)
+    new_text = "\n".join("|".join("9" for _ in range(4)) for _ in range(5)) + "\n"
+    with filesystem.open_output_stream("bucket/data/part-00000.gz") as s:
+        s.write(gzip.compress(new_text.encode()))
+    refreshed = read_file_cached(uri, cache_dir=cdir)
+    assert refreshed.shape == (5, 4)
+    np.testing.assert_array_equal(refreshed, np.full((5, 4), 9.0, np.float32))
+
+
+def test_remote_read_retries_transient_errors(mock_fs, monkeypatch):
+    """One flaky open_input_stream must not fail the read: read_bytes
+    retries transient errors (bounded), while disabled retries fail fast.
+    (pyarrow filesystem methods are read-only, so the flaky filesystem is a
+    delegating proxy installed at the endpoint cache — exactly where fsio
+    resolves filesystems from.)"""
+    filesystem, root, _ = mock_fs
+    uri = root + "/part-00001.gz"
+    monkeypatch.delenv("SHIFU_TPU_FS_RETRIES", raising=False)
+    calls = {"n": 0, "fail_first": 1}
+
+    class FlakyFS:
+        def open_input_stream(self, path_, *a, **k):
+            calls["n"] += 1
+            if calls["n"] <= calls["fail_first"]:
+                raise OSError("transient datanode error")
+            return filesystem.open_input_stream(path_, *a, **k)
+
+        def __getattr__(self, name):
+            return getattr(filesystem, name)
+
+    with fsio._fs_lock:
+        fsio._fs_cache[("mock", "")] = FlakyFS()
+    try:
+        data = fsio.read_bytes(uri)
+        assert gzip.decompress(data)
+        assert calls["n"] == 2
+
+        calls["n"] = 0
+        calls["fail_first"] = 10**9  # always down
+        monkeypatch.setenv("SHIFU_TPU_FS_RETRIES", "0")
+        with pytest.raises(OSError, match="transient"):
+            fsio.read_bytes(uri)
+        assert calls["n"] == 1  # retries disabled -> exactly one attempt
+
+        # auth-shaped errors are terminal: no retries even when enabled
+        monkeypatch.setenv("SHIFU_TPU_FS_RETRIES", "3")
+        calls["n"] = 0
+
+        class DeniedFS:
+            def open_input_stream(self, path_, *a, **k):
+                calls["n"] += 1
+                raise OSError("Permission denied: kerberos ticket expired")
+
+            def __getattr__(self, name):
+                return getattr(filesystem, name)
+
+        with fsio._fs_lock:
+            fsio._fs_cache[("mock", "")] = DeniedFS()
+        with pytest.raises(OSError, match="Permission denied"):
+            fsio.read_bytes(uri)
+        assert calls["n"] == 1  # terminal classification: one attempt
+    finally:
+        with fsio._fs_lock:
+            fsio._fs_cache[("mock", "")] = filesystem
+
+
 def test_streaming_count_matches(data_dir, tmp_path):
     # remote count streams (constant memory); must equal the local count,
     # gzip and plain, including a final unterminated non-blank line
