@@ -295,6 +295,14 @@ class TrainConfig:
     # improvement of at least early_stop_min_delta.  0 disables.
     early_stop_patience: int = 0
     early_stop_min_delta: float = 0.0
+    # True local SGD (the reference's SAGN trainer, resources/SAGN.py:110-196):
+    # each data shard runs `local_sgd_window` plain-SGD updates on its OWN
+    # parameter replica between global syncs (parameter all-mean).  0 = off
+    # (every step is globally synchronous, the ssgd_monitor semantics).
+    # Parameter averaging after K local lr-steps equals the reference's
+    # "average the window's accumulated grads, apply globally, resync" with
+    # learning rate K*lr_ref (it divides the window sum by K, SAGN.py:137-142).
+    local_sgd_window: int = 0
 
     def validate(self) -> None:
         if self.epochs <= 0:
@@ -307,6 +315,29 @@ class TrainConfig:
                               f"{self.bagging_sample_rate}")
         if self.loss not in ("weighted_mse", "bce", "weighted_bce"):
             raise ConfigError(f"unknown loss {self.loss!r}")
+        if self.local_sgd_window < 0:
+            raise ConfigError("local_sgd_window must be >= 0")
+        if self.local_sgd_window > 0:
+            # reference SAGN's local updates are plain GradientDescent
+            # (SAGN.py:150-159); momentum/adaptive state on diverged local
+            # replicas has no reference semantic — reject rather than guess
+            if self.optimizer.name != "sgd":
+                raise ConfigError(
+                    "local_sgd_window requires optimizer 'sgd' (the "
+                    "reference SAGN trainer's local updates are plain "
+                    f"gradient descent), got {self.optimizer.name!r}")
+            if self.optimizer.accumulate_steps > 1:
+                raise ConfigError("local_sgd_window and accumulate_steps "
+                                  "are mutually exclusive")
+            if self.optimizer.schedule != "constant":
+                raise ConfigError("local_sgd_window supports only the "
+                                  "constant learning-rate schedule (local "
+                                  "updates use the static lr)")
+            if self.optimizer.grad_clip_norm > 0 or self.optimizer.weight_decay > 0:
+                raise ConfigError(
+                    "local_sgd_window applies plain p - lr*g local updates; "
+                    "grad_clip_norm/weight_decay would be silently ignored "
+                    "— unset them (the reference SAGN has neither)")
         self.optimizer.validate()
 
 

@@ -246,6 +246,12 @@ def parse_model_config(model_config: dict[str, Any]) -> tuple[ModelSpec, TrainCo
 
     algorithm = str(train.get("algorithm", "NN") or "NN").upper()
     model_type = _ALGORITHM_TO_MODEL_TYPE.get(algorithm, "mlp")
+    # SAGN = the reference's local-SGD trainer (resources/SAGN.py): same MLP,
+    # K=5 local plain-SGD updates per global sync (update_window=5,
+    # SAGN.py:110-142); params.LocalSgdWindow overrides / enables it for any
+    # algorithm
+    local_sgd_window = int(params.get(
+        "LocalSgdWindow", 5 if algorithm == "SAGN" else 0))
     # Explicit override hook for new model families wired through the Shifu
     # train step (BASELINE configs 2-5): params.ModelType wins over algorithm.
     if "ModelType" in params:
@@ -279,8 +285,16 @@ def parse_model_config(model_config: dict[str, Any]) -> tuple[ModelSpec, TrainCo
 
     lr = float(params.get("LearningRate", 0.003))  # reference fallback 0.003 (ssgd_monitor.py:136)
     # An explicit params.Optimizer wins; otherwise legacy Propagation codes.
+    # Local-SGD mode uses plain SGD unless explicitly overridden — the
+    # reference SAGN trainer ignores Propagation and always runs
+    # GradientDescent locally (SAGN.py:150-159).
+    if local_sgd_window > 0:
+        opt_name = str(params.get("Optimizer", "sgd")).lower()
+    else:
+        opt_name = str(params.get(
+            "Optimizer", params.get("Propagation", "adadelta"))).lower()
     optimizer = OptimizerConfig(
-        name=str(params.get("Optimizer", params.get("Propagation", "adadelta"))).lower(),
+        name=opt_name,
         learning_rate=lr,
         accumulate_steps=int(params.get("AccumulateSteps", 1)),
         schedule=str(params.get("LearningRateSchedule", "constant")).lower(),
@@ -307,6 +321,7 @@ def parse_model_config(model_config: dict[str, Any]) -> tuple[ModelSpec, TrainCo
         bagging_sample_rate=float(train.get("baggingSampleRate", 1.0)),
         early_stop_patience=int(params.get("EarlyStopPatience", 0)),
         early_stop_min_delta=float(params.get("EarlyStopMinDelta", 0.0)),
+        local_sgd_window=local_sgd_window,
     )
     train_config.validate()
     model_spec.validate()

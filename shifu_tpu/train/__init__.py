@@ -2,8 +2,8 @@ from .checkpoint import make_manager, restore, restore_latest, save
 from .loop import EpochMetrics, TrainResult, evaluate, init_state, train
 from .optimizers import build_optimizer
 from .step import (make_device_epoch_step, make_epoch_scan_step,
-                   make_eval_step, make_forward_fn, make_loss_fn,
-                   make_train_step)
+                   make_eval_step, make_forward_fn, make_local_sgd_epoch_step,
+                   make_loss_fn, make_train_step)
 from .train_state import TrainState
 
 __all__ = [
@@ -18,6 +18,7 @@ __all__ = [
     "train",
     "build_optimizer",
     "make_device_epoch_step",
+    "make_local_sgd_epoch_step",
     "make_epoch_scan_step",
     "make_eval_step",
     "make_forward_fn",

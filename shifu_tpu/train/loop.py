@@ -433,9 +433,18 @@ def train(job: JobConfig,
     use_staged = (not multihost and job.data.staged and job.data.drop_remainder
                   and not use_resident)
     resident_blocks = None
+    local_sgd = job.train.local_sgd_window > 0
+    if local_sgd and not (use_resident or use_staged):
+        raise ValueError(
+            "local_sgd_window (SAGN mode) needs the staged or "
+            "device-resident input tier: set data.staged=True and "
+            "data.drop_remainder=True (local replicas are synchronized by "
+            "epoch scans, not per-batch dispatches)")
     if use_resident:
-        from .step import make_device_epoch_step
-        device_epoch_step = make_device_epoch_step(job, mesh)
+        from .step import make_device_epoch_step, make_local_sgd_epoch_step
+        device_epoch_step = (
+            make_local_sgd_epoch_step(job, mesh, with_order=True)
+            if local_sgd else make_device_epoch_step(job, mesh))
         nb_total = rows_for_blocks // local_bs
 
         def stack(arr):
@@ -452,8 +461,19 @@ def train(job: JobConfig,
         else:
             resident_blocks = {k: jax.device_put(v)
                                for k, v in host_blocks.items()}
+    staged_block_batches = job.data.block_batches
     if use_staged:
-        epoch_scan_step = make_epoch_scan_step(job, mesh)
+        if local_sgd:
+            from .step import make_local_sgd_epoch_step
+            epoch_scan_step = make_local_sgd_epoch_step(job, mesh)
+            # each staged chunk ends in a replica sync (the step averages
+            # back to one tree per call); keep chunks a multiple of the
+            # window so that boundary sync coincides with a scheduled one
+            # and no window is silently truncated mid-stream
+            k = job.train.local_sgd_window
+            staged_block_batches = -(-job.data.block_batches // k) * k
+        else:
+            epoch_scan_step = make_epoch_scan_step(job, mesh)
     elif not use_resident:
         train_step = make_train_step(job, mesh)
     eval_step = make_eval_step(job)
@@ -550,7 +570,7 @@ def train(job: JobConfig,
                 host_blocks = pipe.staged_epoch_blocks(
                     train_ds, bs, shuffle=job.data.shuffle,
                     seed=job.data.shuffle_seed, epoch=epoch,
-                    block_batches=job.data.block_batches)
+                    block_batches=staged_block_batches)
                 put_fn = ((lambda b: shard_lib.shard_blocks(b, mesh))
                           if mesh is not None else None)
                 for blocks in pipe.prefetch_to_device(

@@ -149,6 +149,142 @@ def make_device_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
     return jax.jit(epoch_step, donate_argnums=donate_argnums)
 
 
+def make_local_sgd_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
+                              donate: bool = True, with_order: bool = False):
+    """True local SGD over one epoch — the reference's SAGN trainer
+    (resources/SAGN.py:110-196): each data shard runs `local_sgd_window`
+    plain-SGD updates on its OWN parameter replica, then the replicas sync
+    by global parameter all-mean (equivalent to SAGN's "average the
+    window's accumulated grads, apply through SyncReplicasOptimizer,
+    re-sync global->local" at learning rate K*lr — it divides the window
+    sum by K, SAGN.py:137-142).
+
+    TPU-native formulation: replicas live as ONE stacked pytree with a
+    leading shard axis sharded over `data` (each existing param axis keeps
+    its own sharding, so TP rules compose); local updates are a vmap over
+    that axis — zero communication, XLA runs them device-local — and the
+    periodic sync is a mean over the stacked axis, for which XLA inserts
+    the same ICI all-reduce a synchronous step would pay, just K times
+    less often.  State in/out is a standard TrainState: replicas stack at
+    epoch start and average back at epoch end (an epoch boundary is always
+    a sync point), so eval/checkpoint/export see ordinary params.
+
+    Signature matches make_epoch_scan_step, or make_device_epoch_step when
+    `with_order` (the device-resident tier's shuffled block order).
+    """
+    from ..parallel.mesh import DATA_AXIS
+
+    loss_fn = make_loss_fn(job)
+    K = job.train.local_sgd_window
+    lr = job.train.optimizer.learning_rate
+    n_shards = int(mesh.shape.get(DATA_AXIS, 1)) if mesh is not None else 1
+
+    # Param shardings must be read from CONCRETE arrays before tracing —
+    # inside jit the leaves are tracers whose .sharding is unavailable, and
+    # falling back to P('data', None, ...) would silently drop TP/model-axis
+    # placements.  The jitted step is therefore built on first call, closed
+    # over the shardings of the state actually passed in (init_state placed
+    # it per the job's rules); `param_shardings` holds (stacked, original).
+    param_shardings = []  # mutated once, at first call, before jit traces
+
+    def leaf_shardings(leaf: jax.Array):
+        sh = getattr(leaf, "sharding", None)
+        if mesh is None or not isinstance(sh, NamedSharding):
+            orig = None if mesh is None else NamedSharding(mesh, P())
+            stk = (None if mesh is None
+                   else NamedSharding(mesh, P(DATA_AXIS)))
+            return stk, orig
+        spec = tuple(sh.spec) + (None,) * (leaf.ndim - len(sh.spec))
+        return NamedSharding(mesh, P(DATA_AXIS, *spec)), sh
+
+    def constrain(tree, which: int):
+        if mesh is None:
+            return tree
+        shardings = jax.tree_util.tree_unflatten(
+            param_shardings[1], [s[which] for s in param_shardings[0]])
+        return jax.tree_util.tree_map(
+            jax.lax.with_sharding_constraint, tree, shardings)
+
+    def epoch_step(state: TrainState, blocks: Batch, order=None):
+        nb, bs = blocks["features"].shape[:2]
+        local_bs = bs // n_shards
+
+        stacked = constrain(
+            jax.tree_util.tree_map(
+                lambda p: jnp.broadcast_to(p[None], (n_shards,) + p.shape),
+                state.params),
+            0)
+
+        def shard_loss(params_i, feats, tgt, wgt, step):
+            return loss_fn(params_i, state.apply_fn,
+                           {"features": feats, "target": tgt, "weight": wgt},
+                           step)
+
+        vgrad = jax.vmap(jax.value_and_grad(shard_loss),
+                         in_axes=(0, 0, 0, 0, None))
+
+        def sync(params_p):
+            return constrain(
+                jax.tree_util.tree_map(
+                    lambda p: jnp.broadcast_to(jnp.mean(p, axis=0)[None],
+                                               p.shape), params_p),
+                0)
+
+        def body(carry, xs):
+            params_p, acc, i = carry
+            if with_order:
+                xs = jax.tree_util.tree_map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, xs, axis=0, keepdims=False), blocks)
+            # (B, ...) -> (shards, B/shards, ...): row-major leading split
+            # matches the data-axis layout, so this is a local reshape
+            resh = {k: v.reshape(n_shards, local_bs, *v.shape[1:])
+                    for k, v in xs.items()}
+            losses, grads = vgrad(params_p, resh["features"], resh["target"],
+                                  resh["weight"], state.step + i)
+            params_p = constrain(
+                jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                       params_p, grads),
+                0)
+            params_p = jax.lax.cond((i + 1) % K == 0, sync,
+                                    lambda pp: pp, params_p)
+            return (params_p, acc + jnp.mean(losses), i + 1), None
+
+        xs_in = jnp.asarray(order) if with_order else blocks
+        (params_p, acc, _), _ = jax.lax.scan(
+            body, (stacked, jnp.float32(0.0), jnp.int32(0)), xs_in)
+        # epoch boundary = sync point: average back to one replica, restored
+        # to the original per-param shardings
+        params = constrain(
+            jax.tree_util.tree_map(lambda p: jnp.mean(p, axis=0), params_p),
+            1)
+        new_state = state.replace(params=params, step=state.step + nb)
+        return new_state, acc
+
+    donate_argnums = (0,) if donate else ()
+    jitted = [None]
+
+    def call(state: TrainState, blocks: Batch, order=None):
+        if jitted[0] is None:
+            # first call: state.params leaves are concrete — capture their
+            # real shardings for the traced constraints
+            flat, treedef = jax.tree_util.tree_flatten(state.params)
+            param_shardings.append([leaf_shardings(l) for l in flat])
+            param_shardings.append(treedef)
+            if with_order:
+                jitted[0] = jax.jit(epoch_step,
+                                    donate_argnums=donate_argnums)
+            else:
+                jitted[0] = jax.jit(
+                    lambda st, bl: epoch_step(st, bl),
+                    donate_argnums=donate_argnums)
+        if with_order:
+            return jitted[0](state, blocks, order)
+        return jitted[0](state, blocks)
+
+    return call
+
+
 def make_eval_step(job: JobConfig) -> Callable[[TrainState, Batch], jax.Array]:
     """Scores (sigmoid probabilities) for a batch — the eval forward pass."""
 

@@ -122,6 +122,29 @@ def test_optimizer_explicit_wins_over_propagation():
     assert tc.optimizer.name == "adam"
 
 
+def test_sagn_algorithm_maps_to_local_sgd():
+    """train.algorithm SAGN selects true local SGD with the reference's
+    update_window=5 and plain-SGD local updates (resources/SAGN.py:110-159);
+    LocalSgdWindow overrides the window for any algorithm."""
+    mc = json.loads(json.dumps(MODEL_CONFIG))
+    mc["train"]["algorithm"] = "SAGN"
+    # Propagation stays in the config: the reference SAGN ignores legacy
+    # codes and always uses plain gradient descent locally
+    spec, tc, _ = parse_model_config(mc)
+    assert spec.model_type == "mlp"  # same MLP as ssgd (SAGN.py topology)
+    assert tc.local_sgd_window == 5
+    assert tc.optimizer.name == "sgd"
+
+    mc["train"]["params"]["LocalSgdWindow"] = 3
+    _, tc, _ = parse_model_config(mc)
+    assert tc.local_sgd_window == 3
+
+    mc["train"]["algorithm"] = "NN"
+    del mc["train"]["params"]["LocalSgdWindow"]
+    _, tc, _ = parse_model_config(mc)
+    assert tc.local_sgd_window == 0
+
+
 def test_multi_target_mode_from_shifu_json(tmp_path):
     """BASELINE config #4 shape: Shifu multi-target mode (fraud + chargeback
     heads) selected entirely from unchanged ModelConfig/ColumnConfig JSON --
