@@ -105,12 +105,20 @@ def export_stablehlo(forward_fn, params, num_features: int, path: str,
 
 
 def save_artifact(params: Any, job: JobConfig, export_dir: str,
-                  forward_fn=None, algorithm: str = "tensorflow") -> str:
+                  forward_fn=None, algorithm: str = "tensorflow",
+                  extra_inputs: Optional[dict] = None) -> str:
     """Write the full scoring artifact; returns export_dir.
 
     `algorithm` defaults to "tensorflow" for byte-level sidecar parity with
     the reference (ssgd_monitor.py:476-490) so an unmodified Shifu eval step
     routes the model to its generic scorer the same way.
+
+    `extra_inputs` maps auxiliary input names to constant values; they are
+    recorded as additional sidecar inputnames whose VALUES live in the
+    properties map — the reference's multi-input contract, where
+    TensorflowModel.compute feeds inputNames[1:] from GenericModelConfig
+    properties (TensorflowModel.java:74-87).  Scorers bind them as named
+    buffers (`input:<name>`) the op-list program can reference.
     """
     import dataclasses as _dc
     if (job.model.model_type == "ft_transformer"
@@ -163,6 +171,17 @@ def save_artifact(params: Any, job: JobConfig, export_dir: str,
             "normtype": "ZSCALE",
         },
     }
+    for name, value in (extra_inputs or {}).items():
+        if name in sidecar["properties"] or name == sidecar["inputnames"][0]:
+            raise ValueError(
+                f"extra input name {name!r} collides with a reserved "
+                "sidecar field (algorithm/tags/outputnames/normtype/"
+                "shifu_input_0)")
+        arr = np.asarray(value, dtype=np.float32).ravel()
+        if arr.size == 0:
+            raise ValueError(f"extra input {name!r} has an empty value")
+        sidecar["inputnames"].append(name)
+        sidecar["properties"][name] = arr.tolist()
     with open(os.path.join(export_dir, SIDE_CAR), "w") as f:
         json.dump(sidecar, f, indent=4)
 

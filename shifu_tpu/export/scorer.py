@@ -82,16 +82,47 @@ def _transformer_block(op: dict, w: dict[str, np.ndarray], x: np.ndarray
     return x + y @ w[op["mlp_out_kernel"]] + w[op["mlp_out_bias"]]
 
 
+def extra_inputs_from_sidecar(sidecar: dict) -> dict[str, np.ndarray]:
+    """Auxiliary named inputs per the reference contract: inputnames[1:]
+    take their VALUES from GenericModelConfig properties
+    (TensorflowModel.java:74-87).  Single source of truth for both engines —
+    the numpy Scorer binds these at call time, pack_native lowers them to
+    kConstant ops.  A listed name with no property value fails loudly."""
+    out: dict[str, np.ndarray] = {}
+    props = sidecar.get("properties", {})
+    for name in sidecar.get("inputnames", [])[1:]:
+        if name not in props:
+            raise ValueError(
+                f"sidecar lists extra input {name!r} but its value is "
+                "missing from GenericModelConfig properties "
+                "(TensorflowModel.java:74-87 contract)")
+        value = np.asarray(props[name], np.float32).ravel()
+        if value.size == 0:
+            raise ValueError(f"extra input {name!r} has an empty value")
+        out[name] = value
+    return out
+
+
 def run_program(program: list[dict], weights: dict[str, np.ndarray],
-                x: np.ndarray) -> np.ndarray:
+                x: np.ndarray,
+                extra_inputs: dict[str, np.ndarray] | None = None
+                ) -> np.ndarray:
     """Execute an artifact op-list on (B, F) float32 rows.
 
     Handles both format v1 (implicit dense chain, no src/out fields) and the
     general v2 SSA form (export/program.py).  This interpreter and the native
     C++ engine (runtime/csrc/shifu_scorer.cc) are semantically pinned to each
     other by tests/test_native_scorer.py.
+
+    `extra_inputs` are the sidecar's auxiliary named inputs
+    (TensorflowModel.java:74-87): each becomes a per-row-broadcast buffer
+    `input:<name>` the program may reference.
     """
     bufs: dict[str, np.ndarray] = {"input": x}
+    for name, value in (extra_inputs or {}).items():
+        bufs[f"input:{name}"] = np.broadcast_to(
+            np.asarray(value, np.float32).ravel()[None, :],
+            (x.shape[0], np.asarray(value).size))
     cur = x
     for op in program:
         kind = op["op"]
@@ -181,6 +212,9 @@ class Scorer:
         self.input_names = self.sidecar.get("inputnames", ["shifu_input_0"])
         self.output_name = self.sidecar.get("properties", {}).get(
             "outputnames", "shifu_output_0")
+        # auxiliary named inputs: values come from the sidecar PROPERTIES,
+        # exactly the reference's contract (TensorflowModel.java:74-87)
+        self.extra_inputs = extra_inputs_from_sidecar(self.sidecar)
 
     def compute_batch(self, rows: np.ndarray) -> np.ndarray:
         """Score (N, F) float rows -> (N, num_heads) probabilities."""
@@ -190,7 +224,8 @@ class Scorer:
         if x.shape[1] != self.num_features:
             raise ValueError(
                 f"expected {self.num_features} features, got {x.shape[1]}")
-        return run_program(self.program, self.weights, x)
+        return run_program(self.program, self.weights, x,
+                           extra_inputs=self.extra_inputs)
 
     def compute(self, row: Sequence[float]) -> float:
         """Single-row double score in [0,1] — the reference's exact call shape

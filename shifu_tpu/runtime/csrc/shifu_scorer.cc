@@ -13,7 +13,7 @@
 // Model file format ("model.bin", little-endian, packed by
 // shifu_tpu/runtime/native_scorer.py:pack_native):
 //   magic   u32 = 0x55464853 ("SHFU")
-//   version u32 = 2
+//   version u32 = 3
 //   num_features u32, num_heads u32, num_buffers u32, num_ops u32
 //   per op: opcode u32, dst u32, src u32 (0xFFFFFFFF if unused), then
 //   op-specific fields/weights (see readers below).  Buffer 0 is the input.
@@ -41,7 +41,7 @@
 namespace {
 
 constexpr uint32_t kMagic = 0x55464853u;  // "SHFU"
-constexpr uint32_t kVersion = 2;
+constexpr uint32_t kVersion = 3;  // v3: + kConstant extra-input ops
 constexpr uint32_t kNoBuf = 0xFFFFFFFFu;
 constexpr float kLeakyAlpha = 0.2f;  // TF 1.4 leaky_relu default (parity)
 constexpr float kLnEps = 1e-6f;      // flax nn.LayerNorm default
@@ -73,6 +73,9 @@ enum OpCode : uint32_t {
   kTransformerBlock = 13,
   kExpertDense = 14,   // per-expert dense over stacked (E, I, O) kernels
   kMoeCombine = 15,    // gate-weighted expert combination
+  kConstant = 16,      // sidecar extra-input constant, broadcast per row
+                       // (TensorflowModel.java:74-87 feeds inputNames[1:]
+                       // from GenericModelConfig properties)
 };
 
 struct Op {
@@ -496,6 +499,11 @@ bool infer_shapes(Model* m) {
         out = {2, h.d2, 0};
         break;
       }
+      case kConstant:
+        if (op.src != kNoBuf || op.a == 0 ||
+            op.w0.size() != op.a) return false;
+        out = {2, op.a, 0};
+        break;
       default:
         return false;
     }
@@ -594,6 +602,10 @@ bool read_op(FILE* f, Op* op) {
       uint32_t n = 0;
       return read_u32(f, &n) && n == 2 && read_u32s(f, &op->idx, n);
     }
+    case kConstant:
+      // a=dim; w0 = the constant row
+      return read_u32(f, &op->a) && op->a > 0 && op->a <= kMaxArrayElems &&
+             read_f32s(f, &op->w0, op->a);
     default:
       return false;
   }
@@ -909,6 +921,10 @@ int exec_program(const Model& m, const float* rows, size_t batch, float* out) {
         }
         break;
       }
+      case kConstant:
+        for (size_t b = 0; b < batch; ++b)
+          std::memcpy(dst + b * op.a, op.w0.data(), op.a * sizeof(float));
+        break;
       default:
         return 2;
     }

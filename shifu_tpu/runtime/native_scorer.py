@@ -26,10 +26,12 @@ _OP_CODES = {"dense": 0, "gather_cols": 1, "embed_lookup": 2,
              "numeric_embed": 3, "concat": 4, "flatten": 5, "sum_fields": 6,
              "add": 7, "fm_pair": 8, "activation": 9, "cls_prepend": 10,
              "layernorm": 11, "select_token": 12, "transformer_block": 13,
-             "expert_dense": 14, "moe_combine": 15}
+             "expert_dense": 14, "moe_combine": 15, "constant": 16}
 
 _MAGIC = 0x55464853  # "SHFU"
-_VERSION = 2  # model.bin format — must match kVersion in shifu_scorer.cc
+_VERSION = 3  # model.bin format — must match kVersion in shifu_scorer.cc
+# v3 adds kConstant (sidecar extra-input constants); v2 artifacts repack
+# automatically from topology.json + the sidecar (_is_current)
 _NO_BUF = 0xFFFFFFFF
 MODEL_BIN = "model.bin"
 
@@ -49,8 +51,9 @@ def _act_id(name) -> int:
 
 
 def pack_native(export_dir: str) -> str:
-    """Pack topology.json + weights.npz into model.bin (format v2, the binary
-    mirror of export/program.py's op list); returns its path."""
+    """Pack topology.json + weights.npz (+ sidecar extra inputs) into
+    model.bin (format v3, the binary mirror of export/program.py's op
+    list); returns its path."""
     with open(os.path.join(export_dir, "topology.json")) as f:
         topo = json.load(f)
     program = topo.get("program")
@@ -70,13 +73,34 @@ def pack_native(export_dir: str) -> str:
         return buf_ids[name]
 
     records: list[bytes] = []
+
+    # sidecar extra named inputs (TensorflowModel.java:74-87: inputNames[1:]
+    # fed from GenericModelConfig properties): their values are load-time
+    # constants, so they lower to kConstant ops seeding `input:<name>`
+    # buffers before the program body runs.  Extraction/validation is shared
+    # with the numpy Scorer (export.scorer.extra_inputs_from_sidecar) so the
+    # two engines cannot desynchronize on the contract.
+    sidecar_path = os.path.join(export_dir, "GenericModelConfig.json")
+    if os.path.exists(sidecar_path):
+        from ..export.scorer import extra_inputs_from_sidecar
+        with open(sidecar_path) as f:
+            sidecar = json.load(f)
+        for name, value in extra_inputs_from_sidecar(sidecar).items():
+            records.append(b"".join([
+                struct.pack("<3I", _OP_CODES["constant"],
+                            bid(f"input:{name}"), _NO_BUF),
+                struct.pack("<I", value.shape[0]),
+                np.ascontiguousarray(value).tobytes(),
+            ]))
+    prev_dst = None  # chain threading is per-PROGRAM op (constants excluded)
     for op in program:
         kind = op["op"]
         code = _OP_CODES.get(kind)
         if code is None:
             raise ValueError(f"native pack: unsupported op {kind!r}")
         # v1 artifacts: dense chain without src/out — thread implicitly
-        src = bid(op["src"]) if "src" in op else (prev_dst if records else 0)
+        src = (bid(op["src"]) if "src" in op
+               else (prev_dst if prev_dst is not None else 0))
         dst = bid(op["out"]) if "out" in op else bid(f"__chain{len(records)}")
         parts = [struct.pack("<3I", code, dst,
                              _NO_BUF if kind in ("concat", "add",
@@ -195,13 +219,25 @@ class NativeScorer:
 
     @staticmethod
     def _is_current(bin_path: str) -> bool:
-        """True when model.bin exists with the current format version —
-        artifacts packed by an older release are repacked from topology.json
-        + weights.npz rather than failing to load."""
+        """True when model.bin exists with the current format version AND is
+        newer than every artifact source it was packed from — an edited
+        sidecar (the reference's runtime-configurable extra-input values,
+        TensorflowModel.java:74-87), topology, or weights triggers a repack
+        instead of silently serving stale baked-in constants."""
         try:
             with open(bin_path, "rb") as f:
                 magic, version = struct.unpack("<2I", f.read(8))
-            return magic == _MAGIC and version == _VERSION
+            if magic != _MAGIC or version != _VERSION:
+                return False
+            bin_mtime = os.path.getmtime(bin_path)
+            art_dir = os.path.dirname(bin_path)
+            for src in ("topology.json", "weights.npz",
+                        "GenericModelConfig.json"):
+                src_path = os.path.join(art_dir, src)
+                if os.path.exists(src_path) and \
+                        os.path.getmtime(src_path) > bin_mtime:
+                    return False
+            return True
         except Exception:
             return False
 

@@ -151,6 +151,98 @@ def test_native_full_ladder(tmp_path, model_type):
     nat.close()
 
 
+def test_multi_input_artifact_numpy_and_native(tmp_path):
+    """Reference multi-input contract (TensorflowModel.java:74-87): extra
+    inputnames beyond the first are fed from GenericModelConfig PROPERTIES.
+    A 2-input artifact — features + a constant logit shift — must score
+    identically through the numpy and native engines, and match the
+    hand-computed shift."""
+    import json
+
+    from shifu_tpu.config import JobConfig, ModelSpec
+    from shifu_tpu.data import synthetic
+    from shifu_tpu.runtime import NativeScorer
+
+    schema = synthetic.make_schema(num_features=6)
+    job = JobConfig(
+        schema=schema,
+        model=ModelSpec(model_type="mlp", hidden_nodes=(8,),
+                        activations=("relu",), compute_dtype="float32"),
+    ).validate()
+    state = init_state(job, 6)
+    out = str(tmp_path / "model")
+    save_artifact(state.params, job, out,
+                  extra_inputs={"aux_logit_shift": [0.7]})
+
+    # extend the program to consume the extra input: logits + shift
+    topo_path = os.path.join(out, "topology.json")
+    with open(topo_path) as f:
+        topo = json.load(f)
+    prog = topo["program"]
+    assert [op["out"] for op in prog] == ["trunk_h0", "logits", "score"]
+    prog[2] = {"op": "add", "srcs": ["logits", "input:aux_logit_shift"],
+               "out": "shifted"}
+    prog.append({"op": "activation", "src": "shifted", "out": "score",
+                 "fn": "sigmoid"})
+    with open(topo_path, "w") as f:
+        json.dump(topo, f)
+
+    rng = np.random.default_rng(3)
+    rows = rng.standard_normal((64, 6)).astype(np.float32)
+
+    py = load_scorer(out)
+    assert py.input_names == ["shifu_input_0", "aux_logit_shift"]
+    got = py.compute_batch(rows)
+
+    # expected: sigmoid(logits + 0.7) from the unshifted artifact's logits
+    out_plain = str(tmp_path / "plain")
+    save_artifact(state.params, job, out_plain)
+    plain = load_scorer(out_plain)
+    logits = np.log(plain.compute_batch(rows) /
+                    (1.0 - plain.compute_batch(rows)))
+    expected = 1.0 / (1.0 + np.exp(-(logits + 0.7)))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+    nat = NativeScorer(out)
+    np.testing.assert_allclose(nat.compute_batch(rows), got,
+                               rtol=1e-6, atol=1e-7)
+    assert nat.compute(np.asarray(rows[0], np.float64)) == pytest.approx(
+        float(got[0, 0]), abs=1e-6)
+    nat.close()
+
+    # editing the property value must reach the NATIVE engine too: the
+    # sidecar is the runtime-configurable value source, so a stale model.bin
+    # repacks (mtime check) instead of serving the baked-in constant
+    with open(os.path.join(out, "GenericModelConfig.json")) as f:
+        sidecar = json.load(f)
+    sidecar["properties"]["aux_logit_shift"] = [-0.4]
+    with open(os.path.join(out, "GenericModelConfig.json"), "w") as f:
+        json.dump(sidecar, f)
+    expected2 = 1.0 / (1.0 + np.exp(-(logits - 0.4)))
+    nat2 = NativeScorer(out)
+    np.testing.assert_allclose(nat2.compute_batch(rows), expected2,
+                               rtol=1e-4, atol=1e-5)
+    nat2.close()
+
+    # a sidecar listing an extra input without its property value fails loud
+    # in BOTH engines
+    del sidecar["properties"]["aux_logit_shift"]
+    with open(os.path.join(out, "GenericModelConfig.json"), "w") as f:
+        json.dump(sidecar, f)
+    with pytest.raises(ValueError, match="aux_logit_shift"):
+        load_scorer(out)
+    with pytest.raises(ValueError, match="aux_logit_shift"):
+        NativeScorer(out)
+
+    # export-time validation: reserved-name collision and empty values
+    with pytest.raises(ValueError, match="reserved"):
+        save_artifact(state.params, job, str(tmp_path / "bad1"),
+                      extra_inputs={"normtype": [1.0]})
+    with pytest.raises(ValueError, match="empty"):
+        save_artifact(state.params, job, str(tmp_path / "bad2"),
+                      extra_inputs={"aux": []})
+
+
 def test_native_corrupt_file(tmp_path):
     from shifu_tpu.runtime.native_scorer import build_library
     import ctypes
