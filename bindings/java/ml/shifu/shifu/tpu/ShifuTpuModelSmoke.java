@@ -1,0 +1,57 @@
+/*
+ * Smoke driver for ShifuTpuModel — run when a JDK 22+ is available
+ * (tests/test_java_binding.py compiles and executes it; the environment
+ * without a JDK covers the identical call sequence with the C harness,
+ * bindings/ffm_harness.c).
+ *
+ * Usage: java ml.shifu.shifu.tpu.ShifuTpuModelSmoke <lib.so> <artifactDir> <nRows>
+ *
+ * Prints the same lines as the C harness (num_features/num_heads, the
+ * single-row double score, per-row batch scores) so one pytest compares
+ * either driver's output against the ctypes NativeScorer.
+ */
+package ml.shifu.shifu.tpu;
+
+import java.nio.file.Path;
+
+public final class ShifuTpuModelSmoke {
+
+    private static double gen(long k) { // mirrors ffm_harness.c / the pytest
+        return ((double) ((k * 1103515245L + 12345L) % 1000L)) / 1000.0 - 0.5;
+    }
+
+    public static void main(String[] args) {
+        Path lib = Path.of(args[0]);
+        Path artifact = Path.of(args[1]);
+        int n = Integer.parseInt(args[2]);
+        try (ShifuTpuModel model = new ShifuTpuModel(lib, artifact)) {
+            int nf = model.getNumFeatures();
+            int nh = model.getNumHeads();
+            System.out.println("num_features=" + nf + " num_heads=" + nh);
+
+            double[] drow = new double[nf];
+            for (int j = 0; j < nf; j++) {
+                drow[j] = gen(j);
+            }
+            System.out.printf("single=%.9f%n", model.compute(drow));
+
+            float[][] rows = new float[n][nf];
+            for (int i = 0; i < n; i++) {
+                for (int j = 0; j < nf; j++) {
+                    rows[i][j] = (float) gen((long) i * nf + j);
+                }
+            }
+            float[][] scores = model.computeBatch(rows);
+            for (int i = 0; i < n; i++) {
+                StringBuilder sb = new StringBuilder("row" + i + "=");
+                for (int h = 0; h < nh; h++) {
+                    if (h > 0) {
+                        sb.append(',');
+                    }
+                    sb.append(String.format("%.9f", scores[i][h]));
+                }
+                System.out.println(sb);
+            }
+        }
+    }
+}

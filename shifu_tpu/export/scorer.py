@@ -82,6 +82,20 @@ def _transformer_block(op: dict, w: dict[str, np.ndarray], x: np.ndarray
     return x + y @ w[op["mlp_out_kernel"]] + w[op["mlp_out_bias"]]
 
 
+def _reject_extra_inputs(sidecar: dict, tier: str) -> None:
+    """Tiers that replay the traced single-input forward (jax rebuild,
+    compiled StableHLO) cannot bind sidecar extra inputs; scoring without
+    them would silently diverge from the numpy/native engines — fail loudly
+    instead (the multi-input contract: TensorflowModel.java:74-87)."""
+    extra = sidecar.get("inputnames", ["shifu_input_0"])[1:]
+    if extra:
+        raise ValueError(
+            f"artifact declares extra named inputs {extra} (fed from "
+            f"GenericModelConfig properties); the {tier!r} tier replays the "
+            "single-input traced forward and cannot bind them — score with "
+            "--engine numpy or native")
+
+
 def extra_inputs_from_sidecar(sidecar: dict) -> dict[str, np.ndarray]:
     """Auxiliary named inputs per the reference contract: inputnames[1:]
     take their VALUES from GenericModelConfig properties
@@ -251,6 +265,7 @@ class JaxScorer:
             self.topology = json.load(f)
         with open(os.path.join(export_dir, SIDE_CAR)) as f:
             self.sidecar = json.load(f)
+        _reject_extra_inputs(self.sidecar, "jax")
         spec = _from_dict(ModelSpec, self.topology["model_spec"])
         schema = _from_dict(DataSchema, self.topology["schema"])
         self.num_features = int(self.topology["num_features"])
@@ -300,6 +315,10 @@ class StableHloScorer:
 
         with open(os.path.join(export_dir, TOPOLOGY)) as f:
             self.topology = json.load(f)
+        sidecar_path = os.path.join(export_dir, SIDE_CAR)
+        if os.path.exists(sidecar_path):
+            with open(sidecar_path) as f:
+                _reject_extra_inputs(json.load(f), "stablehlo")
         self.num_features = int(self.topology["num_features"])
         path = os.path.join(export_dir, JAX_EXPORT)
         with open(path, "rb") as f:

@@ -50,6 +50,27 @@ def _act_id(name) -> int:
     return act
 
 
+def _src_digest(export_dir: str) -> str:
+    """Digest of everything a packed model.bin derives from: topology and
+    sidecar CONTENT (small json — hashing dodges mtime-granularity races on
+    the runtime-configurable extra-input values), weights by (size, mtime)
+    (they are written once at export and can be large)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for name in ("topology.json", "GenericModelConfig.json"):
+        p = os.path.join(export_dir, name)
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                h.update(f.read())
+        h.update(b"|")
+    wp = os.path.join(export_dir, "weights.npz")
+    if os.path.exists(wp):
+        st = os.stat(wp)
+        h.update(f"{st.st_size}:{st.st_mtime_ns}".encode())
+    return h.hexdigest()
+
+
 def pack_native(export_dir: str) -> str:
     """Pack topology.json + weights.npz (+ sidecar extra inputs) into
     model.bin (format v3, the binary mirror of export/program.py's op
@@ -181,6 +202,9 @@ def pack_native(export_dir: str) -> str:
                             int(topo["num_heads"]), len(buf_ids),
                             len(records)))
         f.write(b"".join(records))
+    with open(out_path + ".meta", "w") as f:
+        json.dump({"format_version": _VERSION,
+                   "src_digest": _src_digest(export_dir)}, f)
     return out_path
 
 
@@ -219,25 +243,25 @@ class NativeScorer:
 
     @staticmethod
     def _is_current(bin_path: str) -> bool:
-        """True when model.bin exists with the current format version AND is
-        newer than every artifact source it was packed from — an edited
-        sidecar (the reference's runtime-configurable extra-input values,
-        TensorflowModel.java:74-87), topology, or weights triggers a repack
-        instead of silently serving stale baked-in constants."""
+        """True when model.bin exists with the current format version AND
+        its recorded source digest matches the artifact's current sources —
+        an edited sidecar (the reference's runtime-configurable extra-input
+        values, TensorflowModel.java:74-87) or topology triggers a repack
+        instead of silently serving stale baked-in constants.  Content
+        digests, not mtimes: coarse-granularity filesystems make
+        same-tick edits invisible to timestamp comparison."""
         try:
             with open(bin_path, "rb") as f:
                 magic, version = struct.unpack("<2I", f.read(8))
             if magic != _MAGIC or version != _VERSION:
                 return False
-            bin_mtime = os.path.getmtime(bin_path)
-            art_dir = os.path.dirname(bin_path)
-            for src in ("topology.json", "weights.npz",
-                        "GenericModelConfig.json"):
-                src_path = os.path.join(art_dir, src)
-                if os.path.exists(src_path) and \
-                        os.path.getmtime(src_path) > bin_mtime:
-                    return False
-            return True
+            meta_path = bin_path + ".meta"
+            if not os.path.exists(meta_path):
+                return False  # packed by an older release: repack
+            with open(meta_path) as f:
+                meta = json.load(f)
+            return meta.get("src_digest") == _src_digest(
+                os.path.dirname(bin_path))
         except Exception:
             return False
 

@@ -95,6 +95,7 @@ def test_ulysses_attention_grad_flows(eight_devices):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
 def test_ft_transformer_sequence_parallel_training(eight_devices, impl):
     """ModelSpec.attention_impl routes the FT-Transformer through
@@ -147,6 +148,7 @@ def test_ft_transformer_sequence_parallel_training(eight_devices, impl):
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_ring_attention_grad_flows(eight_devices):
     """Differentiable end-to-end (training path)."""
     mesh = make_mesh(MeshConfig(data=1, seq=2), devices=eight_devices[:2])

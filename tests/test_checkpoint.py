@@ -135,6 +135,7 @@ def test_async_save_resume_equivalence(tmp_path, small_job, small_data):
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_resume_across_mesh_topologies(tmp_path, small_data):
     """Elastic re-provision: a checkpoint written while training on an
     8-way data-parallel mesh resumes on a 2x2 (data x model) mesh — and on
@@ -195,6 +196,7 @@ def test_resume_across_mesh_topologies(tmp_path, small_data):
     assert [m.epoch for m in r_single.history] == [3]
 
 
+@pytest.mark.slow
 def test_resume_across_pipeline_trunk_layout(tmp_path, eight_devices):
     """A checkpoint written by a pipeline-parallel run (stacked trunk)
     resumes a non-pipelined run of the same model — and vice versa — with

@@ -25,19 +25,25 @@ def _load_make_demo(demo):
 # (demo dir, schema kwargs beyond num_features, rows, epochs, seed, noise,
 #  min AUC) — wdbc is BASELINE config #1 (3x100 MLP), ctr is config #3
 # (DeepFM over mixed numeric/categorical)
+# wdbc stays in the fast tier (the canonical e2e smoke); the DeepFM and
+# FT-Transformer demos are slow-tier (13s / 78s of compile-heavy subprocess)
 DEMOS = [
     ("wdbc_demo", {}, 1200, 8, 7, 0.3, 0.8),
-    ("ctr_demo", {"num_categorical": "CAT_FEATURES", "vocab_size": "VOCAB"},
-     1500, 6, 11, 0.4, 0.6),
+    pytest.param("ctr_demo",
+                 {"num_categorical": "CAT_FEATURES", "vocab_size": "VOCAB"},
+                 1500, 6, 11, 0.4, 0.6, marks=pytest.mark.slow,
+                 id="ctr_demo"),
     # config #5 stretch rung: FT-Transformer over the feature-token axis
     # with remat + warmup-cosine schedule (examples/wide_demo)
-    ("wide_demo", {"num_categorical": "CAT_FEATURES", "vocab_size": "VOCAB"},
-     1200, 4, 23, 0.4, 0.6),
+    pytest.param("wide_demo",
+                 {"num_categorical": "CAT_FEATURES", "vocab_size": "VOCAB"},
+                 1200, 4, 23, 0.4, 0.6, marks=pytest.mark.slow,
+                 id="wide_demo"),
 ]
 
 
 @pytest.mark.parametrize("demo,extra,rows,epochs,seed,noise,min_auc", DEMOS,
-                         ids=[d[0] for d in DEMOS])
+                         )
 def test_demo_end_to_end(tmp_path, demo, extra, rows, epochs, seed, noise,
                          min_auc):
     make_demo = _load_make_demo(demo)

@@ -1,0 +1,130 @@
+"""JVM binding ABI proof (round-1 VERDICT item #7).
+
+The reference exercised its Java scorer from Java (TensorflowModelTest.java:
+35-60).  This environment ships no JDK, so the binding's ABI/layout
+assumptions are executed two ways:
+
+1. ALWAYS: a C harness (bindings/ffm_harness.c) that replicates
+   ShifuTpuModel.java's exact FFM call sequence — dlopen/dlsym per
+   SymbolLookup, the same FunctionDescriptor signatures, the same call order
+   and error checks — and prints every score for comparison against the
+   ctypes NativeScorer.
+2. WHEN A JDK 22+ EXISTS: compile and run the real Java smoke driver
+   (ShifuTpuModelSmoke.java) and compare the identical output (skipped
+   cleanly otherwise).
+"""
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from shifu_tpu.export import save_artifact
+from shifu_tpu.train import init_state
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HARNESS_SRC = os.path.join(REPO, "bindings", "ffm_harness.c")
+JAVA_DIR = os.path.join(REPO, "bindings", "java")
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="g++ not available")
+
+N_ROWS = 16
+
+
+def _gen(k: np.ndarray) -> np.ndarray:
+    """The deterministic row generator shared with both drivers."""
+    return ((k * 1103515245 + 12345) % 1000) / 1000.0 - 0.5
+
+
+@pytest.fixture(scope="module")
+def binding_artifact(tmp_path_factory):
+    from shifu_tpu.config import JobConfig, ModelSpec
+    from shifu_tpu.data import synthetic
+    from shifu_tpu.runtime import NativeScorer, build_library, pack_native
+
+    schema = synthetic.make_schema(num_features=8)
+    job = JobConfig(
+        schema=schema,
+        model=ModelSpec(model_type="mlp", hidden_nodes=(12,),
+                        activations=("relu",), compute_dtype="float32"),
+    ).validate()
+    state = init_state(job, 8)
+    out = str(tmp_path_factory.mktemp("binding") / "model")
+    save_artifact(jax.device_get(state.params), job, out)
+    pack_native(out)
+    lib = build_library()
+
+    # reference outputs through the ctypes binding (same .so, same model.bin)
+    ns = NativeScorer(out)
+    k = np.arange(8, dtype=np.int64)
+    single = ns.compute(_gen(k).astype(np.float64))
+    kb = np.arange(N_ROWS * 8, dtype=np.int64).reshape(N_ROWS, 8)
+    batch = ns.compute_batch(_gen(kb).astype(np.float32))
+    ns.close()
+    return lib, out, float(single), batch
+
+
+def _check_output(text: str, single: float, batch: np.ndarray) -> None:
+    assert "num_features=8 num_heads=1" in text
+    m = re.search(r"single=([\d.]+)", text)
+    assert m and float(m.group(1)) == pytest.approx(single, abs=1e-7)
+    rows = re.findall(r"row(\d+)=([\d.,]+)", text)
+    assert len(rows) == N_ROWS
+    got = np.array([[float(v) for v in vals.split(",")]
+                    for _, vals in sorted(rows, key=lambda r: int(r[0]))])
+    np.testing.assert_allclose(got, batch, atol=1e-6)
+
+
+def test_ffm_call_sequence_c_harness(binding_artifact, tmp_path):
+    """The Java binding's exact FFM call sequence executed natively:
+    dlopen -> dlsym x6 -> load -> dims -> compute(double*) ->
+    compute_batch(float*, int, float*) -> free, with the binding's checks."""
+    lib, artifact, single, batch = binding_artifact
+    exe = str(tmp_path / "ffm_harness")
+    subprocess.run(["g++", "-O2", "-o", exe, HARNESS_SRC, "-ldl"],
+                   check=True, capture_output=True, text=True)
+    r = subprocess.run(
+        [exe, lib, os.path.join(artifact, "model.bin"), str(N_ROWS)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    _check_output(r.stdout, single, batch)
+
+    # the binding's NULL-handle check path: a bogus model path must return
+    # NULL from shifu_scorer_load (exit 3), not crash
+    r2 = subprocess.run([exe, lib, os.path.join(artifact, "nope.bin"), "1"],
+                        capture_output=True, text=True, timeout=60)
+    assert r2.returncode == 3
+
+
+def test_java_smoke_when_jdk_present(binding_artifact, tmp_path):
+    """Compile + run the REAL ShifuTpuModel through a JDK when one exists;
+    cleanly skipped otherwise (this image has no JDK)."""
+    javac, java = shutil.which("javac"), shutil.which("java")
+    if not javac or not java:
+        pytest.skip("no JDK in environment")
+    probe = subprocess.run([java, "-version"], capture_output=True, text=True)
+    ver = re.search(r'version "(\d+)', probe.stderr or probe.stdout)
+    if not ver or int(ver.group(1)) < 22:
+        pytest.skip("JDK 22+ (java.lang.foreign) required")
+
+    lib, artifact, single, batch = binding_artifact
+    classes = str(tmp_path / "classes")
+    r = subprocess.run(
+        [javac, "-d", classes,
+         os.path.join(JAVA_DIR, "ml/shifu/shifu/tpu/ShifuTpuModel.java"),
+         os.path.join(JAVA_DIR, "ml/shifu/shifu/tpu/ShifuTpuModelSmoke.java")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run(
+        [java, "--enable-native-access=ALL-UNNAMED", "-cp", classes,
+         "ml.shifu.shifu.tpu.ShifuTpuModelSmoke", lib, artifact, str(N_ROWS)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    _check_output(r.stdout, single, batch)

@@ -114,6 +114,7 @@ def test_timeout_exit_code(job_dir):
     assert "timeout" in r.stdout.lower()
 
 
+@pytest.mark.slow
 def test_supervisor_recovers_from_injected_fault(job_dir):
     """Fault injection: child dies after epoch 0; supervisor restarts it and
     checkpoint-resume finishes the job — the backup-worker capability at SPMD
@@ -138,6 +139,7 @@ def test_supervisor_recovers_from_injected_fault(job_dir):
     assert (out / "final_model" / "weights.npz").exists()
 
 
+@pytest.mark.slow
 def test_supervisor_liveness_kills_hung_child(job_dir):
     """Heartbeat-liveness parity (TensorflowApplicationMaster.java:63-112):
     a child that stops writing board progress for shifu.liveness.seconds is
@@ -188,6 +190,7 @@ def test_liveness_config_keys():
     assert job.runtime.liveness_seconds == 0.0  # default: off
 
 
+@pytest.mark.slow
 def test_supervisor_budget_exhausted(job_dir):
     out = job_dir / "out_b"
     env = _cli_env()
@@ -204,6 +207,7 @@ def test_supervisor_budget_exhausted(job_dir):
     assert "restart budget exhausted" in r.stdout
 
 
+@pytest.mark.slow
 def test_globalconfig_xml_overrides(job_dir):
     from shifu_tpu.utils import xmlconfig
     xml = job_dir / "global.xml"
@@ -225,6 +229,7 @@ def test_globalconfig_xml_overrides(job_dir):
     assert "Epoch 1:" not in r.stdout
 
 
+@pytest.mark.slow
 def test_mesh_from_globalconfig_sequence_parallel(job_dir):
     """shifu.mesh.* XML keys drive the device mesh: a data x seq topology
     trains an FT-Transformer with ring attention through the CLI — the full
@@ -343,6 +348,7 @@ def test_kerberos_config_and_kinit(monkeypatch, tmp_path):
                                job.runtime.kerberos_keytab)
 
 
+@pytest.mark.slow
 def test_eval_cli_multi_target_per_head(tmp_path):
     """Multi-target mode through the full CLI: train MTL from JSON, then
     `eval` reports per-head AUC/error alongside the head-0 summary."""

@@ -45,6 +45,7 @@ def test_embedding_models_learn(model_type):
     assert result.history[-1].valid_auc > 0.62, result.history[-1]
 
 
+@pytest.mark.slow
 def test_ft_transformer_learns():
     schema = synthetic.make_schema(num_features=10, num_categorical=2, vocab_size=12)
     job = _job(schema, "ft_transformer", num_layers=2, num_attention_heads=4,
@@ -129,6 +130,7 @@ def test_deepfm_embedding_sharded_on_mesh(eight_devices):
     assert new_state.params["cat_embedding"]["embedding"].sharding.spec[0] == "model"
 
 
+@pytest.mark.slow
 def test_remat_matches_unremat_gradients():
     """ModelSpec.remat recomputes block activations in the backward pass;
     forward and gradients must be identical to the stored-activation model

@@ -242,6 +242,15 @@ def test_multi_input_artifact_numpy_and_native(tmp_path):
         save_artifact(state.params, job, str(tmp_path / "bad2"),
                       extra_inputs={"aux": []})
 
+    # tiers that replay the single-input traced forward must reject
+    # multi-input artifacts instead of silently scoring without the shift
+    from shifu_tpu.export.scorer import JaxScorer
+    out3 = str(tmp_path / "multi2")
+    save_artifact(state.params, job, out3,
+                  extra_inputs={"aux_logit_shift": [0.7]})
+    with pytest.raises(ValueError, match="extra named inputs"):
+        JaxScorer(out3)
+
 
 def test_native_corrupt_file(tmp_path):
     from shifu_tpu.runtime.native_scorer import build_library

@@ -138,6 +138,7 @@ def test_ft_transformer_flash_impl_matches_local(monkeypatch):
                                rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_ft_transformer_flash_forced_kernel(monkeypatch):
     """With the kernel forced on (interpret mode on CPU), training-style
     forward+grad through the FT-Transformer stays finite and close to the
@@ -167,6 +168,7 @@ def test_ft_transformer_flash_forced_kernel(monkeypatch):
     assert all(np.all(np.isfinite(np.asarray(g))) for g in leaves)
 
 
+@pytest.mark.slow
 def test_flash_wide_token_axis_gradients():
     """Token counts far beyond the block size (513 = a wide table's 512
     feature tokens + CLS, not block-aligned): the multi-block grid must

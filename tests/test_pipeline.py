@@ -47,6 +47,7 @@ def test_pipeline_matches_reference_forward(eight_devices, rng):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_matches_reference_gradients(eight_devices, rng):
     mesh = _pipe_mesh(eight_devices)
     L, d, n_micro, mb = 4, 8, 4, 4
@@ -91,6 +92,7 @@ def _ft_batch(job, n, seed=0):
     return reader.project_columns(rows, job.schema)
 
 
+@pytest.mark.slow
 def test_pipelined_train_step_matches_single_device(eight_devices):
     """Pipeline-parallel update == single-device update on the same batch
     (the same sync-semantics contract as test_parallel's data-parallel case)."""

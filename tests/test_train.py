@@ -213,6 +213,7 @@ def test_dropout_trains_stochastic_eval_deterministic(small_job, small_data):
     assert np.isfinite(result.history[-1].train_error)
 
 
+@pytest.mark.slow
 def test_dropout_all_models_train_flag(small_data):
     """Every ladder model honors train=True dropout: forward under a
     dropout rng differs from the deterministic eval forward."""

@@ -72,6 +72,7 @@ def test_schema_ingestion_width(wide_job):
                for i in job.schema.categorical_indices)
 
 
+@pytest.mark.slow
 def test_wide_train_export_score(wide_job):
     from shifu_tpu.export import load_scorer, save_artifact
     from shifu_tpu.runtime import NativeScorer
