@@ -184,3 +184,20 @@ def test_empty_file(tmp_path):
     got = native_parser.parse_file(p)
     assert got.shape[0] == 0
     assert native_parser.count_rows(p) == 0
+
+
+def test_tab_delimiter_empty_cells_align():
+    """Whitespace delimiters must split columns exactly like the Python
+    tier: an empty tab-delimited cell is NaN in place, never swallowed as
+    padding (regression: the fused scanner skipped tabs as whitespace,
+    shifting columns left)."""
+    import numpy as np
+
+    from shifu_tpu.data import native_parser, reader
+
+    for payload in (b"1\t\t2\n", b"1\t \t2\n", b"\t5\t\n", b"1\t2\t3\n"):
+        nat = native_parser.parse_buffer(payload, "\t")
+        py = reader.parse_rows(payload, "\t")
+        np.testing.assert_array_equal(np.isnan(nat), np.isnan(py), err_msg=payload)
+        np.testing.assert_array_equal(np.nan_to_num(nat), np.nan_to_num(py),
+                                      err_msg=payload)
