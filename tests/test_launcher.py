@@ -542,10 +542,13 @@ def test_score_cli_bad_native_artifact_reports(tmp_path, small_job):
     state = init_state(small_job, 30)
     art = str(tmp_path / "artifact")
     save_artifact(jax.device_get(state.params), small_job, art)
-    # current magic+version so NativeScorer skips the repack path, but a
-    # truncated body the C loader must reject
+    # current magic+version AND a matching source digest so NativeScorer
+    # skips the repack path, but a truncated body the C loader must reject
     with open(tmp_path / "artifact" / ns.MODEL_BIN, "wb") as f:
         f.write(struct.pack("<2I", ns._MAGIC, ns._VERSION))
+    with open(tmp_path / "artifact" / (ns.MODEL_BIN + ".meta"), "w") as f:
+        json.dump({"format_version": ns._VERSION,
+                   "src_digest": ns._src_digest(art)}, f)
     inp = tmp_path / "rows.psv"
     inp.write_text("|".join(["0.1"] * 30) + "\n")
     rc = cli.main(["score", "--model", art, "--input", str(inp),
