@@ -409,10 +409,11 @@ def train(job: JobConfig,
                 "processes) — lower the batch size or rebalance file shards")
 
     # input-path tier selection: device-resident (dataset fits HBM budget)
-    # > staged blocks > per-batch host feed.  Multi-host uses the resident
-    # tier too — each host stacks its shard into (nb, local_B, ...) blocks
-    # that assemble into global arrays, with nb agreed across hosts — so
-    # distributed epochs are one collective scan, not per-batch dispatches.
+    # > staged blocks > per-batch host feed.  Multi-host supports all
+    # three — resident/staged stack each host's shard into (nb, local_B,
+    # ...) blocks that assemble into global arrays, with nb agreed across
+    # hosts — so distributed epochs are collective scans, not per-batch
+    # dispatches, even when the dataset exceeds HBM.
     rows_for_blocks = min_host_rows if multihost else train_ds.num_rows
     # agreed across hosts: per-row bytes are schema-determined (identical
     # everywhere), and the tier only stages the usable rows_for_blocks
@@ -425,7 +426,7 @@ def train(job: JobConfig,
     use_resident = (job.data.staged and job.data.drop_remainder
                     and 0 < ds_bytes <= job.data.device_resident_bytes
                     and rows_for_blocks // local_bs > 0)
-    use_staged = (not multihost and job.data.staged and job.data.drop_remainder
+    use_staged = (job.data.staged and job.data.drop_remainder
                   and not use_resident)
     resident_blocks = None
     local_sgd = job.train.local_sgd_window > 0
@@ -458,6 +459,34 @@ def train(job: JobConfig,
                                for k, v in host_blocks.items()}
     staged_block_batches = job.data.block_batches
     if use_staged:
+        # loop-invariant staged-tier plumbing (the per-epoch subset below
+        # still varies when shards are imbalanced)
+        if multihost:
+            staged_put_fn = (lambda b:
+                             shard_lib.shard_blocks_process_local(b, mesh))
+        elif mesh is not None:
+            staged_put_fn = lambda b: shard_lib.shard_blocks(b, mesh)
+        else:
+            staged_put_fn = None
+
+        def staged_source(epoch: int) -> pipe.TabularDataset:
+            """This host's rows for one staged epoch.  Multihost hosts must
+            contribute exactly min_host_rows each (agreed block counts); a
+            host with MORE rows draws a fresh epoch-seeded subset so its
+            tail rows are still sampled across epochs (the per-batch path
+            reshuffles the whole shard per epoch — a fixed prefix would
+            silently never train the excess)."""
+            if not multihost or train_ds.num_rows <= min_host_rows:
+                return train_ds
+            if job.data.shuffle:
+                rng = np.random.default_rng(
+                    np.random.PCG64(job.data.shuffle_seed * 9176 + epoch))
+                keep = np.sort(rng.permutation(
+                    train_ds.num_rows)[:min_host_rows])
+            else:
+                keep = np.arange(min_host_rows)
+            return train_ds.take(keep)
+
         if local_sgd:
             from .step import make_local_sgd_epoch_step
             epoch_scan_step = make_local_sgd_epoch_step(job, mesh)
@@ -562,12 +591,18 @@ def train(job: JobConfig,
                 loss_n = nb_total
                 timer.mark_step_done()
             elif use_staged:
+                # multihost: every host streams blocks of its OWN shard's
+                # epoch subset (exactly min_host_rows rows), so the
+                # block-count sequence (a pure function of
+                # num_rows/batch/seed/epoch) is identical everywhere and
+                # each chunk's scan is one agreed collective dispatch — the
+                # out-of-HBM successor of the per-batch collective path, at
+                # scan-tier dispatch rates
                 host_blocks = pipe.staged_epoch_blocks(
-                    train_ds, bs, shuffle=job.data.shuffle,
+                    staged_source(epoch), local_bs, shuffle=job.data.shuffle,
                     seed=job.data.shuffle_seed, epoch=epoch,
                     block_batches=staged_block_batches)
-                put_fn = ((lambda b: shard_lib.shard_blocks(b, mesh))
-                          if mesh is not None else None)
+                put_fn = staged_put_fn
                 for blocks in pipe.prefetch_to_device(
                         host_blocks, mesh, size=job.data.prefetch, put_fn=put_fn):
                     timer.mark_input_ready()

@@ -66,6 +66,9 @@ KEY_DATA_CACHE_DIR = "shifu.data.cache-dir"
 KEY_DATA_OUT_OF_CORE = "shifu.data.out-of-core"
 KEY_DATA_STAGED = "shifu.data.staged"
 KEY_DATA_READ_THREADS = "shifu.data.read-threads"
+# HBM budget for the device-resident input tier (bytes); datasets above it
+# use the staged-blocks tier
+KEY_DATA_RESIDENT_BYTES = "shifu.data.device-resident-bytes"
 
 
 def parse_sharding_rules(value: str) -> tuple:
@@ -166,6 +169,10 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         import dataclasses
         data = dataclasses.replace(
             data, out_of_core=parse_bool(conf[KEY_DATA_OUT_OF_CORE]))
+    if KEY_DATA_RESIDENT_BYTES in conf:
+        import dataclasses
+        data = dataclasses.replace(
+            data, device_resident_bytes=int(conf[KEY_DATA_RESIDENT_BYTES]))
     if KEY_DATA_STAGED in conf:
         import dataclasses
         data = dataclasses.replace(

@@ -213,9 +213,13 @@ def test_pod_launch_gang_restart_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("staged", [True, False],
-                         ids=["resident-tier", "per-batch-tier"])
-def test_cli_num_processes_end_to_end(tmp_path, staged):
+@pytest.mark.parametrize(
+    "tier_keys",
+    [{"shifu.data.staged": "true"},
+     {"shifu.data.staged": "true", "shifu.data.device-resident-bytes": "0"},
+     {"shifu.data.staged": "false"}],
+    ids=["resident-tier", "staged-blocks-tier", "per-batch-tier"])
+def test_cli_num_processes_end_to_end(tmp_path, tier_keys):
     """The launcher's own multi-process mode: `train --num-processes 2`
     spawns coordinated processes (SHIFU_TPU_* contract), each loads its own
     file shard, batches assemble process-locally into global arrays
@@ -248,10 +252,10 @@ def test_cli_num_processes_end_to_end(tmp_path, staged):
                     os.path.abspath(__file__)))})
     from shifu_tpu.utils import xmlconfig
     gconf = tmp_path / "global.xml"
-    # staged=False forces the per-batch process-local input path; True uses
-    # the device-resident collective-scan tier — both must work multi-host
-    xmlconfig.write_configuration_xml(
-        {xmlconfig.KEY_DATA_STAGED: str(staged).lower()}, str(gconf))
+    # three multihost input tiers: device-resident collective scan (fits
+    # HBM budget), staged blocks (budget forced to 0 — the out-of-HBM scan
+    # path), and the per-batch process-local feed (staged off)
+    xmlconfig.write_configuration_xml(tier_keys, str(gconf))
     out = tmp_path / "job"
     r = subprocess.run(
         [sys.executable, "-m", "shifu_tpu.launcher.cli", "train",
