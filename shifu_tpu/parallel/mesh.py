@@ -26,13 +26,33 @@ PIPE_AXIS = "pipe"
 MODEL_AXIS = "model"
 
 
+def _num_slices(devices: Sequence[jax.Device]) -> int:
+    """Distinct TPU slices among `devices` (1 on CPU / single slice).
+
+    Multi-slice (Multipod/Multislice) runs expose `slice_index` on each
+    device; collectives WITHIN a slice ride ICI, across slices they ride
+    DCN — orders of magnitude slower, so axis placement must respect the
+    boundary."""
+    seen = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    return max(len(seen), 1)
+
+
 def make_mesh(cfg: Optional[MeshConfig] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a Mesh with axes (data, seq, model).
+    """Build a Mesh with axes (data, seq, pipe, model).
 
     With no config, all local devices go on the data axis — the common
-    data-parallel tabular case.  Axis sizes must multiply to the device count.
-    """
+    data-parallel tabular case.  Axis sizes must multiply to the device
+    count.
+
+    Multi-slice TPU (devices spanning >1 `slice_index`): the mesh is built
+    with `create_hybrid_device_mesh`, splitting the DATA axis across slices
+    so only the gradient all-reduce's slice-level partial crosses DCN, while
+    model/seq/pipe collectives (all-gathers, all-to-alls, ppermute rings —
+    latency-sensitive, per-layer) stay on ICI inside a slice.  This mirrors
+    the standard DCN=data-parallel recipe; it requires `data` to be a
+    multiple of the slice count (the natural layout: N equal data shards
+    per slice)."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if cfg is None:
@@ -46,8 +66,37 @@ def make_mesh(cfg: Optional[MeshConfig] = None,
              "model": cfg.model}
     axis_names = tuple(cfg.axis_order)
     shape = tuple(sizes[a] for a in axis_names)
+
+    from jax.experimental import mesh_utils
+
+    slices = _num_slices(devices)
+    if slices > 1:
+        if cfg.data % slices != 0:
+            raise ConfigError(
+                f"multi-slice mesh: data axis ({cfg.data}) must be a "
+                f"multiple of the slice count ({slices}) so model/seq/pipe "
+                "collectives stay on ICI within a slice")
+        per_slice = {}
+        for d in devices:
+            key = getattr(d, "slice_index", 0) or 0
+            per_slice[key] = per_slice.get(key, 0) + 1
+        if len(set(per_slice.values())) != 1:
+            # a device *prefix* of a multi-slice pod (e.g. --devices or a
+            # partial mesh) can span slices unevenly; fail with the real
+            # misconfiguration, not mesh_utils' internal granule error
+            raise ConfigError(
+                "multi-slice mesh: the selected devices cover slices "
+                f"unevenly ({dict(sorted(per_slice.items()))}); use all "
+                "devices of every participating slice")
+        ici_shape = tuple(sizes[a] // slices if a == DATA_AXIS else sizes[a]
+                          for a in axis_names)
+        dcn_shape = tuple(slices if a == DATA_AXIS else 1
+                          for a in axis_names)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+        return Mesh(dev_array, axis_names)
+
     try:
-        from jax.experimental import mesh_utils
         dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
     except Exception:
         dev_array = np.asarray(devices).reshape(shape)

@@ -44,6 +44,57 @@ def test_make_mesh_shapes(eight_devices):
     assert mesh2.shape["data"] == 8
 
 
+def test_multi_slice_hybrid_mesh(eight_devices, monkeypatch):
+    """Multi-slice TPU (devices spanning >1 slice_index): the data axis
+    splits across DCN and model/seq/pipe stay on ICI within a slice — the
+    standard DCN=data-parallel recipe, via create_hybrid_device_mesh."""
+    from shifu_tpu.parallel import mesh as mesh_mod
+
+    # slice detection from device attributes
+    class D:
+        def __init__(self, s):
+            self.slice_index = s
+    assert mesh_mod._num_slices([D(0), D(0), D(1), D(1)]) == 2
+    assert mesh_mod._num_slices(eight_devices) == 1  # CPU: no slices
+
+    # hybrid construction: data splits ici x dcn, other axes all-ICI
+    captured = {}
+
+    def fake_hybrid(ici_shape, dcn_shape, devices=None):
+        captured["ici"] = tuple(ici_shape)
+        captured["dcn"] = tuple(dcn_shape)
+        from jax.experimental import mesh_utils
+        return mesh_utils.create_device_mesh(
+            tuple(i * d for i, d in zip(ici_shape, dcn_shape)),
+            devices=devices)
+
+    monkeypatch.setattr(mesh_mod, "_num_slices", lambda d: 2)
+    from jax.experimental import mesh_utils as mu
+    monkeypatch.setattr(mu, "create_hybrid_device_mesh", fake_hybrid)
+    mesh = mesh_mod.make_mesh(MeshConfig(data=4, model=2),
+                              devices=eight_devices)
+    assert dict(mesh.shape) == {"data": 4, "seq": 1, "pipe": 1, "model": 2}
+    # data = 2 per slice (ICI) x 2 slices (DCN); model fully within a slice
+    data_pos = list(mesh.axis_names).index("data")
+    model_pos = list(mesh.axis_names).index("model")
+    assert captured["ici"][data_pos] == 2 and captured["dcn"][data_pos] == 2
+    assert captured["ici"][model_pos] == 2 and captured["dcn"][model_pos] == 1
+
+    # data axis not divisible by slice count: loud error, not a DCN-crossing
+    # model axis
+    from shifu_tpu.config import ConfigError
+    with pytest.raises(ConfigError, match="slice count"):
+        mesh_mod.make_mesh(MeshConfig(data=1, model=8),
+                           devices=eight_devices)
+
+    # a device prefix covering slices unevenly: loud ConfigError, not
+    # mesh_utils' internal granule error
+    monkeypatch.setattr(mesh_mod, "_num_slices", lambda d: 2)
+    uneven = [D(0), D(0), D(0), D(0), D(1), D(1)]
+    with pytest.raises(ConfigError, match="unevenly"):
+        mesh_mod.make_mesh(MeshConfig(data=6), devices=uneven)
+
+
 def test_mesh_wrong_device_count(eight_devices):
     from shifu_tpu.config import ConfigError
     with pytest.raises(ConfigError):
