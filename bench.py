@@ -153,8 +153,8 @@ def main() -> None:
                                  make_train_step)
 
     num_features = 30
-    batch_size = 65536
-    nb_total = 40
+    batch_size = 131072  # best of {32k, 64k, 128k, 256k} on v5e (256k tips
+    nb_total = 20        # over an HBM/layout cliff to ~0.55x)
     schema = synthetic.make_schema(num_features=num_features)
     job = JobConfig(
         schema=schema,
@@ -363,7 +363,7 @@ def main() -> None:
         from shifu_tpu.data import reader
         from shifu_tpu.data.cache import read_file_cached
 
-        nb_e2e = 8
+        nb_e2e = 4  # ~0.5M rows: enough to amortize, keeps the tier <1 min
         rows_e2e = nb_e2e * batch_size
         tmp = tempfile.mkdtemp(prefix="bench_e2e_")
         cdir = tempfile.mkdtemp(prefix="bench_e2e_cache_")

@@ -26,15 +26,22 @@ PIPE_AXIS = "pipe"
 MODEL_AXIS = "model"
 
 
-def _num_slices(devices: Sequence[jax.Device]) -> int:
-    """Distinct TPU slices among `devices` (1 on CPU / single slice).
+def _slice_counts(devices: Sequence[jax.Device]) -> dict:
+    """Device count per TPU slice ({0: n} on CPU / single slice).
 
     Multi-slice (Multipod/Multislice) runs expose `slice_index` on each
     device; collectives WITHIN a slice ride ICI, across slices they ride
     DCN — orders of magnitude slower, so axis placement must respect the
-    boundary."""
-    seen = {getattr(d, "slice_index", 0) or 0 for d in devices}
-    return max(len(seen), 1)
+    boundary.  Single source of the slice-key normalization."""
+    counts: dict = {}
+    for d in devices:
+        key = getattr(d, "slice_index", 0) or 0
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def _num_slices(devices: Sequence[jax.Device]) -> int:
+    return max(len(_slice_counts(devices)), 1)
 
 
 def make_mesh(cfg: Optional[MeshConfig] = None,
@@ -76,10 +83,7 @@ def make_mesh(cfg: Optional[MeshConfig] = None,
                 f"multi-slice mesh: data axis ({cfg.data}) must be a "
                 f"multiple of the slice count ({slices}) so model/seq/pipe "
                 "collectives stay on ICI within a slice")
-        per_slice = {}
-        for d in devices:
-            key = getattr(d, "slice_index", 0) or 0
-            per_slice[key] = per_slice.get(key, 0) + 1
+        per_slice = _slice_counts(devices)
         if len(set(per_slice.values())) != 1:
             # a device *prefix* of a multi-slice pod (e.g. --devices or a
             # partial mesh) can span slices unevenly; fail with the real
