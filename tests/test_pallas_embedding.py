@@ -64,3 +64,50 @@ def test_jit_compatible():
     f = jax.jit(lambda t, i: embedding_lookup(t, i, True))
     np.testing.assert_allclose(np.asarray(f(table, ids)),
                                np.asarray(_xla_lookup(table, ids)))
+
+
+def test_onehot_lookup_matches_gather_exactly(monkeypatch):
+    """The small-vocab MXU strategy (one_hot @ table) must be bit-identical
+    to the XLA gather — forward rows AND the production backward branches,
+    including out-of-range id clamping (both grads clip like the forward
+    gather clamp; XLA's OOB scatter would silently drop updates)."""
+    from shifu_tpu.ops import pallas_embedding as pe
+
+    rng = np.random.default_rng(3)
+    table = jnp.asarray(rng.standard_normal((4, 50, 16)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(-5, 60, (64, 4)).astype(np.int32))  # dirty
+
+    clipped = jnp.clip(ids, 0, 49)
+    ref = pe._xla_lookup(table, clipped)
+    got = pe._onehot_lookup(table, ids)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    # bf16 table: still an exact row copy (single exact 1.0 in the one-hot)
+    tb16 = table.astype(jnp.bfloat16)
+    np.testing.assert_array_equal(
+        np.asarray(pe._onehot_lookup(tb16, ids).astype(jnp.float32)),
+        np.asarray(pe._xla_lookup(tb16, clipped).astype(jnp.float32)))
+
+    # gradient parity through the PRODUCTION _bwd branches: force the
+    # one-hot route (CPU backend would refuse) and compare to the scatter
+    # route, dirty ids included
+    g = jnp.asarray(rng.standard_normal((64, 4, 16)).astype(np.float32))
+    carrier = jnp.zeros((0,), jnp.float32)
+    monkeypatch.setattr(pe, "_onehot_ok", lambda v, n: True)
+    onehot_grad, _ = pe._bwd(None, (ids, table.shape, carrier), g)
+    monkeypatch.setattr(pe, "_onehot_ok", lambda v, n: False)
+    scatter_grad, _ = pe._bwd(None, (ids, table.shape, carrier), g)
+    np.testing.assert_allclose(np.asarray(onehot_grad),
+                               np.asarray(scatter_grad),
+                               rtol=1e-6, atol=1e-6)
+
+    # explicit use_pallas=False keeps its contract (scatter grad, gather fwd)
+    monkeypatch.setattr(pe, "_onehot_ok", lambda v, n: True)
+    forced_grad, _ = pe._bwd(False, (ids, table.shape, carrier), g)
+    np.testing.assert_allclose(np.asarray(forced_grad),
+                               np.asarray(scatter_grad), rtol=1e-6, atol=1e-6)
+
+    # budget predicate: vocab cap and the f32 one-hot byte bound
+    monkeypatch.undo()
+    assert not pe._onehot_ok(pe._ONEHOT_MAX_VOCAB + 1, 10)
+    assert not pe._onehot_ok(2048, (pe._ONEHOT_MAX_BYTES // (2048 * 4)) + 1)
