@@ -277,7 +277,8 @@ def run_train(args) -> int:
         return pod_lib.supervise_pod(
             spec, _child_train_args(args, out_dir), out_dir,
             max_restarts=max_restarts,
-            liveness_seconds=sup_job.runtime.liveness_seconds)
+            liveness_seconds=sup_job.runtime.liveness_seconds,
+            checkpoint_dir=sup_job.runtime.checkpoint.directory)
 
     if args.supervise:
         from .supervisor import supervise
@@ -290,7 +291,8 @@ def run_train(args) -> int:
             args, out_dir, num_processes=getattr(args, "num_processes", 0))
         return supervise(child_args, max_restarts=max_restarts,
                          board_path=os.path.join(out_dir, "console.board"),
-                         liveness_seconds=sup_job.runtime.liveness_seconds)
+                         liveness_seconds=sup_job.runtime.liveness_seconds,
+                         checkpoint_dir=sup_job.runtime.checkpoint.directory)
 
     if getattr(args, "num_processes", 0) > 1:
         return _spawn_processes(args, _resolve_out_dir(args))
@@ -476,6 +478,15 @@ def _maybe_inject_fault(metrics, board) -> None:
     if fault_epoch is not None and metrics.epoch == int(fault_epoch):
         # print as well: a non-chief rank's board is silent, but its stdout
         # is captured into the per-host log by the pod launcher
+        print(f"FAULT INJECTION: killing process after epoch {metrics.epoch}",
+              flush=True)
+        board(f"FAULT INJECTION: killing process after epoch {metrics.epoch}")
+        os._exit(17)
+    # repeated-preemption injection: die after EVERY epoch below the bound,
+    # so each attempt advances exactly one epoch then fails — exercises the
+    # progress-resets-restart-budget semantics of the supervisors
+    fault_every = os.environ.get("SHIFU_TPU_FAULT_EVERY_EPOCH")
+    if fault_every is not None and metrics.epoch < int(fault_every):
         print(f"FAULT INJECTION: killing process after epoch {metrics.epoch}",
               flush=True)
         board(f"FAULT INJECTION: killing process after epoch {metrics.epoch}")

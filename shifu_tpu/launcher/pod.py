@@ -207,23 +207,38 @@ def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
 
 def supervise_pod(spec: PodSpec, child_args: Sequence[str], out_dir: str,
                   max_restarts: int = 2, liveness_seconds: float = 0.0,
-                  echo=print) -> int:
+                  echo=print, checkpoint_dir: Optional[str] = None) -> int:
     """Whole-gang restart supervision: any host failure restarts the ENTIRE
-    gang (checkpoint auto-resume continues the job), up to max_restarts —
-    the cross-host successor of `supervise()` and of the reference's
-    backup-promotion recovery."""
+    gang (checkpoint auto-resume continues the job), bounded by max_restarts
+    CONSECUTIVE failures without durable progress — the cross-host successor
+    of `supervise()` and of the reference's backup-promotion recovery.
+    Progress = the shared checkpoint's step advanced during the attempt
+    (supervisor.latest_checkpoint_step; for ssh pods the checkpoint dir is
+    on shared storage the dispatcher can also see): preemption-heavy pods
+    legitimately restart many times, each resuming further, and only a
+    crash loop that persists nothing exhausts the budget."""
+    from .supervisor import charge_restart_budget, latest_checkpoint_step
+
     attempts = 0
+    failures_since_progress = 0
     while True:
         attempts += 1
         start = time.monotonic()
+        step_at_start = latest_checkpoint_step(checkpoint_dir)
         rc = launch_gang(spec, child_args, out_dir, attempts,
                          liveness_seconds=liveness_seconds, echo=echo)
         if rc == 0:
             if attempts > 1:
                 echo(f"pod: succeeded after {attempts} attempts")
             return 0
+        progressed = (checkpoint_dir is not None
+                      and latest_checkpoint_step(checkpoint_dir)
+                      > step_at_start)
+        failures_since_progress = charge_restart_budget(
+            failures_since_progress, progressed, echo=echo, what="pod")
         echo(f"pod: attempt {attempts} failed rc={rc} after "
              f"{time.monotonic() - start:.1f}s")
-        if attempts > max_restarts:
-            echo(f"pod: restart budget exhausted ({max_restarts} restarts)")
+        if failures_since_progress > max_restarts:
+            echo(f"pod: restart budget exhausted ({max_restarts} restarts "
+                 "without progress)")
             return rc if isinstance(rc, int) and rc > 0 else 1

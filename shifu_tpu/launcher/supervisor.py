@@ -26,12 +26,48 @@ from typing import Optional, Sequence
 # reference heartbeat key pair) sets a window sized to the job's epochs.
 
 
+def latest_checkpoint_step(ckpt_dir: Optional[str]) -> int:
+    """Largest finalized step in an orbax checkpoint dir (-1 if none).
+
+    The DURABLE progress signal for restart budgets: console/board lines
+    print before the epoch's conditional save, so log text can claim
+    progress a crash never persisted (save_every_epochs > 1, or the save
+    itself failing).  Orbax finalizes each step as a plain digit-named
+    directory; in-flight tmp dirs carry suffixes and are skipped."""
+    if not ckpt_dir or not os.path.isdir(ckpt_dir):
+        return -1
+    best = -1
+    try:
+        for name in os.listdir(ckpt_dir):
+            if name.isdigit():
+                best = max(best, int(name))
+    except OSError:
+        return -1
+    return best
+
+
+def charge_restart_budget(failures_since_progress: int, progressed: bool,
+                          echo=print, what: str = "supervisor") -> int:
+    """Shared budget accounting for both supervisors: the budget bounds
+    CONSECUTIVE failures without durable progress, not lifetime restarts —
+    a long job on preemptible capacity legitimately restarts many times,
+    each resuming further from checkpoint (monotone progress -> eventual
+    completion); only a crash loop that persists nothing burns it."""
+    if progressed:
+        if failures_since_progress:
+            echo(f"{what}: progress since last failure — restart budget "
+                 "reset")
+        return 1
+    return failures_since_progress + 1
+
+
 def supervise(child_argv: Sequence[str],
               max_restarts: int = 2,
               board_path: Optional[str] = None,
               liveness_seconds: float = 0.0,
               poll_seconds: float = 0.5,
-              python: Optional[str] = None) -> int:
+              python: Optional[str] = None,
+              checkpoint_dir: Optional[str] = None) -> int:
     """Run `python -m shifu_tpu.launcher.cli <child_argv>` with restarts.
 
     Returns the child's final exit code (0 on eventual success).  A child that
@@ -46,9 +82,11 @@ def supervise(child_argv: Sequence[str],
     python = python or sys.executable
     cmd = [python, "-m", "shifu_tpu.launcher.cli", *child_argv]
     attempts = 0
+    failures_since_progress = 0
     while True:
         attempts += 1
         start = time.monotonic()
+        step_at_start = latest_checkpoint_step(checkpoint_dir)
         proc = subprocess.Popen(cmd)
         last_size = -1
         last_progress = time.monotonic()
@@ -82,10 +120,16 @@ def supervise(child_argv: Sequence[str],
                 print(f"supervisor: succeeded after {attempts} attempts", flush=True)
             return 0
         elapsed = time.monotonic() - start
+        # durable progress only: the checkpoint step advanced this attempt
+        progressed = (checkpoint_dir is not None
+                      and latest_checkpoint_step(checkpoint_dir)
+                      > step_at_start)
+        failures_since_progress = charge_restart_budget(
+            failures_since_progress, progressed)
         print(f"supervisor: attempt {attempts} exited rc={rc} "
               f"after {elapsed:.1f}s"
               + (" (liveness kill)" if killed_for_hang else ""), flush=True)
-        if attempts > max_restarts:
+        if failures_since_progress > max_restarts:
             print(f"supervisor: restart budget exhausted "
-                  f"({max_restarts} restarts)", flush=True)
+                  f"({max_restarts} restarts without progress)", flush=True)
             return rc if isinstance(rc, int) and rc > 0 else 1

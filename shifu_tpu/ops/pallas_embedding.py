@@ -112,12 +112,17 @@ def _onehot_ok(vocab: int, n_lookups: int) -> bool:
 def _onehot_lookup(table: jax.Array, ids: jax.Array) -> jax.Array:
     # MXU formulation of the lookup: rows select via one_hot @ table.  The
     # one-hot row has a single exact 1.0, so the result is bit-identical to
-    # the gather.  Ids are clipped first to keep XLA gather's out-of-range
-    # clamp semantics (one_hot alone would zero invalid rows instead).
+    # the gather — including its out-of-range semantics (take_along_axis:
+    # ids in [-V, 0) wrap, anything outside [-V, V) NaN-fills), so dirty
+    # ids behave identically whichever strategy the auto path picks.
     v = table.shape[1]
-    ids = jnp.clip(ids.astype(jnp.int32), 0, v - 1)
-    oh = jax.nn.one_hot(ids, v, dtype=table.dtype)
-    return jnp.einsum("bfv,fvd->bfd", oh, table)
+    ids = ids.astype(jnp.int32)
+    wrapped = jnp.where(ids < 0, ids + v, ids)
+    valid = (ids >= -v) & (ids < v)
+    oh = jax.nn.one_hot(wrapped, v, dtype=table.dtype)  # invalid -> zero row
+    out = jnp.einsum("bfv,fvd->bfd", oh, table)
+    return jnp.where(valid[..., None], out,
+                     jnp.asarray(jnp.nan, out.dtype))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -167,25 +172,26 @@ def _fwd(table, ids, use_pallas):
 
 def _onehot_grad(ids: jax.Array, table_shape, g: jax.Array) -> jax.Array:
     """MXU gradient: dtable = one_hot(ids)^T @ g — the scatter-add expressed
-    as a matmul, matching the one-hot forward strategy.  Ids clip exactly
-    like the forward clamp."""
+    as a matmul.  Matches the scatter path's out-of-range handling exactly:
+    ids in [-V, 0) wrap (`.at[].add` wraps negatives), anything outside
+    [-V, V) contributes nothing (one_hot's zero row == the scatter drop)."""
     v = table_shape[1]
-    idc = jnp.clip(ids.astype(jnp.int32), 0, v - 1)
-    oh = jax.nn.one_hot(idc, v, dtype=jnp.float32)
+    ids = ids.astype(jnp.int32)
+    wrapped = jnp.where(ids < 0, ids + v, ids)
+    oh = jax.nn.one_hot(wrapped, v, dtype=jnp.float32)
     return jnp.einsum("bfv,bfd->fvd", oh, g.astype(jnp.float32))
 
 
 def _scatter_grad(ids: jax.Array, table_shape, g: jax.Array) -> jax.Array:
     """Scatter-add gradient into the stacked table: for each field f, add
-    g[b, f, :] at row ids[b, f].  Ids clip like the forward gather clamp —
-    XLA's default out-of-bounds scatter DROPS updates, which would silently
-    diverge from both the forward semantics and the one-hot path."""
-    nc, v = table_shape[0], table_shape[1]
-    idc = jnp.clip(ids.astype(jnp.int32), 0, v - 1)
+    g[b, f, :] at row ids[b, f] (JAX semantics: negative ids wrap like the
+    forward gather; out-of-bounds-high updates drop, matching the forward's
+    NaN-fill poisoning)."""
+    nc = table_shape[0]
     grad = jnp.zeros(table_shape, dtype=jnp.float32)
     field_idx = jnp.broadcast_to(
-        jnp.arange(nc, dtype=idc.dtype)[None, :], idc.shape)
-    return grad.at[field_idx.reshape(-1), idc.reshape(-1)].add(
+        jnp.arange(nc, dtype=ids.dtype)[None, :], ids.shape)
+    return grad.at[field_idx.reshape(-1), ids.reshape(-1)].add(
         g.reshape(-1, table_shape[-1]).astype(jnp.float32))
 
 

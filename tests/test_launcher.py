@@ -140,6 +140,30 @@ def test_supervisor_recovers_from_injected_fault(job_dir):
 
 
 @pytest.mark.slow
+def test_supervisor_budget_resets_on_progress(job_dir):
+    """The restart budget bounds CONSECUTIVE no-progress failures, not
+    lifetime restarts: a job preempted after every epoch (each attempt
+    resuming one epoch further) must finish under a budget smaller than the
+    total number of preemptions."""
+    out = job_dir / "out_p"
+    env = _cli_env()
+    env["SHIFU_TPU_FAULT_EVERY_EPOCH"] = "3"  # die after epochs 0, 1, 2
+    r = _run_cli(["train",
+                  "--modelconfig", str(job_dir / "ModelConfig.json"),
+                  "--columnconfig", str(job_dir / "ColumnConfig.json"),
+                  "--data", str(job_dir / "normalized"),
+                  "--output", str(out), "--epochs", "4",
+                  "--supervise", "--max-restarts", "1"],
+                 env=env, timeout=600)
+    # 3 failures against a budget of 1 — only possible because every
+    # attempt completed (and checkpointed) one more epoch
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "restart budget reset" in r.stdout
+    assert "succeeded after 4 attempts" in r.stdout
+    assert (out / "final_model" / "weights.npz").exists()
+
+
+@pytest.mark.slow
 def test_supervisor_liveness_kills_hung_child(job_dir):
     """Heartbeat-liveness parity (TensorflowApplicationMaster.java:63-112):
     a child that stops writing board progress for shifu.liveness.seconds is
