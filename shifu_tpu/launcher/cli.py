@@ -270,7 +270,8 @@ def run_train(args) -> int:
                   "a process gang; use one", file=sys.stderr, flush=True)
             return EXIT_FAIL
         out_dir = _resolve_out_dir(args)
-        os.makedirs(out_dir, exist_ok=True)
+        args.output = out_dir  # pin: a second resolve could timestamp anew,
+        os.makedirs(out_dir, exist_ok=True)  # desyncing the checkpoint probe
         sup_job = _assemble_job(args, write_files=False)[0]
         max_restarts = (args.max_restarts if args.max_restarts >= 0
                         else sup_job.runtime.max_restarts)
@@ -283,7 +284,8 @@ def run_train(args) -> int:
     if args.supervise:
         from .supervisor import supervise
         out_dir = _resolve_out_dir(args)
-        os.makedirs(out_dir, exist_ok=True)
+        args.output = out_dir  # pin: a second resolve could timestamp anew,
+        os.makedirs(out_dir, exist_ok=True)  # desyncing the checkpoint probe
         sup_job = _assemble_job(args, write_files=False)[0]
         max_restarts = (args.max_restarts if args.max_restarts >= 0
                         else sup_job.runtime.max_restarts)

@@ -212,30 +212,28 @@ def supervise_pod(spec: PodSpec, child_args: Sequence[str], out_dir: str,
     gang (checkpoint auto-resume continues the job), bounded by max_restarts
     CONSECUTIVE failures without durable progress — the cross-host successor
     of `supervise()` and of the reference's backup-promotion recovery.
-    Progress = the shared checkpoint's step advanced during the attempt
-    (supervisor.latest_checkpoint_step; for ssh pods the checkpoint dir is
-    on shared storage the dispatcher can also see): preemption-heavy pods
-    legitimately restart many times, each resuming further, and only a
-    crash loop that persists nothing exhausts the budget."""
-    from .supervisor import charge_restart_budget, latest_checkpoint_step
+    Progress = the shared checkpoint's epoch advanced during the attempt
+    (supervisor.ProgressProbe over the PROGRESS marker; works for gs://,
+    hdfs://, NFS checkpoint dirs — which is also the shared-storage
+    contract ssh pods already have): preemption-heavy pods legitimately
+    restart many times, each resuming further, and only a crash loop that
+    persists nothing exhausts the budget."""
+    from .supervisor import ProgressProbe, charge_restart_budget
 
     attempts = 0
     failures_since_progress = 0
     while True:
         attempts += 1
         start = time.monotonic()
-        step_at_start = latest_checkpoint_step(checkpoint_dir)
+        probe = ProgressProbe(checkpoint_dir)
         rc = launch_gang(spec, child_args, out_dir, attempts,
                          liveness_seconds=liveness_seconds, echo=echo)
         if rc == 0:
             if attempts > 1:
                 echo(f"pod: succeeded after {attempts} attempts")
             return 0
-        progressed = (checkpoint_dir is not None
-                      and latest_checkpoint_step(checkpoint_dir)
-                      > step_at_start)
         failures_since_progress = charge_restart_budget(
-            failures_since_progress, progressed, echo=echo, what="pod")
+            failures_since_progress, probe.advanced(), echo=echo, what="pod")
         echo(f"pod: attempt {attempts} failed rc={rc} after "
              f"{time.monotonic() - start:.1f}s")
         if failures_since_progress > max_restarts:

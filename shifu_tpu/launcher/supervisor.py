@@ -26,15 +26,37 @@ from typing import Optional, Sequence
 # reference heartbeat key pair) sets a window sized to the job's epochs.
 
 
-def latest_checkpoint_step(ckpt_dir: Optional[str]) -> int:
-    """Largest finalized step in an orbax checkpoint dir (-1 if none).
+def checkpoint_progress(ckpt_dir: Optional[str]) -> int:
+    """Durable progress of a checkpoint dir: the EPOCH recorded in the
+    `PROGRESS` marker the train loop writes after every save (-1 if none).
 
-    The DURABLE progress signal for restart budgets: console/board lines
-    print before the epoch's conditional save, so log text can claim
-    progress a crash never persisted (save_every_epochs > 1, or the save
-    itself failing).  Orbax finalizes each step as a plain digit-named
-    directory; in-flight tmp dirs carry suffixes and are skipped."""
-    if not ckpt_dir or not os.path.isdir(ckpt_dir):
+    Why this signal: console/board lines print before the epoch's
+    conditional save, so log text can claim progress a crash never
+    persisted; the raw global step re-inflates when a mid-epoch resume
+    replays the interrupted epoch, so a deterministic mid-epoch crash loop
+    would look like progress forever.  The marker's epoch only advances
+    when a NEW epoch's save lands.  Works for remote (gs://, hdfs://)
+    checkpoint dirs too — one small file read via fsio.
+
+    Fallback for pre-marker checkpoints (local only): the largest
+    digit-named finalized orbax step dir, counted as epoch-equivalent."""
+    if not ckpt_dir:
+        return -1
+    import json
+
+    from ..train.checkpoint import PROGRESS_MARKER
+
+    try:
+        from ..data import fsio
+        if fsio.is_remote(ckpt_dir):
+            raw = fsio.read_bytes(ckpt_dir.rstrip("/") + "/" + PROGRESS_MARKER)
+        else:
+            with open(os.path.join(ckpt_dir, PROGRESS_MARKER), "rb") as f:
+                raw = f.read()
+        return int(json.loads(raw).get("epoch", -1))
+    except Exception:
+        pass
+    if not os.path.isdir(ckpt_dir):
         return -1
     best = -1
     try:
@@ -44,6 +66,19 @@ def latest_checkpoint_step(ckpt_dir: Optional[str]) -> int:
     except OSError:
         return -1
     return best
+
+
+class ProgressProbe:
+    """Per-attempt durable-progress capture/compare, shared by both
+    supervisors so the budget semantics stay defined once."""
+
+    def __init__(self, ckpt_dir: Optional[str]):
+        self._dir = ckpt_dir
+        self._mark = checkpoint_progress(ckpt_dir)
+
+    def advanced(self) -> bool:
+        return (self._dir is not None
+                and checkpoint_progress(self._dir) > self._mark)
 
 
 def charge_restart_budget(failures_since_progress: int, progressed: bool,
@@ -86,7 +121,7 @@ def supervise(child_argv: Sequence[str],
     while True:
         attempts += 1
         start = time.monotonic()
-        step_at_start = latest_checkpoint_step(checkpoint_dir)
+        probe = ProgressProbe(checkpoint_dir)
         proc = subprocess.Popen(cmd)
         last_size = -1
         last_progress = time.monotonic()
@@ -120,12 +155,9 @@ def supervise(child_argv: Sequence[str],
                 print(f"supervisor: succeeded after {attempts} attempts", flush=True)
             return 0
         elapsed = time.monotonic() - start
-        # durable progress only: the checkpoint step advanced this attempt
-        progressed = (checkpoint_dir is not None
-                      and latest_checkpoint_step(checkpoint_dir)
-                      > step_at_start)
+        # durable progress only: the checkpoint's epoch advanced this attempt
         failures_since_progress = charge_restart_budget(
-            failures_since_progress, progressed)
+            failures_since_progress, probe.advanced())
         print(f"supervisor: attempt {attempts} exited rc={rc} "
               f"after {elapsed:.1f}s"
               + (" (liveness kill)" if killed_for_hang else ""), flush=True)

@@ -24,6 +24,38 @@ def make_manager(directory: str, max_to_keep: int = 3) -> ocp.CheckpointManager:
     return ocp.CheckpointManager(directory, options=options)
 
 
+PROGRESS_MARKER = "PROGRESS"
+
+
+def _write_progress_marker(directory: str, step: int,
+                           extra: Optional[dict]) -> None:
+    """Tiny `<ckpt_dir>/PROGRESS` json ({"epoch": E, "step": S}) updated on
+    every save — the supervisors' durable-progress probe.  One small file
+    readable for LOCAL AND REMOTE (gs://, hdfs://) checkpoint dirs alike,
+    and keyed on EPOCH: the global step re-inflates when a mid-epoch resume
+    replays the interrupted epoch, so step alone would let a deterministic
+    mid-epoch crash loop reset the restart budget forever.  Best-effort:
+    a marker failure must never fail the checkpoint itself."""
+    import json as _json
+
+    payload = _json.dumps({
+        "epoch": int((extra or {}).get("epoch", -1)),
+        "step": int(step),
+    }).encode()
+    try:
+        from ..data import fsio
+        if fsio.is_remote(directory):
+            filesystem, fs_path = fsio._filesystem(directory)
+            with filesystem.open_output_stream(
+                    fs_path.rstrip("/") + "/" + PROGRESS_MARKER) as f:
+                f.write(payload)
+        else:
+            with open(os.path.join(directory, PROGRESS_MARKER), "wb") as f:
+                f.write(payload)
+    except Exception:
+        pass
+
+
 def save(manager: ocp.CheckpointManager, step: int, state: Any,
          extra: Optional[dict] = None, block: bool = True) -> None:
     """Save the train state (and a small metadata dict) at `step`.
@@ -40,6 +72,7 @@ def save(manager: ocp.CheckpointManager, step: int, state: Any,
     manager.save(step, args=ocp.args.Composite(**composite))
     if block:
         manager.wait_until_finished()
+    _write_progress_marker(str(manager.directory), step, extra)
 
 
 def finalize(manager: ocp.CheckpointManager) -> None:

@@ -216,6 +216,38 @@ def test_remote_read_retries_transient_errors(mock_fs, monkeypatch):
             fsio._fs_cache[("mock", "")] = filesystem
 
 
+def test_checkpoint_progress_marker_local_and_remote(mock_fs, tmp_path):
+    """The supervisors' durable-progress probe reads the PROGRESS marker
+    the checkpoint writer drops — for local AND remote checkpoint dirs
+    (the restart budget must keep resetting when checkpoints live on
+    gs://-style storage)."""
+    from shifu_tpu.launcher.supervisor import (ProgressProbe,
+                                               checkpoint_progress)
+    from shifu_tpu.train import checkpoint as ckpt_lib
+
+    d = str(tmp_path / "ck")
+    import os as _os
+    _os.makedirs(d)
+    assert checkpoint_progress(d) == -1
+    ckpt_lib._write_progress_marker(d, 12, {"epoch": 3})
+    assert checkpoint_progress(d) == 3
+    probe = ProgressProbe(d)
+    assert not probe.advanced()
+    ckpt_lib._write_progress_marker(d, 24, {"epoch": 4})
+    assert probe.advanced()
+
+    filesystem, root, _ = mock_fs
+    remote = root + "/ckpt"
+    filesystem.create_dir("bucket/data/ckpt")
+    assert checkpoint_progress(remote) == -1
+    ckpt_lib._write_progress_marker(remote, 7, {"epoch": 2})
+    assert checkpoint_progress(remote) == 2
+    rprobe = ProgressProbe(remote)
+    ckpt_lib._write_progress_marker(remote, 14, {"epoch": 5})
+    assert rprobe.advanced()
+    assert not ProgressProbe(None).advanced()
+
+
 def test_streaming_count_matches(data_dir, tmp_path):
     # remote count streams (constant memory); must equal the local count,
     # gzip and plain, including a final unterminated non-blank line

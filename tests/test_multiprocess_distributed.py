@@ -147,6 +147,77 @@ def test_pod_spec_parsing(tmp_path):
 
 
 @pytest.mark.slow
+def test_pod_ssh_transport_end_to_end(tmp_path):
+    """The SSH transport's actual command line — `ssh -tt -o BatchMode=yes
+    <host> 'env K=V ... python -m shifu_tpu.launcher.cli ...'` with the env
+    contract quoted inline — executed end to end through a fake `ssh` on
+    PATH that runs the remote command locally.  Proves the quoting, env
+    injection, rank->host order, and output streaming the unit test only
+    inspects statically."""
+    import json as json_lib
+
+    from shifu_tpu.data import synthetic
+
+    fake_bin = tmp_path / "bin"
+    fake_bin.mkdir()
+    # a real ssh client would exec the command on <host>; the fake asserts
+    # the argv shape, records the host, and runs the command via sh -c
+    (fake_bin / "ssh").write_text(
+        "#!/bin/sh\n"
+        "[ \"$1\" = -tt ] || { echo 'missing -tt' >&2; exit 64; }\n"
+        "shift\n"
+        "[ \"$1\" = -o ] && shift 2\n"
+        "host=\"$1\"; shift\n"
+        "echo \"FAKE-SSH host=$host cmd=$*\" >&2\n"
+        "exec sh -c \"$*\"\n")
+    (fake_bin / "ssh").chmod(0o755)
+
+    mc = {"dataSet": {"targetColumnName": "target"},
+          "train": {"validSetRate": 0.2, "numTrainEpochs": 2,
+                    "algorithm": "NN",
+                    "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                               "ActivationFunc": ["relu"],
+                               "LearningRate": 0.01, "Optimizer": "adam"}}}
+    cols = [{"columnNum": 0, "columnName": "target", "columnFlag": "Target"}]
+    cols += [{"columnNum": i, "columnName": f"f{i}", "columnType": "N",
+              "finalSelect": True} for i in range(1, 9)]
+    (tmp_path / "ModelConfig.json").write_text(json_lib.dumps(mc))
+    (tmp_path / "ColumnConfig.json").write_text(json_lib.dumps(cols))
+    schema = synthetic.make_schema(num_features=8)
+    rows = synthetic.make_rows(800, schema, seed=6, noise=0.3)
+    synthetic.write_files(rows, str(tmp_path / "data"), num_files=2)
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env.update({"SHIFU_TPU_PLATFORM": "cpu", "SHIFU_TPU_CPU_DEVICES": "1",
+                "PATH": f"{fake_bin}:{env.get('PATH', '')}",
+                "PYTHONPATH": os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))})
+    out = tmp_path / "job"
+    r = subprocess.run(
+        [sys.executable, "-m", "shifu_tpu.launcher.cli", "train",
+         "--modelconfig", str(tmp_path / "ModelConfig.json"),
+         "--columnconfig", str(tmp_path / "ColumnConfig.json"),
+         "--data", str(tmp_path / "data"),
+         # 'localhost' twice: the coordinator address (hosts[0]:port) must
+         # resolve for the real jax.distributed rendezvous to form
+         "--output", str(out), "--hosts", "localhost,localhost"],
+        env=env, capture_output=True, text=True, timeout=600, cwd=str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    # rank i dispatched to hosts[i] through the ssh argv, env contract
+    # quoted inline and intact
+    h0 = (out / "logs" / "host-0.attempt-1.log").read_text()
+    h1 = (out / "logs" / "host-1.attempt-1.log").read_text()
+    assert "FAKE-SSH host=localhost" in h0 and "FAKE-SSH host=localhost" in h1
+    assert "SHIFU_TPU_PROCESS_ID=0" in h0
+    assert "SHIFU_TPU_PROCESS_ID=1" in h1
+    assert "SHIFU_TPU_NUM_PROCESSES=2" in h0
+    assert "Epoch 1:" in h0  # chief trained; env contract survived quoting
+    for f in ("GenericModelConfig.json", "weights.npz", "model.bin"):
+        assert (out / "final_model" / f).exists(), f
+
+
+@pytest.mark.slow
 def test_pod_launch_gang_restart_end_to_end(tmp_path):
     """Pod-scale launch (VERDICT round 1 item #1): `train --hosts local:4`
     dispatches a 4-process simulated pod through the pod launcher — rank env
