@@ -279,7 +279,8 @@ def run_train(args) -> int:
             spec, _child_train_args(args, out_dir), out_dir,
             max_restarts=max_restarts,
             liveness_seconds=sup_job.runtime.liveness_seconds,
-            checkpoint_dir=sup_job.runtime.checkpoint.directory)
+            checkpoint_dir=sup_job.runtime.checkpoint.directory,
+            timeout_seconds=sup_job.runtime.timeout_seconds)
 
     if args.supervise:
         from .supervisor import supervise
@@ -294,7 +295,8 @@ def run_train(args) -> int:
         return supervise(child_args, max_restarts=max_restarts,
                          board_path=os.path.join(out_dir, "console.board"),
                          liveness_seconds=sup_job.runtime.liveness_seconds,
-                         checkpoint_dir=sup_job.runtime.checkpoint.directory)
+                         checkpoint_dir=sup_job.runtime.checkpoint.directory,
+                         timeout_seconds=sup_job.runtime.timeout_seconds)
 
     if getattr(args, "num_processes", 0) > 1:
         return _spawn_processes(args, _resolve_out_dir(args))
@@ -388,8 +390,8 @@ def run_train(args) -> int:
           f"model={job.model.model_type} epochs={job.train.epochs} "
           f"batch={job.data.batch_size}")
 
-    deadline = (time.monotonic() + job.runtime.timeout_seconds
-                if job.runtime.timeout_seconds else None)
+    from .supervisor import JobDeadline
+    deadline = JobDeadline(job.runtime.timeout_seconds)
 
     # ticket renewal for healthy long runs: re-kinit from the per-epoch
     # callback once half a typical 10h ticket lifetime has passed, so a job
@@ -399,7 +401,7 @@ def run_train(args) -> int:
 
     def check_timeout(_m):
         nonlocal last_kinit
-        if deadline is not None and time.monotonic() > deadline:
+        if deadline.expired():
             board(f"job timeout ({job.runtime.timeout_seconds}s) exceeded — aborting")
             raise TimeoutError("job timeout")
         if (job.runtime.kerberos_principal

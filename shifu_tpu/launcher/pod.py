@@ -117,10 +117,15 @@ def _host_command(spec: PodSpec, rank: int, child_args: Sequence[str],
 
 def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
                 attempt: int, liveness_seconds: float = 0.0,
-                echo=print) -> int:
+                echo=print, deadline=None) -> int:
     """Run one gang attempt: dispatch every rank, stream rank 0 to the
     console, capture all ranks to per-host logs, tear everyone down on the
-    first failure (or on a liveness stall), return the gang's exit code."""
+    first failure (or on a liveness stall), return the gang's exit code.
+
+    `deadline` is a supervisor.JobDeadline for the JOB-level timeout: past
+    it the gang is torn down and EXIT_TIMEOUT returned (the supervisor
+    treats that as terminal)."""
+    from .supervisor import EXIT_TIMEOUT
     n = len(spec.hosts)
     log_dir = os.path.join(out_dir, "logs")
     os.makedirs(log_dir, exist_ok=True)
@@ -185,6 +190,18 @@ def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
                     status = status or rc
                     for other in sorted(remaining):
                         procs[other].terminate()
+            # deadline AFTER the poll drain: a gang that finished during the
+            # last sleep must report its real status, not a phantom timeout
+            if deadline is not None and remaining and deadline.expired():
+                # no graceful drain here: multihost ranks deliberately do NOT
+                # catch SIGTERM (one rank draining while peers issue
+                # collectives would deadlock the step — train/loop.py), so
+                # progress durability comes from the periodic checkpoint
+                # cadence, and the teardown is immediate
+                echo("pod: job timeout exceeded — tearing down the gang")
+                for other in sorted(remaining):
+                    procs[other].terminate()
+                return EXIT_TIMEOUT
             if liveness_seconds > 0 and remaining:
                 with lock:
                     newest = max(progress)
@@ -207,7 +224,8 @@ def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
 
 def supervise_pod(spec: PodSpec, child_args: Sequence[str], out_dir: str,
                   max_restarts: int = 2, liveness_seconds: float = 0.0,
-                  echo=print, checkpoint_dir: Optional[str] = None) -> int:
+                  echo=print, checkpoint_dir: Optional[str] = None,
+                  timeout_seconds: float = 0.0) -> int:
     """Whole-gang restart supervision: any host failure restarts the ENTIRE
     gang (checkpoint auto-resume continues the job), bounded by max_restarts
     CONSECUTIVE failures without durable progress — the cross-host successor
@@ -217,21 +235,38 @@ def supervise_pod(spec: PodSpec, child_args: Sequence[str], out_dir: str,
     hdfs://, NFS checkpoint dirs — which is also the shared-storage
     contract ssh pods already have): preemption-heavy pods legitimately
     restart many times, each resuming further, and only a crash loop that
-    persists nothing exhausts the budget."""
-    from .supervisor import ProgressProbe, charge_restart_budget
+    persists nothing exhausts the budget.
+
+    timeout_seconds bounds the WHOLE JOB across attempts (one
+    supervisor.JobDeadline from the first attempt's start); a timeout —
+    whether hit by the gang's own children (exit 3) or by the dispatcher's
+    deadline — is TERMINAL, never restarted (TensorflowClient.java:625-658
+    kills the app once)."""
+    from .supervisor import (EXIT_TIMEOUT, JobDeadline, ProgressProbe,
+                             charge_restart_budget)
 
     attempts = 0
     failures_since_progress = 0
+    deadline = JobDeadline(timeout_seconds)
     while True:
+        if deadline.expired():
+            # don't dispatch a doomed gang just to kill it one poll later
+            echo("pod: job timeout exceeded — terminal, no restart")
+            return EXIT_TIMEOUT
         attempts += 1
         start = time.monotonic()
         probe = ProgressProbe(checkpoint_dir)
         rc = launch_gang(spec, child_args, out_dir, attempts,
-                         liveness_seconds=liveness_seconds, echo=echo)
+                         liveness_seconds=liveness_seconds, echo=echo,
+                         deadline=deadline)
         if rc == 0:
             if attempts > 1:
                 echo(f"pod: succeeded after {attempts} attempts")
             return 0
+        if rc == EXIT_TIMEOUT:
+            echo(f"pod: attempt {attempts} hit the job timeout — terminal, "
+                 "no restart")
+            return EXIT_TIMEOUT
         failures_since_progress = charge_restart_budget(
             failures_since_progress, probe.advanced(), echo=echo, what="pod")
         echo(f"pod: attempt {attempts} failed rc={rc} after "

@@ -25,6 +25,14 @@ from typing import Optional, Sequence
 # longer than that; liveness is off unless `shifu.liveness.seconds` (or the
 # reference heartbeat key pair) sets a window sized to the job's epochs.
 
+# Shifu-style exit status for a job timeout (mirrors cli.EXIT_TIMEOUT; kept
+# local so the supervisor never imports the CLI module it launches).  A
+# timeout is TERMINAL: the reference client killed the app once and stopped
+# (TensorflowClient.java:625-658) — restarting a timed-out child would run
+# the job forever in timeout-sized chunks, because each attempt checkpoints
+# (progress resets the restart budget) and re-derives a fresh deadline.
+EXIT_TIMEOUT = 3
+
 
 def checkpoint_progress(ckpt_dir: Optional[str]) -> int:
     """Durable progress of a checkpoint dir: the EPOCH recorded in the
@@ -96,13 +104,74 @@ def charge_restart_budget(failures_since_progress: int, progressed: bool,
     return failures_since_progress + 1
 
 
+class JobDeadline:
+    """ONE clock for the whole job, shared across attempts — the semantic
+    core of the timeout-is-terminal fix, defined once for both supervisors
+    (like charge_restart_budget for the restart budget).  The child
+    re-derives a fresh per-attempt deadline it may never hit; the
+    supervisors enforce this job-level one."""
+
+    def __init__(self, timeout_seconds: float):
+        self.seconds = timeout_seconds
+        self._at = (time.monotonic() + timeout_seconds
+                    if timeout_seconds > 0 else None)
+
+    def expired(self) -> bool:
+        return self._at is not None and time.monotonic() > self._at
+
+
+class _Terminated(Exception):
+    """A stop signal (SIGTERM from a scheduler, SIGHUP from an ssh drop)
+    arrived at the supervisor parent."""
+
+
+def _raise_terminated(signum, frame):
+    raise _Terminated()
+
+
+def _kill_tree(proc: subprocess.Popen, sig: Optional[int] = None,
+               grace_seconds: float = 5.0) -> None:
+    """Signal the child's whole process group (the child is spawned with
+    start_new_session=True), escalating to SIGKILL after a grace window.
+    A bare proc.kill() would orphan gang grandchildren under `--supervise
+    --num-processes N`: the spawner dies uncatchably, its launch_gang
+    teardown never runs, and the workers keep training after the CLI
+    reported a terminal status.
+
+    sig=None hard-kills immediately (liveness kills target a HUNG tree —
+    grace would just wait on a wedged process); SIGTERM/SIGINT give the
+    train loop's drain handler a window to finalize the in-flight
+    checkpoint before the escalation."""
+    import signal
+
+    def _pg(s: int) -> None:
+        try:
+            os.killpg(proc.pid, s)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                proc.send_signal(s)
+            except (ProcessLookupError, OSError):
+                pass
+
+    if sig is not None:
+        _pg(sig)
+        try:
+            proc.wait(timeout=grace_seconds)
+        except subprocess.TimeoutExpired:
+            pass
+    if proc.poll() is None:
+        _pg(signal.SIGKILL)
+    proc.wait()
+
+
 def supervise(child_argv: Sequence[str],
               max_restarts: int = 2,
               board_path: Optional[str] = None,
               liveness_seconds: float = 0.0,
               poll_seconds: float = 0.5,
               python: Optional[str] = None,
-              checkpoint_dir: Optional[str] = None) -> int:
+              checkpoint_dir: Optional[str] = None,
+              timeout_seconds: float = 0.0) -> int:
     """Run `python -m shifu_tpu.launcher.cli <child_argv>` with restarts.
 
     Returns the child's final exit code (0 on eventual success).  A child that
@@ -113,55 +182,124 @@ def supervise(child_argv: Sequence[str],
     before their first write), the child is presumed hung, killed, and the
     restart budget is charged — heartbeat-expiry parity.  Size the window
     above startup (jax import + first compile) plus one epoch.
+
+    timeout_seconds > 0 bounds the WHOLE JOB, not one attempt: the deadline
+    is derived from the first attempt's start, and both a child exiting
+    EXIT_TIMEOUT and the supervisor's own deadline check are terminal
+    (exit 3, no restart) — client-side-timeout-kill parity
+    (TensorflowClient.java:625-658).
     """
+    import signal as signal_lib
+
     python = python or sys.executable
     cmd = [python, "-m", "shifu_tpu.launcher.cli", *child_argv]
     attempts = 0
     failures_since_progress = 0
-    while True:
-        attempts += 1
-        start = time.monotonic()
-        probe = ProgressProbe(checkpoint_dir)
-        proc = subprocess.Popen(cmd)
-        last_size = -1
-        last_progress = time.monotonic()
-        killed_for_hang = False
+    deadline = JobDeadline(timeout_seconds)
+    # the child runs in its own session (so kills reach the whole gang
+    # tree), which detaches it from external group-wide signals — a
+    # scheduler SIGTERM or an ssh-drop SIGHUP to this parent must be
+    # forwarded, not orphan the training tree
+    old_handlers: list[tuple[int, object]] = []
+    try:
+        for s in (signal_lib.SIGTERM, signal_lib.SIGHUP):
+            if signal_lib.getsignal(s) is signal_lib.SIG_IGN:
+                continue  # nohup'd: SIGHUP is ignored on purpose — keep it
+            old_handlers.append((s, signal_lib.signal(s, _raise_terminated)))
+    except ValueError:  # non-main thread: no handlers, kills still work
+        pass
+    proc: Optional[subprocess.Popen] = None
+    try:
         while True:
-            rc = proc.poll()
-            if rc is not None:
-                break
-            if liveness_seconds > 0 and board_path:
-                # a missing board counts as "no progress since attempt
-                # start": a child wedged BEFORE its first board write (a
-                # stuck distributed rendezvous, a hung kinit) must be
-                # detected too — the window therefore has to cover startup
-                # (jax import + first compile) as well as an epoch
-                size = (os.path.getsize(board_path)
-                        if os.path.exists(board_path) else -1)
-                if size != last_size:
-                    last_size = size
-                    last_progress = time.monotonic()
-                elif time.monotonic() - last_progress > liveness_seconds:
-                    print(f"supervisor: no progress for {liveness_seconds}s — "
-                          f"killing attempt {attempts}", flush=True)
-                    proc.kill()
-                    proc.wait()
-                    rc = -9
-                    killed_for_hang = True
-                    break
-            time.sleep(poll_seconds)
-        if rc == 0:
-            if attempts > 1:
-                print(f"supervisor: succeeded after {attempts} attempts", flush=True)
-            return 0
-        elapsed = time.monotonic() - start
-        # durable progress only: the checkpoint's epoch advanced this attempt
-        failures_since_progress = charge_restart_budget(
-            failures_since_progress, probe.advanced())
-        print(f"supervisor: attempt {attempts} exited rc={rc} "
-              f"after {elapsed:.1f}s"
-              + (" (liveness kill)" if killed_for_hang else ""), flush=True)
-        if failures_since_progress > max_restarts:
-            print(f"supervisor: restart budget exhausted "
-                  f"({max_restarts} restarts without progress)", flush=True)
-            return rc if isinstance(rc, int) and rc > 0 else 1
+            if deadline.expired():
+                # don't spawn a doomed attempt just to kill it one poll later
+                print("supervisor: job timeout exceeded — terminal, "
+                      "no restart", flush=True)
+                return EXIT_TIMEOUT
+            attempts += 1
+            start = time.monotonic()
+            probe = ProgressProbe(checkpoint_dir)
+            proc = subprocess.Popen(cmd, start_new_session=True)
+            last_size = -1
+            last_progress = time.monotonic()
+            killed_for_hang = False
+            try:
+                while True:
+                    rc = proc.poll()
+                    if rc is not None:
+                        break
+                    if deadline.expired():
+                        print(f"supervisor: job timeout "
+                              f"({timeout_seconds:.0f}s) exceeded — killing "
+                              f"attempt {attempts}", flush=True)
+                        # graceful first: the child is healthy (not hung) and
+                        # its SIGTERM drain can finalize the checkpoint
+                        _kill_tree(proc, signal_lib.SIGTERM)
+                        return EXIT_TIMEOUT
+                    if liveness_seconds > 0 and board_path:
+                        # a missing board counts as "no progress since attempt
+                        # start": a child wedged BEFORE its first board write
+                        # (a stuck distributed rendezvous, a hung kinit) must
+                        # be detected too — the window therefore has to cover
+                        # startup (jax import + first compile) plus an epoch
+                        size = (os.path.getsize(board_path)
+                                if os.path.exists(board_path) else -1)
+                        if size != last_size:
+                            last_size = size
+                            last_progress = time.monotonic()
+                        elif (time.monotonic() - last_progress
+                                > liveness_seconds):
+                            print(f"supervisor: no progress for "
+                                  f"{liveness_seconds}s — killing attempt "
+                                  f"{attempts}", flush=True)
+                            # hung tree: no grace, hard-kill immediately
+                            _kill_tree(proc)
+                            rc = -9
+                            killed_for_hang = True
+                            break
+                    time.sleep(poll_seconds)
+            except KeyboardInterrupt:
+                # the new session detaches the child from the terminal's
+                # process group, so Ctrl-C no longer reaches it — forward
+                # SIGINT (graceful unwind) before the SIGKILL escalation
+                _kill_tree(proc, signal_lib.SIGINT)
+                raise
+            if rc == 0:
+                if attempts > 1:
+                    print(f"supervisor: succeeded after {attempts} attempts",
+                          flush=True)
+                return 0
+            if rc == EXIT_TIMEOUT:
+                # terminal: a timed-out job must not restart (each attempt
+                # would checkpoint, reset the budget, and re-derive a fresh
+                # deadline — an infinite loop in timeout-sized chunks)
+                print(f"supervisor: attempt {attempts} hit the job timeout — "
+                      "terminal, no restart", flush=True)
+                return EXIT_TIMEOUT
+            elapsed = time.monotonic() - start
+            # durable progress only: the checkpoint epoch advanced this attempt
+            failures_since_progress = charge_restart_budget(
+                failures_since_progress, probe.advanced())
+            print(f"supervisor: attempt {attempts} exited rc={rc} "
+                  f"after {elapsed:.1f}s"
+                  + (" (liveness kill)" if killed_for_hang else ""), flush=True)
+            if failures_since_progress > max_restarts:
+                print(f"supervisor: restart budget exhausted "
+                      f"({max_restarts} restarts without progress)", flush=True)
+                return rc if isinstance(rc, int) and rc > 0 else 1
+    except _Terminated:
+        # catches the signal wherever it lands — inside the poll loop,
+        # between attempts, or in the Popen→try window — so a live
+        # session-leader child is always drained, never orphaned
+        print("supervisor: stop signal (SIGTERM/SIGHUP) — draining the job",
+              flush=True)
+        # a second signal during the drain must not abort the drain (it
+        # would skip the SIGKILL escalation and leak the child group)
+        for s, _h in old_handlers:
+            signal_lib.signal(s, signal_lib.SIG_IGN)
+        if proc is not None and proc.poll() is None:
+            _kill_tree(proc, signal_lib.SIGTERM)
+        return 143
+    finally:
+        for s, h in old_handlers:
+            signal_lib.signal(s, h)

@@ -114,6 +114,97 @@ def test_timeout_exit_code(job_dir):
     assert "timeout" in r.stdout.lower()
 
 
+def test_exit_timeout_constants_in_sync():
+    """The supervisor keeps its own EXIT_TIMEOUT (it must not import the CLI
+    module it launches); the two spellings must agree."""
+    from shifu_tpu.launcher import cli, supervisor
+    assert cli.EXIT_TIMEOUT == supervisor.EXIT_TIMEOUT == 3
+
+
+def test_supervised_timeout_is_terminal(job_dir):
+    """--supervise --timeout N must stop at N with exit 3 — ONE attempt, no
+    restart.  (Round-2 bug: EXIT_TIMEOUT was treated as a restartable
+    failure and each attempt checkpointed + re-derived a fresh deadline, so
+    the job looped forever in N-second chunks.  Reference semantics: the
+    client kills the app once, terminally — TensorflowClient.java:625-658.)"""
+    import time as _time
+    out = job_dir / "out_st"
+    t0 = _time.monotonic()
+    r = _run_cli(["train",
+                  "--modelconfig", str(job_dir / "ModelConfig.json"),
+                  "--columnconfig", str(job_dir / "ColumnConfig.json"),
+                  "--data", str(job_dir / "normalized"),
+                  "--output", str(out), "--epochs", "500",
+                  "--timeout", "1", "--supervise", "--max-restarts", "3"],
+                 timeout=240)
+    elapsed = _time.monotonic() - t0
+    assert r.returncode == 3, r.stdout + r.stderr
+    assert "timeout" in r.stdout.lower()
+    # exactly one attempt: the supervisor's job deadline killed it or the
+    # child exited 3 — either way nothing restarted
+    assert "attempt 2" not in r.stdout, r.stdout
+    assert "restart budget" not in r.stdout, r.stdout
+    # bounded wall time: one attempt's startup + the 1s budget, nowhere
+    # near max_restarts * attempt length
+    assert elapsed < 200, f"took {elapsed:.0f}s — timeout not terminal?"
+
+
+@pytest.mark.slow
+def test_supervisor_sigterm_drains_child_tree(job_dir):
+    """A scheduler SIGTERM to the supervisor parent must reach the child
+    (which runs in its own session and would otherwise be orphaned): the
+    supervisor forwards SIGTERM to the child's process group, the child's
+    drain saves a checkpoint, and the parent exits 143."""
+    import signal
+    import subprocess as sp
+    import time as _time
+
+    out = job_dir / "out_sig"
+    proc = sp.Popen(
+        [sys.executable, "-m", "shifu_tpu.launcher.cli", "train",
+         "--modelconfig", str(job_dir / "ModelConfig.json"),
+         "--columnconfig", str(job_dir / "ColumnConfig.json"),
+         "--data", str(job_dir / "normalized"),
+         "--output", str(out), "--epochs", "50000", "--supervise"],
+        env=_cli_env(), cwd=REPO, stdout=sp.PIPE, stderr=sp.STDOUT, text=True)
+    # wait for training to actually start (board exists => child is mid-job)
+    board = out / "console.board"
+    deadline = _time.monotonic() + 120
+    while _time.monotonic() < deadline and not board.exists():
+        _time.sleep(0.5)
+    assert board.exists(), "training never started"
+    _time.sleep(1)
+    proc.send_signal(signal.SIGTERM)
+    stdout, _ = proc.communicate(timeout=60)
+    assert proc.returncode == 143, stdout
+    assert "SIGTERM" in stdout, stdout
+    # nothing from this job tree survives the drain
+    _time.sleep(2)
+    r = subprocess.run(["pgrep", "-f", str(out)], capture_output=True,
+                       text=True)
+    assert r.stdout.strip() == "", f"orphans: {r.stdout}"
+
+
+@pytest.mark.slow
+def test_pod_timeout_is_terminal(job_dir):
+    """A --hosts pod run with --timeout (pod implies supervision) is likewise
+    terminal: exit 3, one gang attempt, no whole-gang restart loop."""
+    out = job_dir / "out_pt"
+    env = _cli_env()
+    env["SHIFU_TPU_CPU_DEVICES"] = "2"
+    r = _run_cli(["train",
+                  "--modelconfig", str(job_dir / "ModelConfig.json"),
+                  "--columnconfig", str(job_dir / "ColumnConfig.json"),
+                  "--data", str(job_dir / "normalized"),
+                  "--output", str(out), "--epochs", "500",
+                  "--timeout", "1", "--hosts", "local:2"],
+                 env=env, timeout=300)
+    assert r.returncode == 3, r.stdout + r.stderr
+    assert "timeout" in r.stdout.lower()
+    assert "attempt 2" not in r.stdout, r.stdout
+    assert "terminal" in r.stdout, r.stdout
+
+
 @pytest.mark.slow
 def test_supervisor_recovers_from_injected_fault(job_dir):
     """Fault injection: child dies after epoch 0; supervisor restarts it and
