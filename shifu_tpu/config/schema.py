@@ -301,7 +301,12 @@ class TrainConfig:
     # (every step is globally synchronous, the ssgd_monitor semantics).
     # Parameter averaging after K local lr-steps equals the reference's
     # "average the window's accumulated grads, apply globally, resync" with
-    # learning rate K*lr_ref (it divides the window sum by K, SAGN.py:137-142).
+    # an SGD apply at learning rate K*lr (it divides the window sum by K,
+    # SAGN.py:137-142); shifu_compat divides a migrated SAGN config's
+    # LearningRate by K to keep the effective step size.  KNOWN deviation:
+    # the reference's local AND global applies use Adam (SAGN.py:107-108,
+    # 158-159 — GradientDescent is commented out); this tier is plain SGD
+    # (see validate() below and PARITY.md "Local SGD").
     local_sgd_window: int = 0
 
     def validate(self) -> None:
@@ -318,14 +323,17 @@ class TrainConfig:
         if self.local_sgd_window < 0:
             raise ConfigError("local_sgd_window must be >= 0")
         if self.local_sgd_window > 0:
-            # reference SAGN's local updates are plain GradientDescent
-            # (SAGN.py:150-159); momentum/adaptive state on diverged local
-            # replicas has no reference semantic — reject rather than guess
+            # this tier's local updates are plain p - lr*g; the reference
+            # SAGN ran Adam locally AND globally (SAGN.py:107-108,158-159),
+            # but momentum/adaptive state on diverged local replicas has no
+            # sound averaging semantic here — reject rather than guess, and
+            # document the optimizer-family deviation (PARITY.md)
             if self.optimizer.name != "sgd":
                 raise ConfigError(
-                    "local_sgd_window requires optimizer 'sgd' (the "
-                    "reference SAGN trainer's local updates are plain "
-                    f"gradient descent), got {self.optimizer.name!r}")
+                    "local_sgd_window requires optimizer 'sgd' (this tier "
+                    "implements plain-SGD local updates; the reference "
+                    "SAGN's Adam family is a documented deviation), "
+                    f"got {self.optimizer.name!r}")
             if self.optimizer.accumulate_steps > 1:
                 raise ConfigError("local_sgd_window and accumulate_steps "
                                   "are mutually exclusive")

@@ -285,11 +285,23 @@ def parse_model_config(model_config: dict[str, Any]) -> tuple[ModelSpec, TrainCo
 
     lr = float(params.get("LearningRate", 0.003))  # reference fallback 0.003 (ssgd_monitor.py:136)
     # An explicit params.Optimizer wins; otherwise legacy Propagation codes.
-    # Local-SGD mode uses plain SGD unless explicitly overridden — the
-    # reference SAGN trainer ignores Propagation and always runs
-    # GradientDescent locally (SAGN.py:150-159).
+    # Local-SGD mode: the reference SAGN trainer ignores Propagation and
+    # uses AdamOptimizer for BOTH its local window updates and the global
+    # apply (the GradientDescentOptimizer lines are commented out —
+    # SAGN.py:107-108,158-159).  The TPU local-SGD tier implements
+    # plain-SGD local updates instead (per-replica adaptive state on
+    # diverged replicas has no reference-sound semantics; see
+    # TrainConfig.validate and PARITY.md "Local SGD"), so Optimizer
+    # defaults to sgd here — a KNOWN, documented deviation from the
+    # reference's optimizer family.
     if local_sgd_window > 0:
         opt_name = str(params.get("Optimizer", "sgd")).lower()
+        # The param-averaging formulation advances the persistent params by
+        # ~K*lr per window where the reference advanced by one LearningRate
+        # step of the window-mean grad (SAGN.py:137-167); dividing the
+        # mapped lr by K keeps a migrated SAGN config's effective step size
+        # at its LearningRate instead of silently K x larger.
+        lr = lr / local_sgd_window
     else:
         opt_name = str(params.get(
             "Optimizer", params.get("Propagation", "adadelta"))).lower()

@@ -67,6 +67,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "spelling: SHIFU_TPU_HOSTS")
     t.add_argument("--max-restarts", type=int, default=-1,
                    help="supervisor restart budget (-1 = from config)")
+    t.add_argument("--coordinator-port", type=int, default=0,
+                   help="ssh-pod rendezvous port on hosts[0] (default 8476; "
+                        "env spelling: SHIFU_TPU_COORDINATOR_PORT)")
 
     s = sub.add_parser("score", help="score rows with an exported artifact")
     s.add_argument("--model", required=True, help="artifact dir")
@@ -261,7 +264,8 @@ def run_train(args) -> int:
     pod_hosts = getattr(args, "hosts", None) or pod_lib.detect_hosts_env()
     if pod_hosts and ENV_PROCESS_ID not in os.environ:
         try:
-            spec = pod_lib.parse_hosts(pod_hosts)
+            spec = pod_lib.parse_hosts(
+                pod_hosts, getattr(args, "coordinator_port", 0))
         except (ValueError, OSError) as e:
             print(f"--hosts: {e}", file=sys.stderr, flush=True)
             return EXIT_FAIL

@@ -47,6 +47,7 @@ from dataclasses import dataclass
 from typing import Optional, Sequence
 
 ENV_HOSTS = "SHIFU_TPU_HOSTS"
+ENV_COORDINATOR_PORT = "SHIFU_TPU_COORDINATOR_PORT"
 DEFAULT_COORDINATOR_PORT = 8476
 
 
@@ -58,15 +59,30 @@ class PodSpec:
     remote_python: str = sys.executable  # interpreter on the hosts
 
 
-def parse_hosts(value: str) -> PodSpec:
+def parse_hosts(value: str, coordinator_port: int = 0) -> PodSpec:
     """``local:N`` → N simulated hosts here; ``@file`` → newline-separated
-    host list; ``h1,h2,...`` → ssh to each host."""
+    host list; ``h1,h2,...`` → ssh to each host.
+
+    `coordinator_port` (or SHIFU_TPU_COORDINATOR_PORT) overrides the ssh
+    rendezvous port on hosts[0] — the escape hatch when the default 8476
+    conflicts.  Resolved only on the ssh path: local transport picks a free
+    port and ignores it, so a bad env value must not break local runs."""
     value = value.strip()
     if value.startswith("local:"):
         n = int(value.split(":", 1)[1])
         if n < 1:
             raise ValueError(f"--hosts {value!r}: need at least 1 process")
         return PodSpec(hosts=("local",) * n, transport="local")
+    try:
+        port = (coordinator_port
+                or int(os.environ.get(ENV_COORDINATOR_PORT, "0") or 0)
+                or DEFAULT_COORDINATOR_PORT)
+    except ValueError:
+        raise ValueError(
+            f"{ENV_COORDINATOR_PORT}="
+            f"{os.environ.get(ENV_COORDINATOR_PORT)!r} is not a port number")
+    if not (0 < port < 65536):
+        raise ValueError(f"coordinator port {port} out of range")
     if value.startswith("@"):
         with open(value[1:]) as f:
             hosts = tuple(h.strip() for h in f if h.strip()
@@ -75,7 +91,7 @@ def parse_hosts(value: str) -> PodSpec:
         hosts = tuple(h.strip() for h in value.split(",") if h.strip())
     if not hosts:
         raise ValueError(f"--hosts {value!r}: no hosts")
-    return PodSpec(hosts=hosts, transport="ssh")
+    return PodSpec(hosts=hosts, transport="ssh", coordinator_port=port)
 
 
 def detect_hosts_env() -> Optional[str]:

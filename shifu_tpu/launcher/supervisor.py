@@ -34,22 +34,9 @@ from typing import Optional, Sequence
 EXIT_TIMEOUT = 3
 
 
-def checkpoint_progress(ckpt_dir: Optional[str]) -> int:
-    """Durable progress of a checkpoint dir: the EPOCH recorded in the
-    `PROGRESS` marker the train loop writes after every save (-1 if none).
-
-    Why this signal: console/board lines print before the epoch's
-    conditional save, so log text can claim progress a crash never
-    persisted; the raw global step re-inflates when a mid-epoch resume
-    replays the interrupted epoch, so a deterministic mid-epoch crash loop
-    would look like progress forever.  The marker's epoch only advances
-    when a NEW epoch's save lands.  Works for remote (gs://, hdfs://)
-    checkpoint dirs too — one small file read via fsio.
-
-    Fallback for pre-marker checkpoints (local only): the largest
-    digit-named finalized orbax step dir, counted as epoch-equivalent."""
-    if not ckpt_dir:
-        return -1
+def _marker_epoch(ckpt_dir: str) -> int:
+    """Epoch from the `PROGRESS` marker file (-1 if absent/unreadable);
+    works for remote (gs://, hdfs://) dirs via fsio."""
     import json
 
     from ..train.checkpoint import PROGRESS_MARKER
@@ -63,7 +50,64 @@ def checkpoint_progress(ckpt_dir: Optional[str]) -> int:
                 raw = f.read()
         return int(json.loads(raw).get("epoch", -1))
     except Exception:
+        return -1
+
+
+def _committed_step_epoch(ckpt_dir: str) -> int:
+    """Epoch recorded in the newest FINALIZED orbax step's own `extra`
+    metadata (local dirs; -1 if none).  Crash-safe supplement to the
+    marker: an async save can commit durably and the process die before
+    the marker flush (the marker is only written once the save is KNOWN
+    durable), so on a preemption-heavy job the marker may lag one epoch
+    behind the restorable checkpoint — the checkpoint itself is the
+    authority."""
+    import json
+
+    try:
+        names = sorted((n for n in os.listdir(ckpt_dir) if n.isdigit()),
+                       key=int, reverse=True)
+    except OSError:
+        return -1
+    for name in names:
+        step_dir = os.path.join(ckpt_dir, name)
+        # _CHECKPOINT_METADATA exists only once orbax commits the step
+        if not os.path.exists(os.path.join(step_dir, "_CHECKPOINT_METADATA")):
+            continue
+        try:
+            with open(os.path.join(step_dir, "extra", "metadata")) as f:
+                return int(json.load(f).get("epoch", -1))
+        except (OSError, ValueError):
+            continue
+    return -1
+
+
+def checkpoint_progress(ckpt_dir: Optional[str]) -> int:
+    """Durable progress of a checkpoint dir: the max of the EPOCH recorded
+    in the `PROGRESS` marker and (local dirs) the epoch inside the newest
+    committed orbax step's extra metadata (-1 if neither exists).
+
+    Why epoch, not raw step: console/board lines print before the epoch's
+    conditional save, so log text can claim progress a crash never
+    persisted; and the global step re-inflates when a mid-epoch resume
+    replays the interrupted epoch, so a deterministic mid-epoch crash loop
+    would look like progress forever.  Both sources carry the epoch the
+    train loop actually persisted.
+
+    Last-resort fallback for pre-marker, pre-extra checkpoints (local
+    only): the largest digit-named orbax step dir, counted as
+    epoch-equivalent."""
+    if not ckpt_dir:
+        return -1
+    marker = _marker_epoch(ckpt_dir)
+    try:
+        from ..data import fsio
+        if fsio.is_remote(ckpt_dir):
+            return marker  # remote: marker only (no cheap listing)
+    except Exception:
         pass
+    committed = _committed_step_epoch(ckpt_dir)
+    if marker >= 0 or committed >= 0:
+        return max(marker, committed)
     if not os.path.isdir(ckpt_dir):
         return -1
     best = -1

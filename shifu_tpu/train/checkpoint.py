@@ -11,6 +11,7 @@ restore re-places them onto the current mesh.
 from __future__ import annotations
 
 import os
+import weakref
 from typing import Any, Optional
 
 import jax
@@ -56,6 +57,20 @@ def _write_progress_marker(directory: str, step: int,
         pass
 
 
+# Async saves defer their PROGRESS marker until the save is KNOWN durable
+# (the next wait_until_finished) — a marker recording an epoch whose
+# checkpoint is still in flight could let the supervisors' durable-progress
+# probe reset the restart budget on progress that a crash then discards,
+# and could point one epoch ahead of the restorable checkpoint.
+_PENDING_MARKERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _flush_pending_marker(manager: ocp.CheckpointManager) -> None:
+    pending = _PENDING_MARKERS.pop(manager, None)
+    if pending is not None:
+        _write_progress_marker(str(manager.directory), *pending)
+
+
 def save(manager: ocp.CheckpointManager, step: int, state: Any,
          extra: Optional[dict] = None, block: bool = True) -> None:
     """Save the train state (and a small metadata dict) at `step`.
@@ -66,18 +81,22 @@ def save(manager: ocp.CheckpointManager, step: int, state: Any,
     exiting (`finalize`).
     """
     manager.wait_until_finished()  # at most one save in flight
+    _flush_pending_marker(manager)  # previous async save is now durable
     composite = dict(state=ocp.args.StandardSave(state))
     if extra is not None:
         composite["extra"] = ocp.args.JsonSave(extra)
     manager.save(step, args=ocp.args.Composite(**composite))
     if block:
         manager.wait_until_finished()
-    _write_progress_marker(str(manager.directory), step, extra)
+        _write_progress_marker(str(manager.directory), step, extra)
+    else:
+        _PENDING_MARKERS[manager] = (step, extra)
 
 
 def finalize(manager: ocp.CheckpointManager) -> None:
     """Block until any in-flight async save is durable (call before exit)."""
     manager.wait_until_finished()
+    _flush_pending_marker(manager)
 
 
 def latest_step(manager: ocp.CheckpointManager) -> Optional[int]:

@@ -156,8 +156,13 @@ def make_local_sgd_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
     plain-SGD updates on its OWN parameter replica, then the replicas sync
     by global parameter all-mean (equivalent to SAGN's "average the
     window's accumulated grads, apply through SyncReplicasOptimizer,
-    re-sync global->local" at learning rate K*lr — it divides the window
-    sum by K, SAGN.py:137-142).
+    re-sync global->local" with an SGD apply at learning rate K*lr — it
+    divides the window sum by K, SAGN.py:137-142; shifu_compat divides a
+    migrated SAGN config's LearningRate by K accordingly).  KNOWN
+    deviation: the reference's local and global applies both use Adam
+    (SAGN.py:107-108,158-159); adaptive state on diverged replicas has no
+    sound averaging semantic, so this tier is plain SGD — TrainConfig
+    validation enforces it and PARITY.md documents it.
 
     TPU-native formulation: replicas live as ONE stacked pytree with a
     leading shard axis sharded over `data` (each existing param axis keeps
@@ -220,8 +225,11 @@ def make_local_sgd_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
                            {"features": feats, "target": tgt, "weight": wgt},
                            step)
 
+        # step maps per-shard (in_axes=0): (step, shard) -> a UNIQUE rng
+        # fold value, so replicas draw distinct dropout masks each local
+        # update instead of all sharing shard 0's pattern
         vgrad = jax.vmap(jax.value_and_grad(shard_loss),
-                         in_axes=(0, 0, 0, 0, None))
+                         in_axes=(0, 0, 0, 0, 0))
 
         def sync(params_p):
             return constrain(
@@ -240,8 +248,10 @@ def make_local_sgd_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
             # matches the data-axis layout, so this is a local reshape
             resh = {k: v.reshape(n_shards, local_bs, *v.shape[1:])
                     for k, v in xs.items()}
+            shard_steps = ((state.step + i) * n_shards
+                           + jnp.arange(n_shards, dtype=jnp.int32))
             losses, grads = vgrad(params_p, resh["features"], resh["target"],
-                                  resh["weight"], state.step + i)
+                                  resh["weight"], shard_steps)
             params_p = constrain(
                 jax.tree_util.tree_map(lambda p, g: p - lr * g,
                                        params_p, grads),
@@ -262,25 +272,30 @@ def make_local_sgd_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
         return new_state, acc
 
     donate_argnums = (0,) if donate else ()
-    jitted = [None]
+    cache: dict[str, Any] = {"fn": None, "shardings": None}
 
     def call(state: TrainState, blocks: Batch, order=None):
-        if jitted[0] is None:
-            # first call: state.params leaves are concrete — capture their
-            # real shardings for the traced constraints
-            flat, treedef = jax.tree_util.tree_flatten(state.params)
+        # the traced sharding constraints close over the CURRENT leaves'
+        # concrete placements; keyed on them so a state whose leaves carry
+        # different shardings (e.g. after a cross-topology restore) rebuilds
+        # the jit instead of silently applying stale first-call constraints
+        flat, treedef = jax.tree_util.tree_flatten(state.params)
+        observed = [getattr(l, "sharding", None) for l in flat]
+        if cache["fn"] is None or observed != cache["shardings"]:
+            param_shardings.clear()
             param_shardings.append([leaf_shardings(l) for l in flat])
             param_shardings.append(treedef)
+            cache["shardings"] = observed
             if with_order:
-                jitted[0] = jax.jit(epoch_step,
-                                    donate_argnums=donate_argnums)
+                cache["fn"] = jax.jit(epoch_step,
+                                      donate_argnums=donate_argnums)
             else:
-                jitted[0] = jax.jit(
+                cache["fn"] = jax.jit(
                     lambda st, bl: epoch_step(st, bl),
                     donate_argnums=donate_argnums)
         if with_order:
-            return jitted[0](state, blocks, order)
-        return jitted[0](state, blocks)
+            return cache["fn"](state, blocks, order)
+        return cache["fn"](state, blocks)
 
     return call
 

@@ -114,6 +114,46 @@ def test_resume_disabled(tmp_path, small_job, small_data):
     assert len(r.history) == 2
 
 
+def test_async_save_defers_progress_marker(tmp_path, small_job):
+    """The PROGRESS marker must record only DURABLY saved epochs: with
+    block=False the marker is written at the next wait point (next save or
+    finalize), never while the save may still be in flight — otherwise the
+    supervisors' durable-progress probe could reset the restart budget on
+    progress a crash then discards."""
+    import json
+    import os
+
+    from shifu_tpu.train import checkpoint as ckpt_lib
+    from shifu_tpu.train import init_state
+
+    d = str(tmp_path / "ckpt")
+    mgr = ckpt_lib.make_manager(d)
+    state = init_state(small_job, 30)
+    marker = os.path.join(d, ckpt_lib.PROGRESS_MARKER)
+
+    ckpt_lib.save(mgr, 1, state, extra={"epoch": 0}, block=False)
+    # async: marker may exist only from PREVIOUS durable saves — epoch 0 is
+    # not durable yet, so it must not be visible
+    assert not os.path.exists(marker)
+
+    # even if the process dies after the async save COMMITS but before the
+    # marker flush, the supervisors' probe must still see the progress: the
+    # committed step's own extra metadata is the authority
+    from shifu_tpu.launcher.supervisor import checkpoint_progress
+    mgr.wait_until_finished()  # commit WITHOUT flushing the marker
+    assert not os.path.exists(marker)
+    assert checkpoint_progress(d) == 0
+
+    ckpt_lib.save(mgr, 2, state, extra={"epoch": 1}, block=False)
+    # the wait inside save() made step-1 durable -> its marker flushes
+    with open(marker) as f:
+        assert json.load(f)["epoch"] == 0
+
+    ckpt_lib.finalize(mgr)
+    with open(marker) as f:
+        assert json.load(f)["epoch"] == 1
+
+
 def test_async_save_resume_equivalence(tmp_path, small_job, small_data):
     """async_save overlaps IO with compute but must leave the same durable
     checkpoints: an interrupted async run resumes identically to sync."""

@@ -124,25 +124,32 @@ def test_optimizer_explicit_wins_over_propagation():
 
 def test_sagn_algorithm_maps_to_local_sgd():
     """train.algorithm SAGN selects true local SGD with the reference's
-    update_window=5 and plain-SGD local updates (resources/SAGN.py:110-159);
-    LocalSgdWindow overrides the window for any algorithm."""
+    update_window=5 (resources/SAGN.py:111); LocalSgdWindow overrides the
+    window for any algorithm.  The mapped LearningRate is divided by the
+    window: the param-averaging formulation advances ~K*lr per window where
+    the reference applied ONE LearningRate step of the window-mean grad
+    (SAGN.py:137-167), so an unscaled mapping would train at ~K x the
+    configured step size.  (The reference's Adam family — SAGN.py:107-108,
+    158-159 — is a documented deviation: this tier is plain SGD.)"""
     mc = json.loads(json.dumps(MODEL_CONFIG))
     mc["train"]["algorithm"] = "SAGN"
-    # Propagation stays in the config: the reference SAGN ignores legacy
-    # codes and always uses plain gradient descent locally
+    # Propagation stays in the config: the reference SAGN ignores legacy codes
     spec, tc, _ = parse_model_config(mc)
     assert spec.model_type == "mlp"  # same MLP as ssgd (SAGN.py topology)
     assert tc.local_sgd_window == 5
     assert tc.optimizer.name == "sgd"
+    assert tc.optimizer.learning_rate == pytest.approx(0.05 / 5)
 
     mc["train"]["params"]["LocalSgdWindow"] = 3
     _, tc, _ = parse_model_config(mc)
     assert tc.local_sgd_window == 3
+    assert tc.optimizer.learning_rate == pytest.approx(0.05 / 3)
 
     mc["train"]["algorithm"] = "NN"
     del mc["train"]["params"]["LocalSgdWindow"]
     _, tc, _ = parse_model_config(mc)
     assert tc.local_sgd_window == 0
+    assert tc.optimizer.learning_rate == pytest.approx(0.05)
 
 
 def test_multi_target_mode_from_shifu_json(tmp_path):

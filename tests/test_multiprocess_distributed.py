@@ -114,6 +114,28 @@ def test_pod_spec_parsing(tmp_path):
     with pytest.raises(ValueError):
         pod.parse_hosts(",")
 
+    # coordinator port: default 8476; overridable by argument (the CLI's
+    # --coordinator-port) or the SHIFU_TPU_COORDINATOR_PORT env
+    assert pod.parse_hosts("h0,h1").coordinator_port == 8476
+    assert pod.parse_hosts("h0,h1", 9000).coordinator_port == 9000
+    os.environ[pod.ENV_COORDINATOR_PORT] = "9100"
+    try:
+        assert pod.parse_hosts("h0,h1").coordinator_port == 9100
+        assert pod.parse_hosts("h0,h1", 9000).coordinator_port == 9000
+    finally:
+        del os.environ[pod.ENV_COORDINATOR_PORT]
+    with pytest.raises(ValueError):
+        pod.parse_hosts("h0,h1", 70000)
+    # a bad env value must not break LOCAL runs (local transport picks its
+    # own free port and ignores the coordinator port entirely)
+    os.environ[pod.ENV_COORDINATOR_PORT] = "abc"
+    try:
+        assert pod.parse_hosts("local:2").transport == "local"
+        with pytest.raises(ValueError, match="not a port number"):
+            pod.parse_hosts("h0,h1")
+    finally:
+        del os.environ[pod.ENV_COORDINATOR_PORT]
+
     # ssh command carries the rank env contract inline; rank -> host order
     argv, env = pod._host_command(
         spec, 1, ["train", "--output", "/shared/job"],
