@@ -123,12 +123,28 @@ class DataConfig:
     # host shards larger than RAM stream through the staged tier
     # (data/outofcore.py).
     out_of_core: bool = False
+    # stream the FIRST trained epoch: start training on parsed blocks while
+    # the remaining files still parse (single-host staged path; parse, H2D,
+    # and device compute overlap instead of running serially — the fix for
+    # the reference's parse-everything-then-train anti-pattern,
+    # ssgd_monitor.py:348-454).  Later epochs train from the fully loaded,
+    # globally shuffled dataset as usual.
+    stream_first_epoch: bool = True
+    # host->device wire dtype for the FEATURES array: "auto" sends bfloat16
+    # when the model computes in bfloat16 anyway (the model casts inputs
+    # first — models/base.py) and no categorical id columns ride in features
+    # (ids > 256 are not bf16-exact); halves H2D bytes and the resident
+    # tier's HBM footprint.  "float32"/"bfloat16" force a choice.
+    wire_dtype: str = "auto"
 
     def validate(self) -> None:
         if not (0.0 <= self.valid_ratio < 1.0):
             raise ConfigError(f"valid_ratio must be in [0,1): {self.valid_ratio}")
         if self.batch_size <= 0:
             raise ConfigError("batch_size must be positive")
+        if self.wire_dtype not in ("auto", "float32", "bfloat16"):
+            raise ConfigError(
+                f"wire_dtype must be auto/float32/bfloat16: {self.wire_dtype!r}")
 
 
 # ---------------------------------------------------------------------------

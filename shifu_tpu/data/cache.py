@@ -121,6 +121,78 @@ def read_file_cached(
     return arr
 
 
+def projected_entry_name(path: str, delimiter: str, file_idx: int,
+                         schema, valid_ratio: float, split_seed: int,
+                         feature_dtype: str) -> Optional[str]:
+    """Cache name for a PROJECTED per-file result (features/target/weight +
+    train-valid mask, features already in the wire dtype).  Keyed on
+    everything that shapes the result: source file state, schema column
+    selection, split parameters, the file's position in the path list (row
+    ids derive from it), and the feature dtype.  One load then replaces
+    parse + project + split + cast on every later ingest."""
+    base = cache_entry_name(path, delimiter)
+    if base is None:
+        return None
+    sel = _sha1(str((tuple(schema.selected_indices),
+                     tuple(schema.all_target_indices),
+                     schema.weight_index, file_idx,
+                     round(valid_ratio, 9), split_seed, feature_dtype,
+                     CACHE_FORMAT_VERSION)))[:16]
+    return base[:-4] + f"-p{sel}.npz"
+
+
+def load_projected_entry(cache_dir: str, name: str) -> Optional[dict]:
+    """Load a projected entry ({'features','target','weight','valid_mask'})
+    or None on miss/corruption (corrupt entries are removed).  bfloat16
+    features round-trip as a tagged uint16 view (npz has no bf16)."""
+    entry = os.path.join(cache_dir, name)
+    if not os.path.exists(entry):
+        return None
+    try:
+        with np.load(entry) as z:
+            out = {}
+            if "features_bf16" in z:
+                import ml_dtypes
+                out["features"] = z["features_bf16"].view(ml_dtypes.bfloat16)
+            else:
+                out["features"] = z["features"]
+            for k in ("target", "weight", "valid_mask"):
+                out[k] = z[k]
+        if out["features"].ndim == 2:
+            return out
+    except Exception:
+        pass
+    try:
+        os.remove(entry)
+    except OSError:
+        pass
+    return None
+
+
+def write_projected_entry(cache_dir: str, name: str, arrays: dict) -> None:
+    """Atomic npz write; never raises (cache is an accelerator only)."""
+    try:
+        payload = dict(arrays)
+        f = payload.get("features")
+        if f is not None and f.dtype.name == "bfloat16":
+            payload["features_bf16"] = f.view(np.uint16)
+            del payload["features"]
+        os.makedirs(cache_dir, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=cache_dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f2:
+                np.savez(f2, **payload)
+            os.replace(tmp, os.path.join(cache_dir, name))
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+    except OSError:
+        pass
+
+
 def _write_entry(cache_dir: str, name: str, arr: np.ndarray) -> None:
     """Atomic write + prune of superseded entries; never raises (the cache is
     an accelerator, not a correctness dependency — a read-only cache_dir just

@@ -40,18 +40,59 @@ class TabularDataset:
         return TabularDataset(self.features[idx], self.target[idx], self.weight[idx])
 
 
+def _load_one_projected(item: tuple[int, str], schema: DataSchema,
+                        data: DataConfig, feature_dtype: str,
+                        threaded: bool):
+    """Parse + project + split + wire-cast ONE file; the raw (N, C) matrix
+    dies here, so peak memory is (in-flight raw files) + (projected
+    columns), never all raw matrices at once.  With a cache_dir the fully
+    PROJECTED result is cached (data/cache.py projected entries): a hit
+    replaces parse + project + split + cast with one npz load."""
+    from . import cache as cache_lib
+    file_idx, path = item
+    cache_dir = cache_lib.resolve_cache_dir(data.cache_dir)
+    name = None
+    if cache_dir is not None:
+        name = cache_lib.projected_entry_name(
+            path, data.delimiter, file_idx, schema, data.valid_ratio,
+            data.split_seed, feature_dtype)
+        if name is not None:
+            hit = cache_lib.load_projected_entry(cache_dir, name)
+            if hit is not None:
+                mask = hit.pop("valid_mask")
+                return hit, mask
+    rows = cache_lib.read_file_cached(
+        path, data.delimiter, cache_dir=data.cache_dir,
+        parser_threads=1 if threaded else None)
+    cols = reader.project_columns(rows, schema)
+    if feature_dtype == "bfloat16":
+        import ml_dtypes
+        cols["features"] = cols["features"].astype(ml_dtypes.bfloat16)
+    n = cols["features"].shape[0]
+    row_ids = ((np.uint64(file_idx) << np.uint64(40))
+               + np.arange(n, dtype=np.uint64))
+    _, valid_mask = split.train_valid_mask(row_ids, data.valid_ratio,
+                                           data.split_seed)
+    if cache_dir is not None and name is not None:
+        cache_lib.write_projected_entry(
+            cache_dir, name, {**cols, "valid_mask": valid_mask})
+    return cols, valid_mask
+
+
 def load_datasets(
     schema: DataSchema,
     data: DataConfig,
     host_index: int = 0,
     num_hosts: int = 1,
+    feature_dtype: str = "float32",
 ) -> tuple[TabularDataset, TabularDataset]:
     """Load (train, valid) datasets for this host.
 
     Files are round-robined across hosts (successor of
     yarn/appmaster/TrainingDataSet.java:65-82); rows are split train/valid by
     the deterministic hash in `split` (fixes the re-drawn random split quirk,
-    ssgd_monitor.py:395).
+    ssgd_monitor.py:395).  `feature_dtype` "bfloat16" stores features in the
+    wire dtype (see wire_cast_fn) — half the host RAM and H2D bytes.
     """
     if data.out_of_core:
         from .outofcore import load_datasets_out_of_core
@@ -67,20 +108,8 @@ def load_datasets(
     num_threads = data.read_threads or min(len(mine), os.cpu_count() or 1)
     threaded = num_threads > 1 and len(mine) > 1
 
-    def load_one(item: tuple[int, str]):
-        """Parse + project + split ONE file; the raw (N, C) matrix dies here,
-        so peak memory is (in-flight raw files) + (projected columns), never
-        all raw matrices at once."""
-        from .cache import read_file_cached
-        file_idx, path = item
-        rows = read_file_cached(
-            path, data.delimiter, cache_dir=data.cache_dir,
-            parser_threads=1 if threaded else None)
-        cols = reader.project_columns(rows, schema)
-        n = cols["features"].shape[0]
-        row_ids = (np.uint64(file_idx) << np.uint64(40)) + np.arange(n, dtype=np.uint64)
-        _, valid_mask = split.train_valid_mask(row_ids, data.valid_ratio, data.split_seed)
-        return cols, valid_mask
+    def load_one(item):
+        return _load_one_projected(item, schema, data, feature_dtype, threaded)
 
     if threaded:
         from concurrent.futures import ThreadPoolExecutor
@@ -118,6 +147,238 @@ def load_datasets(
             np.random.PCG64(data.split_seed ^ 0xC0FFEE)).permutation(train.num_rows)
         train = train.take(perm)
     return train, valid
+
+
+def wire_cast_fn(schema: DataSchema, data: DataConfig,
+                 model_compute_dtype: str):
+    """Host-side cast applied to batches/blocks before device_put, or None.
+
+    With DataConfig.wire_dtype "auto", features go over the host->device
+    link as bfloat16 exactly when the model computes in bfloat16 (the model
+    casts inputs to compute_dtype first — models/base.py — so the math is
+    bit-identical) and no categorical id columns ride in the feature matrix
+    (integer ids above 256 are not bf16-representable).  Halves H2D bytes
+    and the device-resident tier's HBM footprint; targets/weights stay
+    float32 (losses/metrics accumulate in f32, and user weights are not
+    guaranteed bf16-exact).
+    """
+    mode = data.wire_dtype
+    if mode == "auto":
+        use = (model_compute_dtype == "bfloat16"
+               and not schema.categorical_indices)
+    else:
+        use = mode == "bfloat16"
+    if not use:
+        return None
+    import ml_dtypes
+
+    def cast(b: dict) -> dict:
+        f = b.get("features")
+        if f is None or f.dtype != np.float32:  # already wire dtype
+            return b
+        out = dict(b)
+        out["features"] = f.astype(ml_dtypes.bfloat16)
+        return out
+
+    return cast
+
+
+class StreamingLoader:
+    """Background-parse loader for the streamed first epoch.
+
+    Parses the host's file shard on a background pool (same per-file
+    parse/project/split as load_datasets) and exposes the results two ways:
+
+    - `first_epoch_blocks(batch_size, block_batches)`: a generator yielding
+      stacked (nb, B, ...) TRAIN blocks as soon as enough rows have parsed —
+      the staged-tier feed that lets the first epoch's device compute overlap
+      the remaining files' parse.  Rows arrive in file order (the global
+      shuffle is applied to the retained dataset afterwards); a remainder
+      that doesn't fill a batch carries over to the next block, and the
+      final partial batch is trained only via the retained dataset's later
+      epochs (drop-remainder semantics, same as staged_epoch_blocks).
+    - `datasets()`: blocks until every file parsed; returns the SAME
+      (train, valid) pair load_datasets would have built (identical split,
+      identical global permutation), for epochs after the first.
+    """
+
+    def __init__(self, schema: DataSchema, data: DataConfig,
+                 feature_dtype: str = "float32"):
+        self._schema = schema
+        self._data = data
+        self._feature_dtype = feature_dtype
+        paths: list[str] = []
+        for p in data.paths:
+            paths.extend(reader.list_data_files(p))
+        self._items = list(enumerate(paths))
+        self._results: list[tuple[dict, np.ndarray]] = []
+        self._datasets: Optional[tuple[TabularDataset, TabularDataset]] = None
+        self.real_batches = 0  # set by first_epoch_blocks
+
+        import queue
+        import threading
+        self._q: "queue.Queue" = queue.Queue(maxsize=4)
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread.start()
+
+    def _produce(self) -> None:
+        data = self._data
+        num_threads = (data.read_threads
+                       or min(len(self._items), os.cpu_count() or 1))
+        threaded = num_threads > 1 and len(self._items) > 1
+        try:
+            if threaded:
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(max_workers=num_threads) as pool:
+                    # Executor.map yields in submit order while workers run
+                    # ahead — file order stays deterministic
+                    for res in pool.map(
+                            lambda it: _load_one_projected(
+                                it, self._schema, data,
+                                self._feature_dtype, True),
+                            self._items):
+                        self._q.put(res)
+            else:
+                for it in self._items:
+                    self._q.put(_load_one_projected(
+                        it, self._schema, data, self._feature_dtype, False))
+        except BaseException as e:  # surface parse errors to the consumer
+            self._q.put(e)
+            return
+        self._q.put(None)
+
+    def first_epoch_blocks(self, batch_size: int, block_batches: int,
+                           pad_tail: bool = True) -> Iterator[dict]:
+        """Stacked train blocks in arrival order; retains every result for
+        datasets().  Must be consumed before datasets() is called.
+
+        Every yielded block has the SAME static shape (block_batches,
+        batch_size, ...) so the scan step compiles exactly once.  With
+        `pad_tail` the final partial block is completed with ZERO-WEIGHT
+        rows — exact for the weight-normalized losses (weighted_mse divides
+        by count(w != 0), weighted_bce by sum(w); zero-weight rows add zero
+        loss and zero gradient), so every parsed train row trains in the
+        streamed epoch.  Callers whose loss/regularizer is not
+        weight-gated (bce ignores weights; an L2 penalty applies per step
+        regardless) pass pad_tail=False and the tail rows simply wait for
+        the retained dataset's later epochs.  `real_batches` counts batches
+        containing at least one real row (the train_error denominator)."""
+        self.real_batches = 0
+        buf: list[dict] = []
+        buffered = 0
+        target_rows = batch_size * block_batches
+
+        def take_rows(take: int) -> dict:
+            nonlocal buffered
+            parts: list[dict] = []
+            got = 0
+            while got < take:
+                head = buf[0]
+                need = take - got
+                n = head["features"].shape[0]
+                if n <= need:
+                    parts.append(buf.pop(0))
+                    got += n
+                else:
+                    parts.append({k: v[:need] for k, v in head.items()})
+                    buf[0] = {k: v[need:] for k, v in head.items()}
+                    got += need
+            buffered -= take
+            return {k: np.concatenate([p[k] for p in parts])
+                    for k in parts[0]}
+
+        def as_block(flat: dict) -> dict:
+            return {k: v.reshape(block_batches, batch_size, *v.shape[1:])
+                    for k, v in flat.items()}
+
+        while True:
+            item = self._q.get()
+            if item is None:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            cols, valid_mask = item
+            self._results.append((cols, valid_mask))
+            tm = ~valid_mask
+            if tm.any():
+                buf.append({k: v[tm] for k, v in cols.items()})
+                buffered += int(tm.sum())
+            while buffered >= target_rows:
+                self.real_batches += block_batches
+                yield as_block(take_rows(target_rows))
+        if buffered and pad_tail:
+            n_real = buffered
+            flat = take_rows(n_real)
+            pad = target_rows - n_real
+            padded = {}
+            for k, v in flat.items():
+                padded[k] = np.concatenate(
+                    [v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+            padded["weight"][n_real:] = 0.0
+            self.real_batches += -(-n_real // batch_size)
+            yield as_block(padded)
+
+    def _drain(self) -> None:
+        """Join the background parse, collecting anything the block
+        generator did not consume."""
+        while True:
+            item = (self._q.get()
+                    if self._thread.is_alive() or not self._q.empty()
+                    else None)
+            if item is None:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            self._results.append(item)
+        self._thread.join()
+
+    def _partition(self, want_valid: bool) -> TabularDataset:
+        feats, targs, weights = [], [], []
+        for cols, valid_mask in self._results:
+            m = valid_mask if want_valid else ~valid_mask
+            if m.any():
+                feats.append(cols["features"][m])
+                targs.append(cols["target"][m])
+                weights.append(cols["weight"][m])
+        if not feats:
+            return TabularDataset(
+                np.zeros((0, self._schema.feature_count), np.float32),
+                np.zeros((0, 1), np.float32), np.zeros((0, 1), np.float32))
+        return TabularDataset(np.concatenate(feats), np.concatenate(targs),
+                              np.concatenate(weights))
+
+    def valid_dataset(self) -> TabularDataset:
+        """The valid partition only — cheap (a few % of the rows), so the
+        streamed epoch's end-of-epoch eval does not pay for the full train
+        assembly."""
+        if self._datasets is not None:
+            return self._datasets[1]
+        if not hasattr(self, "_valid"):
+            self._drain()
+            self._valid = self._partition(want_valid=True)
+        return self._valid
+
+    def train_dataset(self) -> TabularDataset:
+        """The train partition with the same global shuffle load_datasets
+        applies — deferred until an epoch actually needs the retained
+        dataset (an epochs=1 streamed job never assembles it)."""
+        return self.datasets()[0]
+
+    def datasets(self) -> tuple[TabularDataset, TabularDataset]:
+        """(train, valid), identical to load_datasets' output.  Joins the
+        background parse if first_epoch_blocks was not (fully) consumed."""
+        if self._datasets is not None:
+            return self._datasets
+        self._drain()
+        valid = self.valid_dataset()
+        train = self._partition(want_valid=False)
+        if train.num_rows > 1:  # same global shuffle as load_datasets
+            perm = np.random.default_rng(np.random.PCG64(
+                self._data.split_seed ^ 0xC0FFEE)).permutation(train.num_rows)
+            train = train.take(perm)
+        self._results = []
+        self._datasets = (train, valid)
+        return self._datasets
 
 
 def batch_iterator(

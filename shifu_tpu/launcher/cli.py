@@ -782,6 +782,10 @@ def run_export(args) -> int:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     _apply_platform_env()
+    # repeat compiles (supervisor restart attempts, re-runs of the same job)
+    # deserialize from the persistent cache instead of recompiling
+    from ..utils.compilecache import enable_persistent_cache
+    enable_persistent_cache()
     args = build_parser().parse_args(argv)
     if args.command == "train":
         return run_train(args)

@@ -55,12 +55,11 @@ def _marker_epoch(ckpt_dir: str) -> int:
 
 def _committed_step_epoch(ckpt_dir: str) -> int:
     """Epoch recorded in the newest FINALIZED orbax step's own `extra`
-    metadata (local dirs; -1 if none).  Crash-safe supplement to the
-    marker: an async save can commit durably and the process die before
-    the marker flush (the marker is only written once the save is KNOWN
-    durable), so on a preemption-heavy job the marker may lag one epoch
-    behind the restorable checkpoint — the checkpoint itself is the
-    authority."""
+    metadata (-1 if none).  Crash-safe supplement to the marker: an async
+    save can commit durably and the process die before the marker flush
+    (the marker is only written once the save is KNOWN durable), so on a
+    preemption-heavy job the marker may lag one epoch behind the
+    restorable checkpoint — the checkpoint itself is the authority."""
     import json
 
     try:
@@ -78,6 +77,41 @@ def _committed_step_epoch(ckpt_dir: str) -> int:
                 return int(json.load(f).get("epoch", -1))
         except (OSError, ValueError):
             continue
+    return -1
+
+
+def _committed_step_epoch_remote(ckpt_dir: str) -> int:
+    """_committed_step_epoch for gs:// hdfs:// mock:// checkpoint dirs via
+    fsio — one directory listing + two small reads per probe.  Without it a
+    preemption-heavy remote-checkpoint job whose attempts each commit one
+    async save (marker flush pending when the kill lands) would look like
+    NO progress every attempt and exhaust the restart budget."""
+    import json
+
+    try:
+        from pyarrow import fs as pafs
+
+        from ..data import fsio
+        filesystem, fs_path = fsio._filesystem(ckpt_dir)
+        base = fs_path.rstrip("/")
+        infos = filesystem.get_file_info(
+            pafs.FileSelector(base, recursive=False))
+        steps = sorted((int(i.base_name) for i in infos
+                        if i.type == pafs.FileType.Directory
+                        and i.base_name.isdigit()), reverse=True)
+        for s in steps:
+            meta = filesystem.get_file_info(
+                f"{base}/{s}/_CHECKPOINT_METADATA")
+            if meta.type != pafs.FileType.File:
+                continue  # tmp/uncommitted step
+            try:
+                with filesystem.open_input_stream(
+                        f"{base}/{s}/extra/metadata") as f:
+                    return int(json.loads(f.read()).get("epoch", -1))
+            except Exception:
+                continue
+    except Exception:
+        return -1
     return -1
 
 
@@ -99,13 +133,14 @@ def checkpoint_progress(ckpt_dir: Optional[str]) -> int:
     if not ckpt_dir:
         return -1
     marker = _marker_epoch(ckpt_dir)
+    remote = False
     try:
         from ..data import fsio
-        if fsio.is_remote(ckpt_dir):
-            return marker  # remote: marker only (no cheap listing)
+        remote = fsio.is_remote(ckpt_dir)
     except Exception:
         pass
-    committed = _committed_step_epoch(ckpt_dir)
+    committed = (_committed_step_epoch_remote(ckpt_dir) if remote
+                 else _committed_step_epoch(ckpt_dir))
     if marker >= 0 or committed >= 0:
         return max(marker, committed)
     if not os.path.isdir(ckpt_dir):

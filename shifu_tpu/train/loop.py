@@ -251,6 +251,9 @@ def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
         n_micro = job.model.pipeline_microbatches or job.model.pipeline_stages
         quantum = n_micro * (mesh.size if mesh is not None else 1)
         bs = -(-bs // quantum) * quantum
+    # same wire cast as training (model casts inputs to compute_dtype first,
+    # so scores are bit-identical; H2D bytes halve)
+    wcast = pipe.wire_cast_fn(job.schema, job.data, job.model.compute_dtype)
     if not multihost:
         # streaming accumulation (O(bins), not O(valid set)) — same
         # accumulator as the multihost branch and the eval CLI; binned AUC
@@ -259,6 +262,8 @@ def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
         for batch in pipe.batch_iterator(ds, bs, shuffle=False,
                                          drop_remainder=False):
             padded, mask = pipe.pad_to_batch(batch, bs)
+            if wcast is not None:
+                padded = wcast(padded)
             if mesh is not None:
                 padded = shard_lib.shard_batch(padded, mesh)
             s = np.asarray(jax.device_get(eval_step(state, padded)))
@@ -292,6 +297,8 @@ def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
         local = {"features": ds.features[lo:hi], "target": ds.target[lo:hi],
                  "weight": ds.weight[lo:hi]}
         local, _ = pipe.pad_to_batch(local, local_bs)  # zero-weight tail
+        if wcast is not None:
+            local = wcast(local)
         gbatch = shard_lib.shard_batch_process_local(local, mesh)
         s, t, w = gather3(eval_step(state, gbatch), gbatch["target"],
                           gbatch["weight"])
@@ -315,17 +322,39 @@ def train(job: JobConfig,
     job = job.validate()
     console = console or (lambda s: print(s, flush=True))
 
+    # features-on-the-wire cast (bf16 when the model computes bf16 anyway):
+    # halves H2D bytes, host RAM, and the resident tier's HBM footprint —
+    # the loaders store features directly in the wire dtype
+    wcast = pipe.wire_cast_fn(job.schema, job.data, job.model.compute_dtype)
+    feature_dtype = "bfloat16" if wcast is not None else "float32"
+
+    # streamed first epoch: defer the (blocking) load and start training on
+    # parsed blocks while the rest of the files parse in the background —
+    # single-host staged path only (multihost needs globally agreed sizes
+    # that exist only after the full parse)
+    stream_loader = None
     if train_ds is None:
         host, nhosts = mesh_lib.host_shard_info(mesh) if mesh else (0, 1)
-        train_ds, valid_ds = pipe.load_datasets(job.schema, job.data, host, nhosts)
-    assert valid_ds is not None
+        rate = job.train.bagging_sample_rate
+        if (job.data.stream_first_epoch and not job.data.out_of_core
+                and nhosts == 1 and jax.process_count() == 1
+                and job.data.staged and job.data.drop_remainder
+                and not (0.0 < rate < 1.0)):
+            stream_loader = pipe.StreamingLoader(job.schema, job.data,
+                                                 feature_dtype)
+        else:
+            train_ds, valid_ds = pipe.load_datasets(
+                job.schema, job.data, host, nhosts,
+                feature_dtype=feature_dtype)
+    assert valid_ds is not None or stream_loader is not None
 
     # Shifu train.baggingSampleRate: deterministic per-run subsample of the
     # TRAIN partition (valid stays complete).  Positions are stable for a
     # given dataset order, so resume sees the same subsample.  The reference
-    # carried the field but never honored it.
+    # carried the field but never honored it.  (Streamed loading is gated
+    # off when bagging is active, so train_ds is always concrete here.)
     rate = job.train.bagging_sample_rate
-    if 0.0 < rate < 1.0 and train_ds.num_rows > 0:
+    if train_ds is not None and 0.0 < rate < 1.0 and train_ds.num_rows > 0:
         from ..data.split import bagging_mask
         keep = np.nonzero(bagging_mask(
             np.arange(train_ds.num_rows, dtype=np.uint64),
@@ -334,7 +363,8 @@ def train(job: JobConfig,
                 f"(baggingSampleRate={rate:g})")
         train_ds = train_ds.take(keep)
 
-    num_features = train_ds.num_features or job.schema.feature_count
+    num_features = (train_ds.num_features if train_ds is not None else 0) \
+        or job.schema.feature_count
     state = init_state(job, num_features, mesh)
 
     # auto-resume (successor of MonitoredTrainingSession restore-on-start)
@@ -364,142 +394,198 @@ def train(job: JobConfig,
                     console("Resuming past a best-params terminal "
                             "checkpoint: optimizer state reinitialized")
 
-    # multi-host: every process holds a disjoint file shard, so batches are
-    # assembled process-locally into global arrays and the step count is
-    # agreed across hosts (collective input path; single-host tiers assume
-    # the whole dataset is visible locally).  ALL sizing decisions below
-    # derive from globally agreed numbers — a host deciding from its local
-    # row count alone would diverge on shapes and deadlock the collectives.
+    # streaming serves only the FIRST epoch of a FRESH run: a resumed epoch
+    # must replay the same globally shuffled, drop-remainder epoch an
+    # uninterrupted run would execute (the streamed pass trains in file
+    # order with a padded tail — fine for epoch 0, a determinism break for
+    # a resume); a complete checkpoint leaves nothing to stream at all
+    if stream_loader is not None and start_epoch > 0:
+        train_ds, valid_ds = stream_loader.datasets()
+        stream_loader = None
+
+    local_sgd = job.train.local_sgd_window > 0
+    # one scan-step object shared by the streamed first epoch and the staged
+    # tier: equal block shapes then compile exactly once
+    if local_sgd:
+        from .step import make_local_sgd_epoch_step
+        epoch_scan_step = make_local_sgd_epoch_step(job, mesh)
+        k_win = job.train.local_sgd_window
+        staged_block_batches = -(-job.data.block_batches // k_win) * k_win
+    else:
+        epoch_scan_step = make_epoch_scan_step(job, mesh)
+        staged_block_batches = job.data.block_batches
+    # cap chunks near ~512k rows so H2D stays sub-second per chunk and
+    # overlaps compute (a 32-batch chunk of 128k-row batches would be one
+    # multi-second transfer with nothing to overlap); keep the local-SGD
+    # window multiple so no sync window truncates mid-chunk
+    chunk_cap = max(1, 524288 // job.data.batch_size)
+    if local_sgd:
+        chunk_cap = max(k_win, (chunk_cap // k_win) * k_win)
+    staged_block_batches = max(1, min(staged_block_batches, chunk_cap))
+
+    # tier plumbing is resolved by _prepare_tiers() once train_ds exists —
+    # immediately on the loaded path, after the streamed first epoch on the
+    # streaming path
     multihost = jax.process_count() > 1 and mesh is not None
     nproc = jax.process_count() if multihost else 1
-    if multihost:
-        from jax.experimental import multihost_utils
-        min_host_rows = int(np.min(multihost_utils.process_allgather(
-            np.asarray(train_ds.num_rows))))
-    else:
-        min_host_rows = train_ds.num_rows
-    if min_host_rows == 0:
-        raise ValueError("a training data shard has 0 rows — nothing to "
-                         "train on" if multihost else
-                         "training dataset has 0 rows — nothing to train on")
-
-    bs = job.data.batch_size
-    mesh_size = mesh.size if mesh is not None else 1
-    global_capacity = min_host_rows * nproc  # rows every host can cover
-    if bs > global_capacity and job.data.drop_remainder:
-        # A dataset smaller than the batch would silently train zero steps;
-        # clamp down (keeping per-device divisibility) and say so.  The
-        # agreed min_host_rows keeps every host choosing the same bs.
-        bs = max((global_capacity // mesh_size) * mesh_size, mesh_size)
-        console(f"batch_size {job.data.batch_size} > {global_capacity} "
-                f"usable rows; clamped to {bs}")
-    if mesh is not None:
-        bs = -(-bs // mesh.size) * mesh.size  # divisible per-device shards
-
-    local_bs = bs
+    min_host_rows = 0
+    bs = local_bs = job.data.batch_size
     steps_per_epoch = None
-    if multihost:
-        # mesh.size = nproc * local_devices, and bs is a mesh.size multiple,
-        # so bs always divides evenly across processes
-        local_bs = bs // nproc
-        steps_per_epoch = min_host_rows // max(local_bs, 1)
-        if steps_per_epoch == 0:
-            raise ValueError(
-                f"a host has < {local_bs} rows (global batch {bs} / {nproc} "
-                "processes) — lower the batch size or rebalance file shards")
-
-    # input-path tier selection: device-resident (dataset fits HBM budget)
-    # > staged blocks > per-batch host feed.  Multi-host supports all
-    # three — resident/staged stack each host's shard into (nb, local_B,
-    # ...) blocks that assemble into global arrays, with nb agreed across
-    # hosts — so distributed epochs are collective scans, not per-batch
-    # dispatches, even when the dataset exceeds HBM.
-    rows_for_blocks = min_host_rows if multihost else train_ds.num_rows
-    # agreed across hosts: per-row bytes are schema-determined (identical
-    # everywhere), and the tier only stages the usable rows_for_blocks
-    # prefix — a host deciding from its raw local shard size could pick a
-    # different tier and deadlock the collectives
-    per_row_bytes = ((train_ds.features.nbytes + train_ds.target.nbytes
-                      + train_ds.weight.nbytes)
-                     // max(train_ds.num_rows, 1))
-    ds_bytes = per_row_bytes * rows_for_blocks
-    use_resident = (job.data.staged and job.data.drop_remainder
-                    and 0 < ds_bytes <= job.data.device_resident_bytes
-                    and rows_for_blocks // local_bs > 0)
-    use_staged = (job.data.staged and job.data.drop_remainder
-                  and not use_resident)
+    use_resident = use_staged = False
     resident_blocks = None
-    local_sgd = job.train.local_sgd_window > 0
-    if local_sgd and not (use_resident or use_staged):
-        raise ValueError(
-            "local_sgd_window (SAGN mode) needs the staged or "
-            "device-resident input tier: set data.staged=True and "
-            "data.drop_remainder=True (local replicas are synchronized by "
-            "epoch scans, not per-batch dispatches)")
-    if use_resident:
-        from .step import make_device_epoch_step, make_local_sgd_epoch_step
-        device_epoch_step = (
-            make_local_sgd_epoch_step(job, mesh, with_order=True)
-            if local_sgd else make_device_epoch_step(job, mesh))
-        nb_total = rows_for_blocks // local_bs
+    device_epoch_step = None
+    train_step = None
+    staged_put_fn = None
+    staged_source = None
 
-        def stack(arr):
-            return arr[:nb_total * local_bs].reshape(
-                nb_total, local_bs, *arr.shape[1:])
-        host_blocks = {"features": stack(train_ds.features),
-                       "target": stack(train_ds.target),
-                       "weight": stack(train_ds.weight)}
+    def _feed_put_fn(shard_local, shard_global):
+        """Device placement for host arrays — blocks or batches, mesh or
+        not, multihost or not — with the wire cast composed in (runs inside
+        the prefetch producer thread).  ONE definition so the block and
+        batch tiers can never diverge on placement/cast rules."""
         if multihost:
-            resident_blocks = shard_lib.shard_blocks_process_local(
-                host_blocks, mesh)
+            put = lambda b: shard_global(b, mesh)
         elif mesh is not None:
-            resident_blocks = shard_lib.shard_blocks(host_blocks, mesh)
+            put = lambda b: shard_local(b, mesh)
         else:
-            resident_blocks = {k: jax.device_put(v)
-                               for k, v in host_blocks.items()}
-    staged_block_batches = job.data.block_batches
-    if use_staged:
-        # loop-invariant staged-tier plumbing (the per-epoch subset below
-        # still varies when shards are imbalanced)
-        if multihost:
-            staged_put_fn = (lambda b:
-                             shard_lib.shard_blocks_process_local(b, mesh))
-        elif mesh is not None:
-            staged_put_fn = lambda b: shard_lib.shard_blocks(b, mesh)
-        else:
-            staged_put_fn = None
+            put = lambda b: {k: jax.device_put(v) for k, v in b.items()}
+        if wcast is None:
+            return put
+        return lambda b: put(wcast(b))
 
-        def staged_source(epoch: int) -> pipe.TabularDataset:
-            """This host's rows for one staged epoch.  Multihost hosts must
-            contribute exactly min_host_rows each (agreed block counts); a
-            host with MORE rows draws a fresh epoch-seeded subset so its
-            tail rows are still sampled across epochs (the per-batch path
-            reshuffles the whole shard per epoch — a fixed prefix would
-            silently never train the excess)."""
-            if not multihost or train_ds.num_rows <= min_host_rows:
-                return train_ds
-            if job.data.shuffle:
-                rng = np.random.default_rng(
-                    np.random.PCG64(job.data.shuffle_seed * 9176 + epoch))
-                keep = np.sort(rng.permutation(
-                    train_ds.num_rows)[:min_host_rows])
+    def _block_put_fn():
+        return _feed_put_fn(shard_lib.shard_blocks,
+                            shard_lib.shard_blocks_process_local)
+
+    def _prepare_tiers():
+        # multi-host: every process holds a disjoint file shard, so batches
+        # are assembled process-locally into global arrays and the step
+        # count is agreed across hosts (collective input path; single-host
+        # tiers assume the whole dataset is visible locally).  ALL sizing
+        # decisions below derive from globally agreed numbers — a host
+        # deciding from its local row count alone would diverge on shapes
+        # and deadlock the collectives.
+        nonlocal min_host_rows, bs, local_bs, steps_per_epoch, use_resident, \
+            use_staged, resident_blocks, device_epoch_step, train_step, \
+            staged_put_fn, staged_source
+        if multihost:
+            from jax.experimental import multihost_utils
+            min_host_rows = int(np.min(multihost_utils.process_allgather(
+                np.asarray(train_ds.num_rows))))
+        else:
+            min_host_rows = train_ds.num_rows
+        if min_host_rows == 0:
+            raise ValueError("a training data shard has 0 rows — nothing to "
+                             "train on" if multihost else
+                             "training dataset has 0 rows — nothing to train on")
+
+        bs = job.data.batch_size
+        mesh_size = mesh.size if mesh is not None else 1
+        global_capacity = min_host_rows * nproc  # rows every host can cover
+        if bs > global_capacity and job.data.drop_remainder:
+            # A dataset smaller than the batch would silently train zero
+            # steps; clamp down (keeping per-device divisibility) and say
+            # so.  The agreed min_host_rows keeps every host choosing the
+            # same bs.
+            bs = max((global_capacity // mesh_size) * mesh_size, mesh_size)
+            console(f"batch_size {job.data.batch_size} > {global_capacity} "
+                    f"usable rows; clamped to {bs}")
+        if mesh is not None:
+            bs = -(-bs // mesh.size) * mesh.size  # divisible per-device shards
+
+        local_bs = bs
+        steps_per_epoch = None
+        if multihost:
+            # mesh.size = nproc * local_devices, and bs is a mesh.size
+            # multiple, so bs always divides evenly across processes
+            local_bs = bs // nproc
+            steps_per_epoch = min_host_rows // max(local_bs, 1)
+            if steps_per_epoch == 0:
+                raise ValueError(
+                    f"a host has < {local_bs} rows (global batch {bs} / "
+                    f"{nproc} processes) — lower the batch size or "
+                    "rebalance file shards")
+
+        # input-path tier selection: device-resident (dataset fits HBM
+        # budget) > staged blocks > per-batch host feed.  Multi-host
+        # supports all three — resident/staged stack each host's shard into
+        # (nb, local_B, ...) blocks that assemble into global arrays, with
+        # nb agreed across hosts — so distributed epochs are collective
+        # scans, not per-batch dispatches, even when the dataset exceeds HBM.
+        rows_for_blocks = min_host_rows if multihost else train_ds.num_rows
+        # agreed across hosts: per-row bytes are schema-determined
+        # (identical everywhere), and the tier only stages the usable
+        # rows_for_blocks prefix — a host deciding from its raw local shard
+        # size could pick a different tier and deadlock the collectives
+        feat_row_bytes = train_ds.features.nbytes // max(train_ds.num_rows, 1)
+        if wcast is not None and train_ds.features.dtype == np.float32:
+            feat_row_bytes //= 2  # bf16 on device (loader may pre-cast)
+        per_row_bytes = (feat_row_bytes
+                         + (train_ds.target.nbytes + train_ds.weight.nbytes)
+                         // max(train_ds.num_rows, 1))
+        ds_bytes = per_row_bytes * rows_for_blocks
+        use_resident = (job.data.staged and job.data.drop_remainder
+                        and 0 < ds_bytes <= job.data.device_resident_bytes
+                        and rows_for_blocks // local_bs > 0)
+        use_staged = (job.data.staged and job.data.drop_remainder
+                      and not use_resident)
+        resident_blocks = None
+        if local_sgd and not (use_resident or use_staged):
+            raise ValueError(
+                "local_sgd_window (SAGN mode) needs the staged or "
+                "device-resident input tier: set data.staged=True and "
+                "data.drop_remainder=True (local replicas are synchronized "
+                "by epoch scans, not per-batch dispatches)")
+        if use_resident:
+            from .step import make_device_epoch_step, make_local_sgd_epoch_step
+            device_epoch_step = (
+                make_local_sgd_epoch_step(job, mesh, with_order=True)
+                if local_sgd else make_device_epoch_step(job, mesh))
+            nb_total = rows_for_blocks // local_bs
+
+            def stack(arr):
+                return arr[:nb_total * local_bs].reshape(
+                    nb_total, local_bs, *arr.shape[1:])
+            host_blocks = {"features": stack(train_ds.features),
+                           "target": stack(train_ds.target),
+                           "weight": stack(train_ds.weight)}
+            if wcast is not None:
+                host_blocks = wcast(host_blocks)
+            if multihost:
+                resident_blocks = shard_lib.shard_blocks_process_local(
+                    host_blocks, mesh)
+            elif mesh is not None:
+                resident_blocks = shard_lib.shard_blocks(host_blocks, mesh)
             else:
-                keep = np.arange(min_host_rows)
-            return train_ds.take(keep)
+                resident_blocks = {k: jax.device_put(v)
+                                   for k, v in host_blocks.items()}
+        if use_staged:
+            # loop-invariant staged-tier plumbing (the per-epoch subset
+            # below still varies when shards are imbalanced)
+            staged_put_fn = _block_put_fn()
 
-        if local_sgd:
-            from .step import make_local_sgd_epoch_step
-            epoch_scan_step = make_local_sgd_epoch_step(job, mesh)
-            # each staged chunk ends in a replica sync (the step averages
-            # back to one tree per call); keep chunks a multiple of the
-            # window so that boundary sync coincides with a scheduled one
-            # and no window is silently truncated mid-stream
-            k = job.train.local_sgd_window
-            staged_block_batches = -(-job.data.block_batches // k) * k
-        else:
-            epoch_scan_step = make_epoch_scan_step(job, mesh)
-    elif not use_resident:
-        train_step = make_train_step(job, mesh)
+            def staged_source(epoch: int) -> pipe.TabularDataset:
+                """This host's rows for one staged epoch.  Multihost hosts
+                must contribute exactly min_host_rows each (agreed block
+                counts); a host with MORE rows draws a fresh epoch-seeded
+                subset so its tail rows are still sampled across epochs
+                (the per-batch path reshuffles the whole shard per epoch —
+                a fixed prefix would silently never train the excess)."""
+                if not multihost or train_ds.num_rows <= min_host_rows:
+                    return train_ds
+                if job.data.shuffle:
+                    rng = np.random.default_rng(
+                        np.random.PCG64(job.data.shuffle_seed * 9176 + epoch))
+                    keep = np.sort(rng.permutation(
+                        train_ds.num_rows)[:min_host_rows])
+                else:
+                    keep = np.arange(min_host_rows)
+                return train_ds.take(keep)
+        elif not use_resident:
+            train_step = make_train_step(job, mesh)
+
+    if train_ds is not None:
+        _prepare_tiers()
     eval_step = make_eval_step(job)
 
     from . import profiler as prof_lib
@@ -564,9 +650,16 @@ def train(job: JobConfig,
     best_valid = float("inf")
     evals_since_best = 0
     best_params_host = None
+    pending_loader = None  # streamed loader whose train set is not yet built
     try:
       for epoch in range(start_epoch, job.train.epochs):
         t0 = time.perf_counter()
+        if pending_loader is not None and epoch > start_epoch:
+            # first epoch after the streamed one: assemble the retained
+            # dataset and resolve the input tiers for the rest of the job
+            train_ds = pending_loader.train_dataset()
+            pending_loader = None
+            _prepare_tiers()
         # loss accumulates on device; host sync happens once per epoch so
         # async dispatch keeps the chips busy (bench.py measures the same way)
         loss_acc = None
@@ -577,7 +670,58 @@ def train(job: JobConfig,
                      if profile_dir and epoch == start_epoch
                      else prof_lib.maybe_trace(None))
         with trace_ctx:
-            if use_resident:
+            streamed_this_epoch = False
+            if stream_loader is not None and epoch == start_epoch:
+                # streamed first epoch: train on stacked blocks as files
+                # parse in the background — parse, H2D (in the prefetch
+                # producer thread), and device compute overlap instead of
+                # running serially
+                stream_bs = bs
+                if mesh is not None:
+                    stream_bs = -(-stream_bs // mesh.size) * mesh.size
+                # same chunk shape as the staged tier (staged_block_batches
+                # already carries the ~512k-row overlap cap), so the
+                # streamed epoch and later staged epochs share ONE compiled
+                # scan program
+                nb_stream = staged_block_batches
+                # zero-weight tail padding is exact only for weight-gated
+                # losses without a per-step L2 term (see first_epoch_blocks)
+                pad_tail = (job.train.loss in ("weighted_mse", "weighted_bce")
+                            and job.model.l2_scale <= 0)
+                console(f"Streaming first epoch: training overlaps the "
+                        f"background parse (batch {stream_bs}, "
+                        f"{nb_stream} batches/chunk)")
+                for blocks in pipe.prefetch_to_device(
+                        stream_loader.first_epoch_blocks(
+                            stream_bs, nb_stream, pad_tail=pad_tail),
+                        mesh, size=job.data.prefetch, put_fn=_block_put_fn()):
+                    timer.mark_input_ready()
+                    state, loss_sum_blk = epoch_scan_step(state, blocks)
+                    loss_acc = (loss_sum_blk if loss_acc is None
+                                else loss_acc + loss_sum_blk)
+                    timer.mark_step_done()
+                # batches that held at least one real row (pad-only batches
+                # contribute zero loss and must not skew train_error)
+                loss_n = stream_loader.real_batches
+                # end-of-epoch eval needs only the (small) valid partition;
+                # the train partition's assembly + global shuffle waits for
+                # the next epoch that actually consumes it (an epochs=1 job
+                # never pays it)
+                valid_ds = stream_loader.valid_dataset()
+                pending_loader, stream_loader = stream_loader, None
+                streamed_this_epoch = loss_n > 0
+                if not streamed_this_epoch:
+                    # empty stream (no train rows at all): assemble now so
+                    # _prepare_tiers can clamp or raise its usual errors
+                    train_ds = pending_loader.train_dataset()
+                    pending_loader = None
+                    _prepare_tiers()
+                    console(f"streamed first epoch had no full batch of "
+                            f"{stream_bs}; re-running epoch {epoch} with "
+                            f"batch {bs}")
+            if streamed_this_epoch:
+                pass
+            elif use_resident:
                 nb_total = resident_blocks["features"].shape[0]
                 if job.data.shuffle:
                     rng = np.random.default_rng(
@@ -618,13 +762,12 @@ def train(job: JobConfig,
                     train_ds, local_bs, shuffle=job.data.shuffle,
                     seed=job.data.shuffle_seed, epoch=epoch,
                     drop_remainder=job.data.drop_remainder or multihost)
-                put_fn = None
                 if multihost:
                     # every host must run the SAME number of collective steps
                     host_batches = itertools.islice(host_batches,
                                                     steps_per_epoch)
-                    put_fn = (lambda b:
-                              shard_lib.shard_batch_process_local(b, mesh))
+                put_fn = _feed_put_fn(shard_lib.shard_batch,
+                                      shard_lib.shard_batch_process_local)
                 for batch in pipe.prefetch_to_device(host_batches, mesh,
                                                      size=job.data.prefetch,
                                                      put_fn=put_fn):

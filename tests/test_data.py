@@ -101,6 +101,105 @@ def test_load_datasets_end_to_end(tmp_path):
     assert t0.num_rows + v0.num_rows + t1.num_rows + v1.num_rows == 2000
 
 
+def test_streaming_loader_matches_load_datasets(tmp_path):
+    """StreamingLoader.datasets() must be bit-identical to load_datasets
+    (same per-file split, same global permutation), and the streamed blocks
+    must cover exactly the full-batch prefix of the train rows in file
+    order, carrying remainders across file boundaries."""
+    from shifu_tpu.data.pipeline import StreamingLoader
+
+    schema = synthetic.make_schema(num_features=8)
+    rows = synthetic.make_rows(2000, schema, seed=2)
+    synthetic.write_files(rows, str(tmp_path / "data"), num_files=4)
+    cfg = DataConfig(paths=(str(tmp_path / "data"),), valid_ratio=0.1)
+
+    ref_train, ref_valid = load_datasets(schema, cfg)
+
+    loader = StreamingLoader(schema, cfg)
+    bs, bb = 128, 3
+    blocks = list(loader.first_epoch_blocks(bs, bb))
+    s_train, s_valid = loader.datasets()
+
+    np.testing.assert_array_equal(s_train.features, ref_train.features)
+    np.testing.assert_array_equal(s_train.target, ref_train.target)
+    np.testing.assert_array_equal(s_train.weight, ref_train.weight)
+    np.testing.assert_array_equal(s_valid.features, ref_valid.features)
+
+    # every block has the SAME static shape (one compile); the tail is
+    # completed with zero-weight rows, so all train rows stream
+    assert all(b["features"].shape[:2] == (bb, bs) for b in blocks)
+    streamed = np.concatenate(
+        [b["features"].reshape(-1, 8) for b in blocks])
+    wstream = np.concatenate(
+        [b["weight"].reshape(-1) for b in blocks])
+    real = wstream != 0.0
+    assert int(real.sum()) == ref_train.num_rows  # pad rows are weight-0
+    assert not real[int(real.sum()):].any()       # pad is a suffix
+    assert loader.real_batches == -(-ref_train.num_rows // bs)
+    # streamed rows are the train rows in FILE order (pre-permutation):
+    # reconstruct that order from the reference by undoing the perm
+    perm = np.random.default_rng(np.random.PCG64(
+        cfg.split_seed ^ 0xC0FFEE)).permutation(ref_train.num_rows)
+    file_order = np.empty_like(ref_train.features)
+    file_order[perm] = ref_train.features
+    np.testing.assert_array_equal(streamed[real], file_order)
+
+    # pad_tail=False: only whole batches stream, remainder waits for the
+    # retained dataset's later epochs
+    loader2 = StreamingLoader(schema, cfg)
+    blocks2 = list(loader2.first_epoch_blocks(bs, bb, pad_tail=False))
+    total2 = sum(b["features"].shape[0] * bs for b in blocks2)
+    assert total2 == (ref_train.num_rows // (bb * bs)) * bb * bs
+    loader2.datasets()
+
+
+def test_streaming_loader_datasets_without_consuming(tmp_path):
+    """datasets() alone (stream never consumed) still returns everything —
+    the fallback when the streamed epoch is skipped (e.g. resume says the
+    job is complete)."""
+    from shifu_tpu.data.pipeline import StreamingLoader
+
+    schema = synthetic.make_schema(num_features=6)
+    rows = synthetic.make_rows(500, schema, seed=4)
+    synthetic.write_files(rows, str(tmp_path / "data"), num_files=3)
+    cfg = DataConfig(paths=(str(tmp_path / "data"),))
+    loader = StreamingLoader(schema, cfg)
+    train, valid = loader.datasets()
+    assert train.num_rows + valid.num_rows == 500
+    # idempotent
+    t2, _ = loader.datasets()
+    assert t2 is train
+
+
+def test_wire_cast_fn_gating():
+    """bf16 wire format engages only when it is bit-safe: bf16 compute and
+    no categorical id columns (ids > 256 are not bf16-exact)."""
+    import ml_dtypes
+
+    from shifu_tpu.data.pipeline import wire_cast_fn
+
+    plain = synthetic.make_schema(num_features=6)
+    cat = synthetic.make_schema(num_features=6, num_categorical=2,
+                                vocab_size=1000)
+    cfg = DataConfig()
+    assert wire_cast_fn(plain, cfg, "float32") is None
+    assert wire_cast_fn(cat, cfg, "bfloat16") is None
+    cast = wire_cast_fn(plain, cfg, "bfloat16")
+    assert cast is not None
+    b = {"features": np.ones((4, 6), np.float32),
+         "target": np.ones((4, 1), np.float32),
+         "weight": np.ones((4, 1), np.float32)}
+    out = cast(b)
+    assert out["features"].dtype == ml_dtypes.bfloat16
+    assert out["target"].dtype == np.float32  # only features ride bf16
+    # explicit override beats auto
+    import dataclasses
+    assert wire_cast_fn(plain, dataclasses.replace(cfg, wire_dtype="float32"),
+                        "bfloat16") is None
+    assert wire_cast_fn(cat, dataclasses.replace(cfg, wire_dtype="bfloat16"),
+                        "float32") is not None
+
+
 def test_batch_iterator_shapes_and_determinism():
     ds = TabularDataset(
         features=np.arange(100 * 3, dtype=np.float32).reshape(100, 3),

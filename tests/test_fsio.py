@@ -122,6 +122,37 @@ def mock_fs(tmp_path):
         fsio._fs_cache.pop(("mock", ""), None)
 
 
+def test_remote_committed_step_epoch_probe(mock_fs):
+    """The supervisors' durable-progress probe reads the newest COMMITTED
+    orbax step's own epoch on remote checkpoint dirs too — an async save
+    that commits right before a preemption (marker flush still pending)
+    must count as progress (round-3 review finding)."""
+    import json
+
+    from shifu_tpu.launcher.supervisor import checkpoint_progress
+
+    filesystem, root, _ = mock_fs
+    ck = "bucket/ckpt"
+    filesystem.create_dir(ck)
+    # committed step 7 (epoch 2): has _CHECKPOINT_METADATA
+    filesystem.create_dir(f"{ck}/7/extra")
+    with filesystem.open_output_stream(f"{ck}/7/_CHECKPOINT_METADATA") as s:
+        s.write(b"{}")
+    with filesystem.open_output_stream(f"{ck}/7/extra/metadata") as s:
+        s.write(json.dumps({"epoch": 2}).encode())
+    # newer but UNCOMMITTED step 9 (no metadata file): must be skipped
+    filesystem.create_dir(f"{ck}/9/extra")
+    with filesystem.open_output_stream(f"{ck}/9/extra/metadata") as s:
+        s.write(json.dumps({"epoch": 3}).encode())
+    uri = "mock://bucket/ckpt"
+    assert checkpoint_progress(uri) == 2
+    # a fresher marker wins the max
+    from shifu_tpu.train.checkpoint import PROGRESS_MARKER
+    with filesystem.open_output_stream(f"{ck}/{PROGRESS_MARKER}") as s:
+        s.write(json.dumps({"epoch": 5, "step": 9}).encode())
+    assert checkpoint_progress(uri) == 5
+
+
 def test_mock_remote_listing_and_read(mock_fs):
     """The full remote path over a non-local filesystem: list (skipping
     markers, bucket-style URI rebuild), read+gunzip, stream-count."""
