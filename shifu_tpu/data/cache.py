@@ -170,7 +170,8 @@ def load_projected_entry(cache_dir: str, name: str) -> Optional[dict]:
 
 
 def write_projected_entry(cache_dir: str, name: str, arrays: dict) -> None:
-    """Atomic npz write; never raises (cache is an accelerator only)."""
+    """Atomic npz write + prune of stale-source entries; never raises
+    (cache is an accelerator only)."""
     try:
         payload = dict(arrays)
         f = payload.get("features")
@@ -189,6 +190,7 @@ def write_projected_entry(cache_dir: str, name: str, arrays: dict) -> None:
                     os.remove(tmp)
                 except OSError:
                     pass
+        _prune_superseded(cache_dir, name)
     except OSError:
         pass
 
@@ -216,15 +218,30 @@ def _write_entry(cache_dir: str, name: str, arr: np.ndarray) -> None:
 
 
 def _prune_superseded(cache_dir: str, fresh_name: str) -> None:
-    """Remove older entries for the same source path (same path-hash prefix)."""
-    prefix = fresh_name.split("-", 1)[0]
+    """Remove entries for the same source path (path-hash prefix) whose
+    META hash differs — a rewritten/re-mtimed source supersedes BOTH its
+    raw `.npy` and every projected `-p*.npz` built from it, which would
+    otherwise accumulate a dataset-sized orphan per rewrite.  Entries with
+    the same meta but a different projection key stay (two jobs with
+    different split params legitimately share the cache dir)."""
+    parts = fresh_name.rsplit(".", 1)[0].split("-")
+    if len(parts) < 2:
+        return
+    path_part, meta_part = parts[0], parts[1]
     try:
         for existing in os.listdir(cache_dir):
-            if (existing.endswith(".npy") and existing != fresh_name
-                    and existing.split("-", 1)[0] == prefix):
-                try:
-                    os.remove(os.path.join(cache_dir, existing))
-                except OSError:
-                    pass
+            if not (existing.endswith(".npy") or existing.endswith(".npz")):
+                continue
+            if existing == fresh_name:
+                continue
+            eparts = existing.rsplit(".", 1)[0].split("-")
+            if len(eparts) < 2 or eparts[0] != path_part:
+                continue
+            if eparts[1] == meta_part:
+                continue  # same source state: raw + projections coexist
+            try:
+                os.remove(os.path.join(cache_dir, existing))
+            except OSError:
+                pass
     except OSError:
         pass

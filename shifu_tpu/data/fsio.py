@@ -19,6 +19,7 @@ metadata, so steady-state ingest of remote data is a local mmap-speed read.
 
 from __future__ import annotations
 
+import os
 import re
 from typing import Optional, Tuple
 
@@ -181,6 +182,50 @@ def _classifier(filesystem, fs_path: str, path: str):
                 f"expected a file, got a directory: {path}") from e
 
     return classify
+
+
+def join(base: str, *names: str) -> str:
+    """Path join that preserves remote URI schemes (os.path.join would
+    mangle 'gs://bucket' + 'x' fine but keep one definition for both)."""
+    if is_remote(base):
+        return "/".join([base.rstrip("/"), *names])
+    return os.path.join(base, *names)
+
+
+def write_bytes(path: str, data: bytes) -> None:
+    """Write a whole object/file at `path` (remote URIs via pyarrow.fs;
+    parent 'directories' are implicit on object stores, created on
+    hdfs-style filesystems)."""
+    filesystem, fs_path = _filesystem(path)
+
+    def op() -> None:
+        parent = fs_path.rsplit("/", 1)[0]
+        if parent and parent != fs_path:
+            try:
+                filesystem.create_dir(parent, recursive=True)
+            except Exception:
+                pass  # object stores have no dirs; write decides
+        with filesystem.open_output_stream(fs_path) as f:
+            f.write(data)
+
+    _retry_transient(op, _classifier(filesystem, fs_path, path))
+
+
+def upload_dir(local_dir: str, remote_dir: str) -> list[str]:
+    """Upload every file under local_dir to remote_dir (flat recursion,
+    relative layout preserved); returns the remote paths written.  Used to
+    ship locally-built artifacts (export dir, native pack) to a remote job
+    dir."""
+    out: list[str] = []
+    base = remote_dir.rstrip("/")
+    for root, _dirs, files in os.walk(local_dir):
+        rel_root = os.path.relpath(root, local_dir)
+        for name in sorted(files):
+            rel = name if rel_root == "." else f"{rel_root}/{name}"
+            with open(os.path.join(root, name), "rb") as f:
+                write_bytes(f"{base}/{rel}", f.read())
+            out.append(f"{base}/{rel}")
+    return out
 
 
 def read_bytes(path: str) -> bytes:

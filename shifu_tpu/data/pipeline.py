@@ -320,16 +320,26 @@ class StreamingLoader:
 
     def _drain(self) -> None:
         """Join the background parse, collecting anything the block
-        generator did not consume."""
-        while True:
-            item = (self._q.get()
-                    if self._thread.is_alive() or not self._q.empty()
-                    else None)
+        generator did not consume.  Timed gets, no unconditional blocking:
+        the None sentinel may already have been consumed by
+        first_epoch_blocks (a bare get() would hang forever), and the
+        producer may be blocked on a full queue (a bare join() first would
+        deadlock) — the loop drains and watches thread liveness together."""
+        import queue as queue_lib
+        done = False
+        while not done:
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue_lib.Empty:
+                if not self._thread.is_alive():
+                    done = True
+                continue
             if item is None:
-                break
-            if isinstance(item, BaseException):
+                done = True
+            elif isinstance(item, BaseException):
                 raise item
-            self._results.append(item)
+            else:
+                self._results.append(item)
         self._thread.join()
 
     def _partition(self, want_valid: bool) -> TabularDataset:

@@ -70,6 +70,46 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--coordinator-port", type=int, default=0,
                    help="ssh-pod rendezvous port on hosts[0] (default 8476; "
                         "env spelling: SHIFU_TPU_COORDINATOR_PORT)")
+    t.add_argument("--detach", action="store_true",
+                   help="submit and return immediately: the job runs under "
+                        "a detached session-leader dispatcher that survives "
+                        "this client (status/attach/kill drive it from the "
+                        "job dir afterwards)")
+    t.add_argument("--provision", action="store_true",
+                   help="acquire a TPU slice first (shifu.provision.* keys "
+                        "/ --provision-* flags), dispatch the pod onto its "
+                        "workers, release the slice when the job ends")
+    t.add_argument("--keep-slice", action="store_true",
+                   help="with --provision: leave the slice running after "
+                        "the job (inspect/reuse; release with "
+                        "`shifu-tpu provision delete`)")
+    _add_provision_flags(t)
+
+    pv = sub.add_parser(
+        "provision", help="TPU slice lifecycle (queued resources): the "
+                          "compute-acquisition step the reference client "
+                          "got from YARN submitApplication")
+    pv.add_argument("action", choices=["create", "status", "hosts", "delete"])
+    pv.add_argument("--globalconfig", default=None,
+                    help="Hadoop-style XML carrying shifu.provision.* keys")
+    pv.add_argument("--wait", action="store_true",
+                    help="with create: block until the slice is ACTIVE")
+    _add_provision_flags(pv)
+
+    st = sub.add_parser("status", help="report a detached job's state "
+                                       "(RUNNING/FINISHED/FAILED + last "
+                                       "progress line) from its job dir")
+    st.add_argument("job_dir")
+    at = sub.add_parser("attach", help="follow a detached job's console "
+                                       "board until it ends (TailThread "
+                                       "parity); exits with the job's code")
+    at.add_argument("job_dir")
+    at.add_argument("--tail", action="store_true",
+                    help="start from the board's current end, not the top")
+    kl = sub.add_parser("kill", help="terminate a detached job's whole "
+                                     "process tree (SIGTERM drain, then "
+                                     "SIGKILL)")
+    kl.add_argument("job_dir")
 
     s = sub.add_parser("score", help="score rows with an exported artifact")
     s.add_argument("--model", required=True, help="artifact dir")
@@ -114,6 +154,71 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _add_provision_flags(p) -> None:
+    p.add_argument("--provision-name", default="",
+                   help="queued-resource / node id (shifu.provision.name)")
+    p.add_argument("--accelerator-type", default="",
+                   help="e.g. v5litepod-16 (shifu.provision.accelerator-type)")
+    p.add_argument("--zone", default="",
+                   help="e.g. us-west4-a (shifu.provision.zone)")
+    p.add_argument("--project", default="",
+                   help="GCP project (shifu.provision.project; default = "
+                        "gcloud's configured project)")
+    p.add_argument("--runtime-version", default="",
+                   help="TPU VM runtime (shifu.provision.runtime-version)")
+    p.add_argument("--spot", action="store_true",
+                   help="request spot/preemptible capacity "
+                        "(shifu.provision.spot)")
+
+
+def _provision_spec(args):
+    """ProvisionSpec from --globalconfig shifu.provision.* keys with CLI
+    flags as the top override layer."""
+    from ..utils import xmlconfig
+    from .provision import spec_from_xml
+
+    conf: dict = {}
+    if getattr(args, "globalconfig", None):
+        conf = xmlconfig.parse_configuration_xml(args.globalconfig)
+    return spec_from_xml(
+        conf,
+        name=getattr(args, "provision_name", ""),
+        accelerator_type=getattr(args, "accelerator_type", ""),
+        zone=getattr(args, "zone", ""),
+        project=getattr(args, "project", ""),
+        runtime_version=getattr(args, "runtime_version", ""),
+        spot=getattr(args, "spot", False),
+    )
+
+
+def run_provision(args) -> int:
+    from . import provision as prov
+
+    try:
+        spec = _provision_spec(args)
+        if args.action == "create":
+            prov.create(spec)
+            if args.wait:
+                prov.await_ready(spec)
+            return EXIT_OK
+        if args.action == "status":
+            spec.validate()
+            print(prov.state(spec))
+            return EXIT_OK
+        if args.action == "hosts":
+            spec.validate()
+            print(",".join(prov.worker_hosts(spec)))
+            return EXIT_OK
+        if args.action == "delete":
+            spec.validate()
+            prov.delete(spec)
+            return EXIT_OK
+    except prov.ProvisionError as e:
+        print(f"provision: {e}", file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    return EXIT_FAIL
+
+
 def _kerberos_from_xml(globalconfig) -> int:
     """Acquire a Kerberos ticket for score/eval when --globalconfig carries
     shifu.security.kerberos.* keys (same fail-fast as run_train); returns an
@@ -138,6 +243,7 @@ def _assemble_job(args, write_files: bool = True) -> "JobConfig":
 
     from ..config import job_config_from_shifu
     from ..config.schema import CheckpointConfig
+    from ..data import fsio
     from ..utils import xmlconfig
 
     job = job_config_from_shifu(args.modelconfig, args.columnconfig,
@@ -149,7 +255,9 @@ def _assemble_job(args, write_files: bool = True) -> "JobConfig":
         job = xmlconfig.apply_to_job(job, merged_xml)
 
     out_dir = _resolve_out_dir(args)
-    os.makedirs(out_dir, exist_ok=True)
+    remote_out = fsio.is_remote(out_dir)
+    if not remote_out:
+        os.makedirs(out_dir, exist_ok=True)
 
     # overrides, highest precedence (the reference's programmatic layer)
     train = job.train
@@ -166,32 +274,47 @@ def _assemble_job(args, write_files: bool = True) -> "JobConfig":
     if not runtime.checkpoint.directory:
         runtime = dataclasses.replace(
             runtime, checkpoint=dataclasses.replace(
-                runtime.checkpoint, directory=os.path.join(out_dir, "tmp_model")))
+                runtime.checkpoint,
+                directory=fsio.join(out_dir, "tmp_model")))
     if not runtime.final_model_path:
         runtime = dataclasses.replace(
-            runtime, final_model_path=os.path.join(out_dir, "final_model"))
+            runtime, final_model_path=fsio.join(out_dir, "final_model"))
     job = job.replace(train=train, data=data, runtime=runtime)
 
     if write_files:  # chief-only under multi-process (shared job dir)
         # persist the raw Shifu inputs beside the derived configs, like the
         # reference client's per-app upload of ModelConfig/ColumnConfig
-        # (TensorflowClient.java:356-382) — the job dir alone reproduces the run
-        import shutil
+        # (TensorflowClient.java:356-382) — the job dir alone reproduces the
+        # run.  A remote (gs:// hdfs://) job dir writes through fsio, the
+        # same contract the reference had with its per-app HDFS dir.
         for src in (args.modelconfig, args.columnconfig):
-            dst = os.path.join(out_dir, os.path.basename(src))
-            # realpath: a symlinked cwd can alias src and dst (SameFileError)
-            if os.path.realpath(src) != os.path.realpath(dst):
-                shutil.copyfile(src, dst)
+            dst = fsio.join(out_dir, os.path.basename(src))
+            if remote_out:
+                with open(src, "rb") as f:
+                    fsio.write_bytes(dst, f.read())
+            else:
+                import shutil
+                # realpath: a symlinked cwd can alias src and dst
+                if os.path.realpath(src) != os.path.realpath(dst):
+                    shutil.copyfile(src, dst)
 
         # persist the merged view (global-final.xml parity + typed JSON)
-        xmlconfig.write_configuration_xml(
-            {**merged_xml,
-             "shifu.application.epochs": str(job.train.epochs),
-             "shifu.application.final-model-path": job.runtime.final_model_path,
-             "shifu.application.tmp-model-path": job.runtime.checkpoint.directory},
-            os.path.join(out_dir, "global-final.xml"))
-        with open(os.path.join(out_dir, "job-config.json"), "w") as f:
-            f.write(job.to_json())
+        final_conf = {**merged_xml,
+                      "shifu.application.epochs": str(job.train.epochs),
+                      "shifu.application.final-model-path":
+                          job.runtime.final_model_path,
+                      "shifu.application.tmp-model-path":
+                          job.runtime.checkpoint.directory}
+        if remote_out:
+            fsio.write_bytes(fsio.join(out_dir, "global-final.xml"),
+                             xmlconfig.configuration_xml_bytes(final_conf))
+            fsio.write_bytes(fsio.join(out_dir, "job-config.json"),
+                             job.to_json().encode())
+        else:
+            xmlconfig.write_configuration_xml(
+                final_conf, os.path.join(out_dir, "global-final.xml"))
+            with open(os.path.join(out_dir, "job-config.json"), "w") as f:
+                f.write(job.to_json())
     return job, out_dir
 
 
@@ -254,6 +377,39 @@ def run_train(args) -> int:
     # supervised multi-process job restarts as a whole gang — supervisor
     # wraps the spawner, spawner wraps the worker processes.
 
+    # --detach: re-launch this dispatcher as a session-leader daemon and
+    # return (YARN parity: the job outlives the submitting client,
+    # TensorflowClient.java:625-658; status/attach/kill drive it after)
+    from . import detach as detach_lib
+    if getattr(args, "detach", False) \
+            and detach_lib.ENV_DETACHED not in os.environ:
+        out_dir = _resolve_out_dir(args)
+        args.output = out_dir
+        child = _child_train_args(
+            args, out_dir, num_processes=getattr(args, "num_processes", 0))
+        # preserve the orchestration flags the slim child argv strips
+        if getattr(args, "hosts", None):
+            child += ["--hosts", args.hosts]
+        if getattr(args, "provision", False):
+            child += ["--provision"]
+            for flag, attr in (("--provision-name", "provision_name"),
+                               ("--accelerator-type", "accelerator_type"),
+                               ("--zone", "zone"), ("--project", "project"),
+                               ("--runtime-version", "runtime_version")):
+                if getattr(args, attr, ""):
+                    child += [flag, getattr(args, attr)]
+            if getattr(args, "spot", False):
+                child += ["--spot"]
+            if getattr(args, "keep_slice", False):
+                child += ["--keep-slice"]
+        elif getattr(args, "supervise", False) or not getattr(args, "hosts", None):
+            child += ["--supervise"]  # a detached job should self-heal
+        if getattr(args, "max_restarts", -1) >= 0:
+            child += ["--max-restarts", str(args.max_restarts)]
+        if getattr(args, "coordinator_port", 0):
+            child += ["--coordinator-port", str(args.coordinator_port)]
+        return detach_lib.submit(child, out_dir)
+
     # pod-scale launch (successor of the YARN submit/monitor path): the
     # dispatcher routes here only in the PARENT — dispatched children carry
     # the SHIFU_TPU_PROCESS_ID env and run the plain train path below.
@@ -262,6 +418,36 @@ def run_train(args) -> int:
     from ..parallel.distributed import ENV_PROCESS_ID
     from . import pod as pod_lib
     pod_hosts = getattr(args, "hosts", None) or pod_lib.detect_hosts_env()
+
+    # --provision: acquire a slice, dispatch the pod onto its workers,
+    # release on every exit path (successor of createApplication ->
+    # submitApplication -> monitorApplication, TensorflowClient.java:339-426)
+    if getattr(args, "provision", False) and ENV_PROCESS_ID not in os.environ:
+        from . import provision as prov
+        if pod_hosts:
+            print("--provision and --hosts are exclusive (provisioning "
+                  "derives the hosts from the new slice)",
+                  file=sys.stderr, flush=True)
+            return EXIT_FAIL
+        try:
+            spec = _provision_spec(args)
+            spec.validate()
+        except prov.ProvisionError as e:
+            print(f"provision: {e}", file=sys.stderr, flush=True)
+            return EXIT_FAIL
+
+        def _dispatch(hosts: list) -> int:
+            args.hosts = ",".join(hosts)
+            args.provision = False  # re-entry takes the pod branch below
+            return run_train(args)
+
+        try:
+            return prov.provision_and_run(
+                spec, _dispatch, keep=getattr(args, "keep_slice", False))
+        except prov.ProvisionError as e:
+            print(f"provision: {e}", file=sys.stderr, flush=True)
+            return EXIT_FAIL
+
     if pod_hosts and ENV_PROCESS_ID not in os.environ:
         try:
             spec = pod_lib.parse_hosts(
@@ -273,9 +459,11 @@ def run_train(args) -> int:
             print("--hosts and --num-processes are alternative spellings of "
                   "a process gang; use one", file=sys.stderr, flush=True)
             return EXIT_FAIL
+        from ..data import fsio as fsio_mod
         out_dir = _resolve_out_dir(args)
         args.output = out_dir  # pin: a second resolve could timestamp anew,
-        os.makedirs(out_dir, exist_ok=True)  # desyncing the checkpoint probe
+        if not fsio_mod.is_remote(out_dir):  # desyncing the checkpoint probe
+            os.makedirs(out_dir, exist_ok=True)
         sup_job = _assemble_job(args, write_files=False)[0]
         max_restarts = (args.max_restarts if args.max_restarts >= 0
                         else sup_job.runtime.max_restarts)
@@ -287,17 +475,19 @@ def run_train(args) -> int:
             timeout_seconds=sup_job.runtime.timeout_seconds)
 
     if args.supervise:
+        from ..data import fsio as fsio_mod
         from .supervisor import supervise
         out_dir = _resolve_out_dir(args)
         args.output = out_dir  # pin: a second resolve could timestamp anew,
-        os.makedirs(out_dir, exist_ok=True)  # desyncing the checkpoint probe
+        if not fsio_mod.is_remote(out_dir):  # desyncing the checkpoint probe
+            os.makedirs(out_dir, exist_ok=True)
         sup_job = _assemble_job(args, write_files=False)[0]
         max_restarts = (args.max_restarts if args.max_restarts >= 0
                         else sup_job.runtime.max_restarts)
         child_args = _child_train_args(
             args, out_dir, num_processes=getattr(args, "num_processes", 0))
         return supervise(child_args, max_restarts=max_restarts,
-                         board_path=os.path.join(out_dir, "console.board"),
+                         board_path=fsio_mod.join(out_dir, "console.board"),
                          liveness_seconds=sup_job.runtime.liveness_seconds,
                          checkpoint_dir=sup_job.runtime.checkpoint.directory,
                          timeout_seconds=sup_job.runtime.timeout_seconds)
@@ -340,8 +530,9 @@ def run_train(args) -> int:
     from ..train import train
     from .console import ConsoleBoard
 
+    from ..data import fsio as fsio_lib
     if chief:
-        board = ConsoleBoard(os.path.join(out_dir, "console.board"))
+        board = ConsoleBoard(fsio_lib.join(out_dir, "console.board"))
     else:  # non-chief processes train silently (reference: only the AM's
         class board:  # aggregated view reached the console board)
             def __call__(self, _s): pass
@@ -436,7 +627,7 @@ def run_train(args) -> int:
     if chief:
         # make_forward_fn inside: meshless rebuild for single-host export
         _export_and_pack(params, job, job.runtime.final_model_path, board)
-        _write_metrics_jsonl(result, os.path.join(out_dir, "metrics.jsonl"))
+        _write_metrics_jsonl(result, fsio_lib.join(out_dir, "metrics.jsonl"))
         if result.history:
             last = result.history[-1]
             board(f"final: valid_error={last.valid_error:.6f} "
@@ -463,12 +654,19 @@ def _write_metrics_jsonl(result, path: str) -> None:
             return None
         return v
 
+    lines = []
+    for m in result.history:
+        rec = {k: _clean(v) for k, v in dataclasses.asdict(m).items()}
+        lines.append(json.dumps(rec, allow_nan=False))
+    payload = ("\n".join(lines) + "\n") if lines else ""
     try:
-        with open(path, "w") as f:
-            for m in result.history:
-                rec = {k: _clean(v) for k, v in dataclasses.asdict(m).items()}
-                f.write(json.dumps(rec, allow_nan=False) + "\n")
-    except OSError:
+        from ..data import fsio
+        if fsio.is_remote(path):
+            fsio.write_bytes(path, payload.encode())
+        else:
+            with open(path, "w") as f:
+                f.write(payload)
+    except Exception:
         pass  # metrics sink is best-effort; the board already has the lines
 
 
@@ -726,17 +924,33 @@ def run_eval(args) -> int:
 def _export_and_pack(params, job, out_dir, console) -> str:
     """The one export sequence (artifact + best-effort native pack) shared
     by the train tail and the export recovery command — divergence here
-    would give the recovery path different artifacts than training."""
+    would give the recovery path different artifacts than training.
+
+    A remote (gs:// hdfs://) destination builds the artifact in a local
+    temp dir (the exporters and the native pack write real files) and
+    uploads it through fsio — the reference likewise exported to
+    FINAL_MODEL_PATH on HDFS (ssgd_monitor.py:302-345)."""
+    from ..data import fsio
     from ..export import save_artifact
     from ..train import make_forward_fn
 
-    export_dir = save_artifact(params, job, out_dir,
+    remote = fsio.is_remote(out_dir)
+    local_dir = out_dir
+    if remote:
+        import tempfile
+        local_dir = tempfile.mkdtemp(prefix="shifu_tpu_export_")
+    export_dir = save_artifact(params, job, local_dir,
                                forward_fn=make_forward_fn(job))
     try:
         from ..runtime import pack_native
         pack_native(export_dir)
     except Exception as e:  # native pack is best-effort
         console(f"native pack skipped: {e}")
+    if remote:
+        import shutil
+        fsio.upload_dir(export_dir, out_dir)
+        shutil.rmtree(local_dir, ignore_errors=True)
+        export_dir = out_dir
     console(f"model exported to {export_dir}")
     return export_dir
 
@@ -782,19 +996,37 @@ def run_export(args) -> int:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     _apply_platform_env()
-    # repeat compiles (supervisor restart attempts, re-runs of the same job)
-    # deserialize from the persistent cache instead of recompiling
-    from ..utils.compilecache import enable_persistent_cache
-    enable_persistent_cache()
     args = build_parser().parse_args(argv)
+    if args.command in ("train", "score", "eval", "export"):
+        # repeat compiles (supervisor restarts, re-runs of the same job)
+        # deserialize from the persistent cache instead of recompiling.
+        # Only for commands that compile: status/attach/kill/provision are
+        # file/CLI operations and must not pay the jax import
+        from ..utils.compilecache import enable_persistent_cache
+        enable_persistent_cache()
     if args.command == "train":
-        return run_train(args)
+        rc = run_train(args)
+        # daemonized dispatcher: record the terminal state for `status`
+        from . import detach as detach_lib
+        detached_dir = os.environ.get(detach_lib.ENV_DETACHED)
+        if detached_dir and not getattr(args, "detach", False):
+            detach_lib.write_status(detached_dir, rc)
+        return rc
     if args.command == "score":
         return run_score(args)
     if args.command == "eval":
         return run_eval(args)
     if args.command == "export":
         return run_export(args)
+    if args.command == "provision":
+        return run_provision(args)
+    from . import detach as detach_lib
+    if args.command == "status":
+        return detach_lib.run_status(args.job_dir)
+    if args.command == "attach":
+        return detach_lib.attach(args.job_dir, from_start=not args.tail)
+    if args.command == "kill":
+        return detach_lib.kill(args.job_dir)
     return EXIT_FAIL
 
 

@@ -6,6 +6,14 @@ client TailThread; SURVEY.md section 5.5).  Under SPMD there is one program,
 so the board is written directly: every line goes to stdout immediately and
 is appended (flushed) to a board file that an external tail — or the
 supervisor's liveness monitor — can follow.
+
+Remote job dirs are first-class (the reference's board LIVED on HDFS,
+yarn/util/CommonUtils.java:426-458): a gs:// hdfs:// mock:// board path
+writes through data/fsio — object stores have no append, so the board
+keeps its lines in memory and rewrites the (small, per-epoch-cadence)
+object on every line — and `tail_board` polls the remote object,
+yielding only the new lines, so an operator on ANOTHER machine can follow
+a running job (TensorflowClient.java:829-841 parity).
 """
 
 from __future__ import annotations
@@ -16,13 +24,26 @@ import time
 from typing import Optional
 
 
+def _is_remote(path: Optional[str]) -> bool:
+    if not path:
+        return False
+    try:
+        from ..data import fsio
+        return fsio.is_remote(path)
+    except Exception:
+        return False
+
+
 class ConsoleBoard:
     def __init__(self, board_path: Optional[str] = None, echo: bool = True):
         self.board_path = board_path
         self.echo = echo
         self._fh = None
-        if board_path:
-            os.makedirs(os.path.dirname(os.path.abspath(board_path)), exist_ok=True)
+        self._remote = _is_remote(board_path)
+        self._lines: list[str] = []
+        if board_path and not self._remote:
+            os.makedirs(os.path.dirname(os.path.abspath(board_path)),
+                        exist_ok=True)
             self._fh = open(board_path, "a", buffering=1)
 
     def __call__(self, line: str) -> None:
@@ -32,6 +53,21 @@ class ConsoleBoard:
         if self._fh is not None:
             self._fh.write(stamped + "\n")
             self._fh.flush()
+        elif self._remote:
+            self._lines.append(stamped)
+            self._flush_remote()
+
+    def _flush_remote(self) -> None:
+        # whole-object rewrite: appends don't exist on object stores, and
+        # the board is small (one line per epoch) — best-effort, the lines
+        # already reached stdout
+        try:
+            from ..data import fsio
+            fsio.write_bytes(self.board_path,
+                             ("\n".join(self._lines) + "\n").encode())
+        except Exception as e:  # noqa: BLE001 - board is observability
+            print(f"board write failed ({e}); continuing",
+                  file=sys.stderr, flush=True)
 
     def close(self) -> None:
         if self._fh is not None:
@@ -39,10 +75,17 @@ class ConsoleBoard:
             self._fh = None
 
 
-def tail_board(board_path: str, from_start: bool = True):
+def tail_board(board_path: str, from_start: bool = True,
+               poll_seconds: float = 0.2):
     """Generator yielding board lines as they appear (the reference client's
-    TailThread, TensorflowClient.java:829-841). Stops when the file is
-    removed; callers normally run it in a thread."""
+    TailThread, TensorflowClient.java:829-841).  Local boards stream from
+    the file handle; remote (gs:// hdfs:// mock://) boards poll the object
+    through fsio and yield the delta — follow a running job from any
+    machine that can read the job dir.  Stops when the board is removed;
+    callers normally run it in a thread."""
+    if _is_remote(board_path):
+        yield from _tail_remote(board_path, from_start, poll_seconds)
+        return
     pos = 0
     while not os.path.exists(board_path):
         time.sleep(0.1)
@@ -56,4 +99,32 @@ def tail_board(board_path: str, from_start: bool = True):
             else:
                 if not os.path.exists(board_path):
                     return
-                time.sleep(0.2)
+                time.sleep(poll_seconds)
+
+
+def _tail_remote(board_path: str, from_start: bool, poll_seconds: float):
+    from ..data import fsio
+
+    seen = 0
+    first = True
+    missing_grace = True
+    while True:
+        try:
+            text = fsio.read_bytes(board_path).decode("utf-8", "replace")
+            missing_grace = False
+        except FileNotFoundError:
+            if missing_grace:  # not yet written: keep waiting for the job
+                time.sleep(poll_seconds)
+                continue
+            return  # existed once, now gone: the board was removed
+        except Exception:
+            time.sleep(poll_seconds)
+            continue
+        lines = text.splitlines()
+        if first and not from_start:
+            seen = len(lines)
+        first = False
+        for line in lines[seen:]:
+            yield line
+        seen = max(seen, len(lines))
+        time.sleep(poll_seconds)

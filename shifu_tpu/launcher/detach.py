@@ -1,0 +1,217 @@
+"""Detached job submission: jobs that survive the submitting client.
+
+A reference job ran under YARN and outlived its client — the client merely
+polled the application report every 10s and tailed the progress log
+(yarn/client/TensorflowClient.java:625-658,829-841); an operator could
+disconnect and come back.  The pod/ssh gang here is deliberately tethered
+to its dispatcher (parent death tears the gang down), so `train --detach`
+re-launches the dispatcher as a session-leader daemon whose stdout goes to
+`<job>/supervisor.log`, records `<job>/job.json`, and returns immediately;
+the daemon writes `<job>/job.status` when the job ends.  `status`,
+`attach`, and `kill` drive the job from its directory afterwards — the
+poll/tail/kill surface the reference client had.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional, Sequence
+
+# marks the daemonized dispatcher so run_train records its final status
+ENV_DETACHED = "SHIFU_TPU_DETACHED_JOB_DIR"
+
+JOB_FILE = "job.json"
+STATUS_FILE = "job.status"
+LOG_FILE = "supervisor.log"
+BOARD_FILE = "console.board"
+
+
+def submit(child_argv: Sequence[str], out_dir: str, echo=print) -> int:
+    """Launch `python -m shifu_tpu.launcher.cli <child_argv>` as a detached
+    session leader and return immediately (exit 0 = submitted)."""
+    try:
+        from ..data import fsio
+        if fsio.is_remote(out_dir):
+            echo("--detach needs a LOCAL job dir (job.json/pid live beside "
+                 "the daemon); use a local --output whose board/checkpoint "
+                 "paths may still be remote", )
+            return 1
+    except Exception:
+        pass
+    os.makedirs(out_dir, exist_ok=True)
+    log_path = os.path.join(out_dir, LOG_FILE)
+    env = dict(os.environ)
+    env[ENV_DETACHED] = out_dir
+    with open(log_path, "ab") as log:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "shifu_tpu.launcher.cli", *child_argv],
+            stdout=log, stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+            start_new_session=True,  # survives the client's session/terminal
+            env=env, cwd=os.getcwd())
+    with open(os.path.join(out_dir, JOB_FILE), "w") as f:
+        json.dump({"pid": proc.pid, "argv": list(child_argv),
+                   "submitted_at": time.time(),
+                   "host": os.uname().nodename}, f)
+    echo(f"submitted: pid {proc.pid}, job dir {out_dir}")
+    echo(f"  follow:  shifu-tpu attach {out_dir}")
+    echo(f"  status:  shifu-tpu status {out_dir}")
+    echo(f"  stop:    shifu-tpu kill {out_dir}")
+    return 0
+
+
+def write_status(out_dir: str, exit_code: int) -> None:
+    """Called by the daemonized dispatcher when the job ends (job.status is
+    the 'application report' a later `status` reads).
+
+    Guarded by pid: ENV_DETACHED inherits into the dispatcher's whole tree
+    (supervisor attempts, gang ranks), and a worker exiting mid-restart
+    must not record ITS code as the job's terminal state — only the
+    process `submit` recorded may write."""
+    job = _read_json(os.path.join(out_dir, JOB_FILE))
+    if not job or job.get("pid") != os.getpid():
+        return
+    try:
+        with open(os.path.join(out_dir, STATUS_FILE), "w") as f:
+            json.dump({"exit": int(exit_code), "finished_at": time.time()}, f)
+    except OSError:
+        pass
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def job_state(out_dir: str) -> dict:
+    """One dict describing the job: RUNNING / FINISHED(exit) / FAILED /
+    UNKNOWN, plus the last board line when there is one."""
+    job = _read_json(os.path.join(out_dir, JOB_FILE))
+    status = _read_json(os.path.join(out_dir, STATUS_FILE))
+    out: dict = {"job_dir": out_dir}
+    if job:
+        out.update(pid=job.get("pid"), submitted_at=job.get("submitted_at"))
+    if status is not None:
+        rc = int(status.get("exit", 1))
+        out.update(state="FINISHED" if rc == 0 else "FAILED", exit=rc,
+                   finished_at=status.get("finished_at"))
+    elif job and isinstance(job.get("pid"), int) and _alive(job["pid"]):
+        out["state"] = "RUNNING"
+    elif job:
+        # pid gone with no status file: the daemon was killed uncleanly
+        out.update(state="DEAD", exit=None)
+    else:
+        out["state"] = "UNKNOWN"
+    board = os.path.join(out_dir, BOARD_FILE)
+    try:
+        with open(board) as f:
+            lines = f.read().splitlines()
+        if lines:
+            out["last_progress"] = lines[-1]
+    except OSError:
+        pass
+    return out
+
+
+def run_status(out_dir: str, echo=print) -> int:
+    st = job_state(out_dir)
+    echo(json.dumps(st))
+    if st["state"] == "UNKNOWN":
+        return 1
+    return 0
+
+
+def attach(out_dir: str, echo=print, poll_seconds: float = 0.5,
+           from_start: bool = True) -> int:
+    """Follow the job's console board until it finishes — the reference
+    client's TailThread over the HDFS progress file
+    (TensorflowClient.java:829-841).  Returns the job's exit code."""
+    try:
+        from ..data import fsio
+        if fsio.is_remote(out_dir):
+            # remote job dir: follow the board object from ANY machine that
+            # can read it (no local pid/status to consult — ^C to stop)
+            from .console import tail_board
+            for line in tail_board(fsio.join(out_dir, BOARD_FILE),
+                                   from_start=from_start):
+                echo(line)
+            return 0
+    except KeyboardInterrupt:
+        return 0
+    board = os.path.join(out_dir, BOARD_FILE)
+    pos = 0
+    if not from_start and os.path.exists(board):
+        pos = os.path.getsize(board)
+    while True:
+        if os.path.exists(board):
+            with open(board) as f:
+                f.seek(pos)
+                chunk = f.read()
+                pos = f.tell()
+            for line in chunk.splitlines():
+                echo(line)
+        st = job_state(out_dir)
+        if st["state"] in ("FINISHED", "FAILED"):
+            # drain anything written between the read and the status check
+            if os.path.exists(board):
+                with open(board) as f:
+                    f.seek(pos)
+                    for line in f.read().splitlines():
+                        echo(line)
+            echo(f"job {st['state'].lower()} (exit {st.get('exit')})")
+            return int(st.get("exit") or 0)
+        if st["state"] in ("DEAD", "UNKNOWN"):
+            echo(f"job state: {st['state']}")
+            return 1
+        time.sleep(poll_seconds)
+
+
+def kill(out_dir: str, echo=print, grace_seconds: float = 10.0) -> int:
+    """SIGTERM the detached dispatcher's process group (it is a session
+    leader, so the whole supervisor->gang tree drains), escalating to
+    SIGKILL; the client-side 'kill application' the reference had."""
+    job = _read_json(os.path.join(out_dir, JOB_FILE))
+    if not job or not isinstance(job.get("pid"), int):
+        echo(f"no submitted job under {out_dir}")
+        return 1
+    pid = job["pid"]
+    if not _alive(pid):
+        echo(f"job pid {pid} is not running")
+        return 0
+    try:
+        os.killpg(pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            pass
+    deadline = time.monotonic() + grace_seconds
+    while time.monotonic() < deadline:
+        if not _alive(pid):
+            echo(f"job pid {pid} terminated")
+            return 0
+        time.sleep(0.2)
+    try:
+        os.killpg(pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        pass
+    echo(f"job pid {pid} killed")
+    return 0

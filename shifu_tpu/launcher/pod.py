@@ -49,6 +49,10 @@ from typing import Optional, Sequence
 ENV_HOSTS = "SHIFU_TPU_HOSTS"
 ENV_COORDINATOR_PORT = "SHIFU_TPU_COORDINATOR_PORT"
 DEFAULT_COORDINATOR_PORT = 8476
+# per-host reconnects for ssh rc=255 with NO output yet (connect-level
+# failure — host booting, transient network); a host that produced output
+# and then died is a worker failure, handled by gang restart instead
+SSH_CONNECT_RETRIES = 3
 
 
 @dataclass(frozen=True)
@@ -143,8 +147,20 @@ def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
     treats that as terminal)."""
     from .supervisor import EXIT_TIMEOUT
     n = len(spec.hosts)
-    log_dir = os.path.join(out_dir, "logs")
-    os.makedirs(log_dir, exist_ok=True)
+    try:
+        from ..data import fsio
+        remote_out = fsio.is_remote(out_dir)
+    except Exception:
+        remote_out = False
+    if remote_out:
+        # per-host log PIPES are local files; a remote job dir keeps its
+        # board/metrics/checkpoints remote while the dispatcher's raw host
+        # logs live beside it on the dispatching machine
+        import tempfile
+        log_dir = tempfile.mkdtemp(prefix="shifu_tpu_pod_logs_")
+    else:
+        log_dir = os.path.join(out_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
     if spec.transport == "local":
         coordinator = f"127.0.0.1:{_free_port()}"
     else:
@@ -157,10 +173,19 @@ def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
     # output counts as gang progress for the liveness monitor (epoch lines
     # come from rank 0; other ranks are quiet when healthy)
     progress = [time.monotonic()] * n
+    ssh_retries = [0] * n
     lock = threading.Lock()
 
-    def pump(rank: int, proc: subprocess.Popen, log_path: str) -> None:
-        with open(log_path, "w") as log:
+    def _contract(rank: int) -> dict[str, str]:
+        return {
+            "SHIFU_TPU_COORDINATOR": coordinator,
+            "SHIFU_TPU_NUM_PROCESSES": str(n),
+            "SHIFU_TPU_PROCESS_ID": str(rank),
+        }
+
+    def pump(rank: int, proc: subprocess.Popen, log_path: str,
+             mode: str = "w") -> None:
+        with open(log_path, mode) as log:
             for line in proc.stdout:  # text mode; closes on child exit
                 log.write(line)
                 log.flush()
@@ -169,22 +194,22 @@ def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
                 if rank == 0:
                     echo(line.rstrip("\n"))
 
-    for rank in range(n):
-        env_contract = {
-            "SHIFU_TPU_COORDINATOR": coordinator,
-            "SHIFU_TPU_NUM_PROCESSES": str(n),
-            "SHIFU_TPU_PROCESS_ID": str(rank),
-        }
-        argv, env = _host_command(spec, rank, child_args, env_contract)
-        log_path = os.path.join(log_dir, f"host-{rank}.attempt-{attempt}.log")
-        log_paths.append(log_path)
+    def dispatch(rank: int, mode: str = "w") -> None:
+        argv, env = _host_command(spec, rank, child_args, _contract(rank))
         proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
                                 stderr=subprocess.STDOUT, text=True)
-        procs.append(proc)
-        t = threading.Thread(target=pump, args=(rank, proc, log_path),
+        procs[rank] = proc
+        t = threading.Thread(target=pump,
+                             args=(rank, proc, log_paths[rank], mode),
                              daemon=True)
         t.start()
         threads.append(t)
+
+    for rank in range(n):
+        log_paths.append(
+            os.path.join(log_dir, f"host-{rank}.attempt-{attempt}.log"))
+        procs.append(None)  # type: ignore[arg-type]
+        dispatch(rank)
 
     echo(f"pod: attempt {attempt}: {n} processes "
          f"({spec.transport}), coordinator {coordinator}, "
@@ -197,6 +222,23 @@ def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
             for rank in sorted(remaining):
                 rc = procs[rank].poll()
                 if rc is None:
+                    continue
+                if (rc == 255 and spec.transport == "ssh"
+                        and ssh_retries[rank] < SSH_CONNECT_RETRIES):
+                    # rc=255 is the ssh CLIENT's own exit code — a
+                    # transport-level failure, not a child exit: retry THIS
+                    # host with backoff.  A pre-rendezvous connect failure
+                    # (host booting, flaky network) reconnects cleanly; a
+                    # mid-run drop killed the remote worker (-tt HUP), the
+                    # re-join then fails fast and the gang restarts under
+                    # supervise_pod's TRANSPORT budget — either way the
+                    # training restart budget is never charged
+                    ssh_retries[rank] += 1
+                    echo(f"pod: host {rank} ({spec.hosts[rank]}) ssh "
+                         f"connect failed (rc=255) — reconnect "
+                         f"{ssh_retries[rank]}/{SSH_CONNECT_RETRIES}")
+                    time.sleep(min(2.0 * ssh_retries[rank], 10.0))
+                    dispatch(rank, mode="a")
                     continue
                 remaining.discard(rank)
                 if rc != 0:
@@ -263,6 +305,7 @@ def supervise_pod(spec: PodSpec, child_args: Sequence[str], out_dir: str,
 
     attempts = 0
     failures_since_progress = 0
+    transport_failures = 0
     deadline = JobDeadline(timeout_seconds)
     while True:
         if deadline.expired():
@@ -283,6 +326,24 @@ def supervise_pod(spec: PodSpec, child_args: Sequence[str], out_dir: str,
             echo(f"pod: attempt {attempts} hit the job timeout — terminal, "
                  "no restart")
             return EXIT_TIMEOUT
+        if rc == 255 and spec.transport == "ssh":
+            # a mid-run ssh-level failure (rc=255 is the ssh client's own
+            # code) is a TRANSPORT fault, not a training crash: restart the
+            # gang on its own bounded budget so one flaky link cannot eat
+            # the failure budget meant for real crash loops.  Like the
+            # restart budget, it bounds CONSECUTIVE no-progress failures —
+            # a multi-day job's occasional link drops, each resuming
+            # further, must not accumulate to a terminal failure
+            if probe.advanced():
+                transport_failures = 0
+            transport_failures += 1
+            if transport_failures <= SSH_CONNECT_RETRIES:
+                echo(f"pod: ssh transport failure — restarting the gang "
+                     f"without charging the restart budget "
+                     f"({transport_failures}/{SSH_CONNECT_RETRIES})")
+                continue
+            echo("pod: ssh transport failure budget exhausted")
+            return 1
         failures_since_progress = charge_restart_budget(
             failures_since_progress, probe.advanced(), echo=echo, what="pod")
         echo(f"pod: attempt {attempts} failed rc={rc} after "

@@ -1,0 +1,214 @@
+"""TPU slice provisioning — the compute-acquisition layer.
+
+Successor of the reference's YARN resource acquisition: one command there
+went `yarnClient.createApplication -> submitApplication ->
+monitorApplication` (yarn/client/TensorflowClient.java:339-426) with the AM
+allocating containers (yarn/appmaster/AMRMCallbackHandler.java:148-190).
+On Cloud TPU the unit of compute is a *queued resource* — a slice request
+the TPU scheduler fulfils when capacity frees — so acquisition is:
+
+    create (queued-resources create)
+      -> await ACTIVE (describe poll; WAITING_FOR_RESOURCES is the queue)
+      -> derive worker hosts (tpu-vm describe networkEndpoints, worker order)
+      -> run the pod (launcher/pod.py dispatch over ssh)
+      -> release (queued-resources delete)
+
+Everything shells out to `gcloud` (the supported control surface; no egress
+assumptions beyond it), so tests drive the full flow against a fake gcloud
+on PATH — the same technique as the fake-ssh transport e2e.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import time
+from dataclasses import dataclass, replace
+from typing import Callable, Optional, Sequence
+
+# Hadoop-style XML keys (the shifu.* namespace, like every other subsystem)
+KEY_NAME = "shifu.provision.name"
+KEY_ACCELERATOR = "shifu.provision.accelerator-type"
+KEY_ZONE = "shifu.provision.zone"
+KEY_PROJECT = "shifu.provision.project"
+KEY_RUNTIME_VERSION = "shifu.provision.runtime-version"
+KEY_SPOT = "shifu.provision.spot"
+KEY_TIMEOUT = "shifu.provision.ready-timeout-seconds"
+
+# states a queued resource moves through (queued-resources describe)
+_READY_STATES = ("ACTIVE",)
+_PENDING_STATES = ("ACCEPTED", "PROVISIONING", "WAITING_FOR_RESOURCES",
+                   "CREATING")
+_DEAD_STATES = ("FAILED", "SUSPENDED", "SUSPENDING", "DELETING")
+
+
+class ProvisionError(RuntimeError):
+    """gcloud failed or the slice cannot become ready."""
+
+
+@dataclass(frozen=True)
+class ProvisionSpec:
+    name: str
+    accelerator_type: str            # e.g. v5litepod-16
+    zone: str                        # e.g. us-west4-a
+    project: str = ""                # "" = gcloud's configured default
+    runtime_version: str = "tpu-ubuntu2204-base"
+    spot: bool = False               # preemptible capacity
+    ready_timeout_seconds: float = 1800.0
+    poll_seconds: float = 10.0       # reference client polled every 10s
+                                     # (TensorflowClient.java:625-658)
+
+    def validate(self) -> None:
+        missing = [k for k, v in (("name", self.name),
+                                  ("accelerator-type", self.accelerator_type),
+                                  ("zone", self.zone)) if not v]
+        if missing:
+            raise ProvisionError(
+                "provisioning needs shifu.provision."
+                + "/".join(missing)
+                + " (or the matching --provision-* flags)")
+
+
+def spec_from_xml(conf: dict, **overrides) -> ProvisionSpec:
+    """Build a spec from shifu.provision.* keys, overridden by kwargs
+    (CLI flags are the programmatic layer, like the reference's)."""
+    from ..utils.xmlconfig import parse_bool
+    spec = ProvisionSpec(
+        name=conf.get(KEY_NAME, ""),
+        accelerator_type=conf.get(KEY_ACCELERATOR, ""),
+        zone=conf.get(KEY_ZONE, ""),
+        project=conf.get(KEY_PROJECT, ""),
+        runtime_version=conf.get(KEY_RUNTIME_VERSION,
+                                 ProvisionSpec.runtime_version),
+        spot=parse_bool(conf.get(KEY_SPOT, False)),
+        ready_timeout_seconds=float(
+            conf.get(KEY_TIMEOUT, ProvisionSpec.ready_timeout_seconds)),
+    )
+    fields = {k: v for k, v in overrides.items() if v}
+    return replace(spec, **fields) if fields else spec
+
+
+def _gcloud_bin() -> str:
+    path = shutil.which("gcloud")
+    if not path:
+        raise ProvisionError(
+            "no `gcloud` on PATH — provisioning drives Cloud TPU queued "
+            "resources through the gcloud CLI")
+    return path
+
+
+def _run(args: Sequence[str]) -> str:
+    proc = subprocess.run([_gcloud_bin(), *args], capture_output=True,
+                          text=True)
+    if proc.returncode != 0:
+        raise ProvisionError(
+            f"gcloud {' '.join(args[:4])}... failed (rc={proc.returncode}): "
+            f"{proc.stderr.strip()[:500]}")
+    return proc.stdout
+
+
+def _common(spec: ProvisionSpec) -> list[str]:
+    out = ["--zone", spec.zone]
+    if spec.project:
+        out += ["--project", spec.project]
+    return out
+
+
+def create(spec: ProvisionSpec, echo=print) -> None:
+    """Submit the slice request (node id == queued-resource id == name)."""
+    spec.validate()
+    args = ["compute", "tpus", "queued-resources", "create", spec.name,
+            "--node-id", spec.name,
+            "--accelerator-type", spec.accelerator_type,
+            "--runtime-version", spec.runtime_version,
+            *_common(spec)]
+    if spec.spot:
+        args.append("--spot")
+    echo(f"provision: requesting {spec.accelerator_type} in {spec.zone} "
+         f"as {spec.name!r}" + (" (spot)" if spec.spot else ""))
+    _run(args)
+
+
+def state(spec: ProvisionSpec) -> str:
+    out = _run(["compute", "tpus", "queued-resources", "describe", spec.name,
+                *_common(spec), "--format", "json"])
+    doc = json.loads(out or "{}")
+    st = doc.get("state")
+    if isinstance(st, dict):  # API nests it: {"state": {"state": "ACTIVE"}}
+        st = st.get("state")
+    return str(st or "UNKNOWN").upper()
+
+
+def await_ready(spec: ProvisionSpec, echo=print) -> None:
+    """Poll until ACTIVE; raise on a dead state or timeout (the successor
+    of the client-side monitor loop, TensorflowClient.java:625-658)."""
+    deadline = time.monotonic() + spec.ready_timeout_seconds
+    last = None
+    while True:
+        st = state(spec)
+        if st != last:
+            echo(f"provision: {spec.name} is {st}")
+            last = st
+        if st in _READY_STATES:
+            return
+        if st in _DEAD_STATES:
+            raise ProvisionError(f"queued resource {spec.name} entered "
+                                 f"terminal state {st}")
+        if time.monotonic() > deadline:
+            raise ProvisionError(
+                f"queued resource {spec.name} not ready after "
+                f"{spec.ready_timeout_seconds:.0f}s (last state {st}); it "
+                "remains queued — `shifu-tpu provision delete` to release")
+        time.sleep(spec.poll_seconds)
+
+
+def worker_hosts(spec: ProvisionSpec) -> list[str]:
+    """The slice's worker IPs in WORKER ORDER — the order that defines the
+    jax.distributed process ids (launcher/pod.py dispatch)."""
+    out = _run(["compute", "tpus", "tpu-vm", "describe", spec.name,
+                *_common(spec), "--format", "json"])
+    doc = json.loads(out or "{}")
+    endpoints = doc.get("networkEndpoints") or []
+    hosts = [e.get("ipAddress", "") for e in endpoints]
+    hosts = [h for h in hosts if h]
+    if not hosts:
+        raise ProvisionError(
+            f"tpu-vm describe {spec.name} returned no networkEndpoints — "
+            "is the node ready?")
+    return hosts
+
+
+def delete(spec: ProvisionSpec, echo=print) -> None:
+    """Release the slice (idempotent best-effort: releasing twice or
+    releasing a failed create must not mask the original error)."""
+    try:
+        _run(["compute", "tpus", "queued-resources", "delete", spec.name,
+              *_common(spec), "--quiet", "--force"])
+        echo(f"provision: released {spec.name}")
+    except ProvisionError as e:
+        echo(f"provision: release of {spec.name} failed ({e}); release "
+             "manually with `gcloud compute tpus queued-resources delete`")
+
+
+def provision_and_run(spec: ProvisionSpec,
+                      run_fn: Callable[[list[str]], int],
+                      echo=print,
+                      keep: bool = False) -> int:
+    """The one-command lifecycle: nothing -> slice -> gang -> released.
+
+    `run_fn(hosts)` runs the job (the pod dispatch) once the slice is
+    ACTIVE; the slice is released on EVERY exit path unless `keep` (a
+    failed run must not leak a billing TPU — the YARN analog was the RM
+    reclaiming containers when the app died)."""
+    create(spec, echo=echo)
+    try:
+        await_ready(spec, echo=echo)
+        hosts = worker_hosts(spec)
+        echo(f"provision: {len(hosts)} worker hosts: {', '.join(hosts)}")
+        return run_fn(hosts)
+    finally:
+        if keep:
+            echo(f"provision: keeping {spec.name} (--keep-slice)")
+        else:
+            delete(spec, echo=echo)

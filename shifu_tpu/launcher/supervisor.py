@@ -183,6 +183,22 @@ def charge_restart_budget(failures_since_progress: int, progressed: bool,
     return failures_since_progress + 1
 
 
+def _board_size(path: str) -> int:
+    """Board file size for the liveness monitor, -1 when missing — fsio for
+    remote (gs:// hdfs://) job dirs, os.stat locally."""
+    try:
+        from ..data import fsio
+        if fsio.is_remote(path):
+            size, _ = fsio.file_info(path)
+            return -1 if size is None else int(size)
+    except Exception:
+        return -1
+    try:
+        return os.path.getsize(path)
+    except OSError:
+        return -1
+
+
 class JobDeadline:
     """ONE clock for the whole job, shared across attempts — the semantic
     core of the timeout-is-terminal fix, defined once for both supervisors
@@ -321,8 +337,7 @@ def supervise(child_argv: Sequence[str],
                         # (a stuck distributed rendezvous, a hung kinit) must
                         # be detected too — the window therefore has to cover
                         # startup (jax import + first compile) plus an epoch
-                        size = (os.path.getsize(board_path)
-                                if os.path.exists(board_path) else -1)
+                        size = _board_size(board_path)
                         if size != last_size:
                             last_size = size
                             last_progress = time.monotonic()

@@ -128,9 +128,9 @@ def layer_configs(*dicts: Mapping[str, str]) -> dict[str, str]:
     return merged
 
 
-def write_configuration_xml(config: Mapping[str, str], path: str) -> None:
-    """Serialize the merged config (the `global-final.xml` the reference wrote
-    and localized into every container, TensorflowClient.java:389-403)."""
+def configuration_xml_bytes(config: Mapping[str, str]) -> bytes:
+    """The serialized XML as bytes — for remote (fsio) job dirs."""
+    import io
     root = ET.Element("configuration")
     for name in sorted(config):
         prop = ET.SubElement(root, "property")
@@ -138,7 +138,16 @@ def write_configuration_xml(config: Mapping[str, str], path: str) -> None:
         ET.SubElement(prop, "value").text = str(config[name])
     tree = ET.ElementTree(root)
     ET.indent(tree)
-    tree.write(path, encoding="unicode", xml_declaration=True)
+    buf = io.BytesIO()
+    tree.write(buf, encoding="utf-8", xml_declaration=True)
+    return buf.getvalue()
+
+
+def write_configuration_xml(config: Mapping[str, str], path: str) -> None:
+    """Serialize the merged config (the `global-final.xml` the reference wrote
+    and localized into every container, TensorflowClient.java:389-403)."""
+    with open(path, "wb") as f:
+        f.write(configuration_xml_bytes(config))
 
 
 def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:

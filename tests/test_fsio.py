@@ -122,6 +122,99 @@ def mock_fs(tmp_path):
         fsio._fs_cache.pop(("mock", ""), None)
 
 
+def test_remote_board_write_and_tail(mock_fs):
+    """The board round-trip on a remote job dir (VERDICT r2 missing #3):
+    ConsoleBoard rewrites the object through fsio; tail_board follows it
+    from a reader that shares nothing but the URI, seeing lines written
+    AFTER the tail started; removal ends the tail."""
+    import threading
+    import time as time_mod
+
+    from shifu_tpu.launcher.console import ConsoleBoard, tail_board
+
+    filesystem, root, _ = mock_fs
+    board_uri = "mock://bucket/job/console.board"
+    board = ConsoleBoard(board_uri, echo=False)
+    board("Epoch 0: train_error=0.5")
+
+    got: list[str] = []
+    done = threading.Event()
+
+    def reader():
+        for line in tail_board(board_uri, poll_seconds=0.05):
+            got.append(line)
+        done.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    deadline = time_mod.monotonic() + 10
+    while not got and time_mod.monotonic() < deadline:
+        time_mod.sleep(0.05)
+    assert any("Epoch 0" in l for l in got)
+    board("Epoch 1: train_error=0.4")  # written AFTER the tail began
+    deadline = time_mod.monotonic() + 10
+    while len(got) < 2 and time_mod.monotonic() < deadline:
+        time_mod.sleep(0.05)
+    assert any("Epoch 1" in l for l in got), got
+    filesystem.delete_file("bucket/job/console.board")
+    assert done.wait(10), "tail did not stop when the board was removed"
+
+
+def test_train_cli_remote_job_dir(mock_fs, tmp_path):
+    """`train --output mock://...` end to end in-process: configs, board,
+    metrics, and the exported artifact all land on the remote job dir via
+    fsio (checkpoints stay local via the tmp-model-path key — orbax has its
+    own remote story)."""
+    import json as json_lib
+
+    from shifu_tpu.data import fsio as fsio_mod
+    from shifu_tpu.data import synthetic
+    from shifu_tpu.launcher import cli
+    from shifu_tpu.utils import xmlconfig
+
+    filesystem, _, _ = mock_fs
+    mc = {"dataSet": {"targetColumnName": "target"},
+          "train": {"validSetRate": 0.2, "numTrainEpochs": 1,
+                    "algorithm": "NN",
+                    "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                               "ActivationFunc": ["relu"],
+                               "LearningRate": 0.01, "Optimizer": "adam"}}}
+    cols = [{"columnNum": 0, "columnName": "target", "columnFlag": "Target"}]
+    cols += [{"columnNum": i, "columnName": f"f{i}", "columnType": "N",
+              "finalSelect": True} for i in range(1, 9)]
+    (tmp_path / "ModelConfig.json").write_text(json_lib.dumps(mc))
+    (tmp_path / "ColumnConfig.json").write_text(json_lib.dumps(cols))
+    schema = synthetic.make_schema(num_features=8)
+    rows = synthetic.make_rows(600, schema, seed=6, noise=0.3)
+    synthetic.write_files(rows, str(tmp_path / "data"), num_files=2)
+    gconf = tmp_path / "global.xml"
+    xmlconfig.write_configuration_xml(
+        {"shifu.application.tmp-model-path": str(tmp_path / "ckpt")},
+        str(gconf))
+
+    out = "mock://bucket/jobdir"
+    rc = cli.main(["train",
+                   "--modelconfig", str(tmp_path / "ModelConfig.json"),
+                   "--columnconfig", str(tmp_path / "ColumnConfig.json"),
+                   "--data", str(tmp_path / "data"),
+                   "--globalconfig", str(gconf),
+                   "--output", out])
+    assert rc == 0
+    board = fsio_mod.read_bytes(out + "/console.board").decode()
+    assert "Epoch 0:" in board and "model exported" in board
+    metrics = fsio_mod.read_bytes(out + "/metrics.jsonl").decode()
+    assert json_lib.loads(metrics.splitlines()[0])["epoch"] == 0
+    assert b"shifu.application" in fsio_mod.read_bytes(
+        out + "/global-final.xml")
+    job_doc = json_lib.loads(fsio_mod.read_bytes(out + "/job-config.json"))
+    assert job_doc["train"]["epochs"] == 1
+    # the exported artifact was built locally and uploaded through fsio
+    sidecar = json_lib.loads(fsio_mod.read_bytes(
+        out + "/final_model/GenericModelConfig.json"))
+    assert fsio_mod.read_bytes(out + "/final_model/weights.npz")[:2] == b"PK"
+    assert fsio_mod.read_bytes(out + "/ModelConfig.json")
+
+
 def test_remote_committed_step_epoch_probe(mock_fs):
     """The supervisors' durable-progress probe reads the newest COMMITTED
     orbax step's own epoch on remote checkpoint dirs too — an async save

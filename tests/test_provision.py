@@ -1,0 +1,201 @@
+"""TPU slice provisioning tests — the compute-acquisition layer driven
+end-to-end against a fake `gcloud` on PATH (the same technique as the
+fake-ssh transport e2e), per the reference's one-command acquisition
+(yarn/client/TensorflowClient.java:339-426)."""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_FAKE_GCLOUD = f"""#!{sys.executable}
+import json, os, sys
+args = sys.argv[1:]
+with open(os.environ["FAKE_GCLOUD_LOG"], "a") as f:
+    f.write(json.dumps(args) + chr(10))
+cmd = " ".join(args)
+if "queued-resources create" in cmd:
+    sys.exit(0)
+if "queued-resources describe" in cmd:
+    sf = os.environ["FAKE_GCLOUD_STATE"]
+    n = int(open(sf).read()) if os.path.exists(sf) else 0
+    open(sf, "w").write(str(n + 1))
+    states = os.environ.get("FAKE_GCLOUD_STATES", "ACTIVE").split(",")
+    state = states[min(n, len(states) - 1)]
+    print(json.dumps({{"state": {{"state": state}}}}))
+    sys.exit(0)
+if "tpu-vm describe" in cmd:
+    print(json.dumps({{"networkEndpoints": [
+        {{"ipAddress": "localhost"}}, {{"ipAddress": "localhost"}}]}}))
+    sys.exit(0)
+if "queued-resources delete" in cmd:
+    sys.exit(0)
+sys.exit(64)
+"""
+
+
+@pytest.fixture
+def fake_gcloud(tmp_path, monkeypatch):
+    fake_bin = tmp_path / "bin"
+    fake_bin.mkdir()
+    (fake_bin / "gcloud").write_text(_FAKE_GCLOUD)
+    (fake_bin / "gcloud").chmod(0o755)
+    log = tmp_path / "gcloud.log"
+    monkeypatch.setenv("PATH", f"{fake_bin}{os.pathsep}{os.environ['PATH']}")
+    monkeypatch.setenv("FAKE_GCLOUD_LOG", str(log))
+    monkeypatch.setenv("FAKE_GCLOUD_STATE", str(tmp_path / "gcloud.state"))
+    return fake_bin, log
+
+
+def _calls(log):
+    if not log.exists():
+        return []
+    return [json.loads(l) for l in log.read_text().splitlines()]
+
+
+def test_spec_from_xml_and_flags():
+    from shifu_tpu.launcher.provision import (ProvisionError, ProvisionSpec,
+                                              spec_from_xml)
+
+    conf = {"shifu.provision.name": "shifu-job",
+            "shifu.provision.accelerator-type": "v5litepod-16",
+            "shifu.provision.zone": "us-west4-a",
+            "shifu.provision.spot": "true",
+            "shifu.provision.ready-timeout-seconds": "600"}
+    spec = spec_from_xml(conf)
+    assert spec.name == "shifu-job"
+    assert spec.accelerator_type == "v5litepod-16"
+    assert spec.spot is True
+    assert spec.ready_timeout_seconds == 600.0
+    # CLI flags override the XML layer
+    spec2 = spec_from_xml(conf, zone="europe-west4-b", name="other")
+    assert spec2.zone == "europe-west4-b" and spec2.name == "other"
+    with pytest.raises(ProvisionError, match="accelerator-type"):
+        ProvisionSpec(name="x", accelerator_type="", zone="z").validate()
+
+
+def test_provision_lifecycle_argv(fake_gcloud):
+    """create -> await -> hosts -> delete issue the exact gcloud surface."""
+    from shifu_tpu.launcher import provision as prov
+
+    _, log = fake_gcloud
+    spec = prov.ProvisionSpec(name="s1", accelerator_type="v5litepod-8",
+                              zone="us-west4-a", spot=True,
+                              poll_seconds=0.01)
+    prov.create(spec, echo=lambda s: None)
+    prov.await_ready(spec, echo=lambda s: None)
+    assert prov.worker_hosts(spec) == ["localhost", "localhost"]
+    prov.delete(spec, echo=lambda s: None)
+    calls = _calls(log)
+    assert calls[0][:5] == ["compute", "tpus", "queued-resources", "create",
+                            "s1"]
+    assert "--spot" in calls[0] and "--node-id" in calls[0]
+    assert ["compute", "tpus", "tpu-vm", "describe", "s1"] == calls[-2][:5]
+    assert calls[-1][:5] == ["compute", "tpus", "queued-resources", "delete",
+                             "s1"]
+
+
+def test_await_ready_waits_through_queue_and_rejects_dead(fake_gcloud,
+                                                          monkeypatch):
+    from shifu_tpu.launcher import provision as prov
+
+    spec = prov.ProvisionSpec(name="s2", accelerator_type="a", zone="z",
+                              poll_seconds=0.01)
+    monkeypatch.setenv("FAKE_GCLOUD_STATES",
+                       "ACCEPTED,WAITING_FOR_RESOURCES,ACTIVE")
+    seen = []
+    prov.await_ready(spec, echo=seen.append)
+    assert any("WAITING_FOR_RESOURCES" in s for s in seen)
+    assert any("ACTIVE" in s for s in seen)
+
+    monkeypatch.setenv("FAKE_GCLOUD_STATES", "FAILED")
+    monkeypatch.setenv("FAKE_GCLOUD_STATE",
+                       os.environ["FAKE_GCLOUD_STATE"] + ".none")
+    with pytest.raises(prov.ProvisionError, match="FAILED"):
+        prov.await_ready(prov.ProvisionSpec(
+            name="s3", accelerator_type="a", zone="z", poll_seconds=0.01))
+
+
+def test_provision_and_run_releases_on_failure(fake_gcloud):
+    from shifu_tpu.launcher import provision as prov
+
+    _, log = fake_gcloud
+    spec = prov.ProvisionSpec(name="s4", accelerator_type="a", zone="z",
+                              poll_seconds=0.01)
+    with pytest.raises(RuntimeError, match="boom"):
+        prov.provision_and_run(spec, lambda hosts: (_ for _ in ()).throw(
+            RuntimeError("boom")), echo=lambda s: None)
+    # the slice was still released — a failed job must not leak a TPU
+    assert _calls(log)[-1][:4] == ["compute", "tpus", "queued-resources",
+                                   "delete"]
+
+
+@pytest.mark.slow
+def test_train_provision_end_to_end(tmp_path):
+    """One command, nothing -> slice -> gang -> released: `train
+    --provision` against a fake gcloud (slice lifecycle) + fake ssh
+    (dispatch onto the 'provisioned' hosts), trained artifact out, slice
+    deleted afterward."""
+    from shifu_tpu.data import synthetic
+
+    fake_bin = tmp_path / "bin"
+    fake_bin.mkdir()
+    (fake_bin / "gcloud").write_text(_FAKE_GCLOUD)
+    (fake_bin / "gcloud").chmod(0o755)
+    (fake_bin / "ssh").write_text(
+        "#!/bin/sh\n"
+        "[ \"$1\" = -tt ] || { echo 'missing -tt' >&2; exit 64; }\n"
+        "shift\n"
+        "[ \"$1\" = -o ] && shift 2\n"
+        "host=\"$1\"; shift\n"
+        "exec sh -c \"$*\"\n")
+    (fake_bin / "ssh").chmod(0o755)
+
+    mc = {"dataSet": {"targetColumnName": "target"},
+          "train": {"validSetRate": 0.2, "numTrainEpochs": 2,
+                    "algorithm": "NN",
+                    "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                               "ActivationFunc": ["relu"],
+                               "LearningRate": 0.01, "Optimizer": "adam"}}}
+    cols = [{"columnNum": 0, "columnName": "target", "columnFlag": "Target"}]
+    cols += [{"columnNum": i, "columnName": f"f{i}", "columnType": "N",
+              "finalSelect": True} for i in range(1, 9)]
+    (tmp_path / "ModelConfig.json").write_text(json.dumps(mc))
+    (tmp_path / "ColumnConfig.json").write_text(json.dumps(cols))
+    schema = synthetic.make_schema(num_features=8)
+    rows = synthetic.make_rows(800, schema, seed=6, noise=0.3)
+    synthetic.write_files(rows, str(tmp_path / "data"), num_files=2)
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env.update({"SHIFU_TPU_PLATFORM": "cpu", "SHIFU_TPU_CPU_DEVICES": "1",
+                "PATH": f"{fake_bin}{os.pathsep}{env.get('PATH', '')}",
+                "FAKE_GCLOUD_LOG": str(tmp_path / "gcloud.log"),
+                "FAKE_GCLOUD_STATE": str(tmp_path / "gcloud.state"),
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", "")})
+    out = tmp_path / "job"
+    r = subprocess.run(
+        [sys.executable, "-m", "shifu_tpu.launcher.cli", "train",
+         "--modelconfig", str(tmp_path / "ModelConfig.json"),
+         "--columnconfig", str(tmp_path / "ColumnConfig.json"),
+         "--data", str(tmp_path / "data"),
+         "--output", str(out),
+         "--provision", "--provision-name", "shifu-e2e",
+         "--accelerator-type", "v5litepod-8", "--zone", "us-west4-a"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "provision: requesting v5litepod-8" in r.stdout
+    assert "ACTIVE" in r.stdout
+    assert "2 worker hosts" in r.stdout
+    assert "provision: released shifu-e2e" in r.stdout
+    for f in ("GenericModelConfig.json", "weights.npz"):
+        assert (out / "final_model" / f).exists(), f
+    calls = [json.loads(l)
+             for l in (tmp_path / "gcloud.log").read_text().splitlines()]
+    assert calls[0][3] == "create" and calls[-1][3] == "delete"
