@@ -135,9 +135,12 @@ def _ladder_extras(mesh, n_chips: int, peak_tflops) -> dict:
         ("moe_mlp", ModelSpec(model_type="moe_mlp", hidden_nodes=(100, 100),
                               activations=("relu", "relu"), num_experts=8,
                               compute_dtype="bfloat16"), 32768, 32),
+        # batch 8192: the batch-in-lanes small-token attention kernel
+        # (ops/pallas_small_attention.py) peaks there on a v5e (~334k vs
+        # 131k samples/s/chip with the classic XLA path at any batch)
         ("ft_transformer", ModelSpec(model_type="ft_transformer", token_dim=64,
                                      num_layers=3, num_attention_heads=8,
-                                     compute_dtype="bfloat16"), 4096, 16),
+                                     compute_dtype="bfloat16"), 8192, 16),
     ]
     out = {}
     rng = np.random.default_rng(7)

@@ -44,9 +44,7 @@ def strip_literals(src: str, path: str) -> str:
             i += 1
         elif src.startswith("//", i):
             j = src.find("\n", i)
-            i = n if j < 0 else j
-            out.append(" " * (i - len("".join(out))) if False else "")
-            # keep column alignment irrelevant; just skip
+            i = n if j < 0 else j  # skip to end of line (newline kept)
         elif src.startswith("/*", i):
             j = src.find("*/", i + 2)
             if j < 0:

@@ -211,20 +211,39 @@ def write_bytes(path: str, data: bytes) -> None:
     _retry_transient(op, _classifier(filesystem, fs_path, path))
 
 
-def upload_dir(local_dir: str, remote_dir: str) -> list[str]:
-    """Upload every file under local_dir to remote_dir (flat recursion,
-    relative layout preserved); returns the remote paths written.  Used to
-    ship locally-built artifacts (export dir, native pack) to a remote job
-    dir."""
+def upload_dir(local_dir: str, remote_dir: str,
+               chunk_bytes: int = 8 << 20) -> list[str]:
+    """Upload every file under local_dir to remote_dir (recursive, relative
+    layout preserved); returns the remote paths written.  Streams in
+    fixed-size chunks — a multi-GB weights file must not be materialized
+    in host RAM.  Used to ship locally-built artifacts (export dir, native
+    pack) to a remote job dir."""
     out: list[str] = []
     base = remote_dir.rstrip("/")
     for root, _dirs, files in os.walk(local_dir):
         rel_root = os.path.relpath(root, local_dir)
         for name in sorted(files):
             rel = name if rel_root == "." else f"{rel_root}/{name}"
-            with open(os.path.join(root, name), "rb") as f:
-                write_bytes(f"{base}/{rel}", f.read())
-            out.append(f"{base}/{rel}")
+            target = f"{base}/{rel}"
+            filesystem, fs_path = _filesystem(target)
+
+            def op() -> None:
+                parent = fs_path.rsplit("/", 1)[0]
+                if parent and parent != fs_path:
+                    try:  # object stores have no dirs; hdfs-style need them
+                        filesystem.create_dir(parent, recursive=True)
+                    except Exception:
+                        pass
+                with open(os.path.join(root, name), "rb") as src, \
+                        filesystem.open_output_stream(fs_path) as dst:
+                    while True:
+                        chunk = src.read(chunk_bytes)
+                        if not chunk:
+                            break
+                        dst.write(chunk)
+
+            _retry_transient(op, _classifier(filesystem, fs_path, target))
+            out.append(target)
     return out
 
 

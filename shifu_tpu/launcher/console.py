@@ -120,7 +120,11 @@ def _tail_remote(board_path: str, from_start: bool, poll_seconds: float):
         except Exception:
             time.sleep(poll_seconds)
             continue
-        lines = text.splitlines()
+        # the board rewrite is not atomic on every store: only count lines
+        # up to the last newline, so a partially-written final line is
+        # neither emitted truncated nor marked seen (it completes next poll)
+        complete = text[:text.rfind("\n") + 1]
+        lines = complete.splitlines()
         if first and not from_start:
             seen = len(lines)
         first = False

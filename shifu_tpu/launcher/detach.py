@@ -101,6 +101,21 @@ def _alive(pid: int) -> bool:
         return True
 
 
+def _is_our_job(pid: int, job: Optional[dict]) -> bool:
+    """Guard against stale/recycled pids and wrong-machine job dirs: the
+    recorded pid must belong to a shifu_tpu dispatcher ON the recording
+    host — an unclean daemon death followed by pid reuse must not make
+    `kill` SIGKILL an innocent process tree."""
+    if job and job.get("host") and job["host"] != os.uname().nodename:
+        return False
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return b"shifu_tpu" in f.read()
+    except OSError:
+        # no /proc (or no permission): fall back to pid liveness alone
+        return True
+
+
 def job_state(out_dir: str) -> dict:
     """One dict describing the job: RUNNING / FINISHED(exit) / FAILED /
     UNKNOWN, plus the last board line when there is one."""
@@ -113,7 +128,8 @@ def job_state(out_dir: str) -> dict:
         rc = int(status.get("exit", 1))
         out.update(state="FINISHED" if rc == 0 else "FAILED", exit=rc,
                    finished_at=status.get("finished_at"))
-    elif job and isinstance(job.get("pid"), int) and _alive(job["pid"]):
+    elif (job and isinstance(job.get("pid"), int) and _alive(job["pid"])
+          and _is_our_job(job["pid"], job)):
         out["state"] = "RUNNING"
     elif job:
         # pid gone with no status file: the daemon was killed uncleanly
@@ -160,28 +176,31 @@ def attach(out_dir: str, echo=print, poll_seconds: float = 0.5,
     pos = 0
     if not from_start and os.path.exists(board):
         pos = os.path.getsize(board)
-    while True:
-        if os.path.exists(board):
-            with open(board) as f:
-                f.seek(pos)
-                chunk = f.read()
-                pos = f.tell()
-            for line in chunk.splitlines():
-                echo(line)
-        st = job_state(out_dir)
-        if st["state"] in ("FINISHED", "FAILED"):
-            # drain anything written between the read and the status check
+    try:
+        while True:
             if os.path.exists(board):
                 with open(board) as f:
                     f.seek(pos)
-                    for line in f.read().splitlines():
-                        echo(line)
-            echo(f"job {st['state'].lower()} (exit {st.get('exit')})")
-            return int(st.get("exit") or 0)
-        if st["state"] in ("DEAD", "UNKNOWN"):
-            echo(f"job state: {st['state']}")
-            return 1
-        time.sleep(poll_seconds)
+                    chunk = f.read()
+                    pos = f.tell()
+                for line in chunk.splitlines():
+                    echo(line)
+            st = job_state(out_dir)
+            if st["state"] in ("FINISHED", "FAILED"):
+                # drain anything written between the read and the status
+                if os.path.exists(board):
+                    with open(board) as f:
+                        f.seek(pos)
+                        for line in f.read().splitlines():
+                            echo(line)
+                echo(f"job {st['state'].lower()} (exit {st.get('exit')})")
+                return int(st.get("exit") or 0)
+            if st["state"] in ("DEAD", "UNKNOWN"):
+                echo(f"job state: {st['state']}")
+                return 1
+            time.sleep(poll_seconds)
+    except KeyboardInterrupt:
+        return 0  # stop following; the job keeps running
 
 
 def kill(out_dir: str, echo=print, grace_seconds: float = 10.0) -> int:
@@ -196,6 +215,11 @@ def kill(out_dir: str, echo=print, grace_seconds: float = 10.0) -> int:
     if not _alive(pid):
         echo(f"job pid {pid} is not running")
         return 0
+    if not _is_our_job(pid, job):
+        echo(f"pid {pid} is not this job's dispatcher (recycled pid or a "
+             f"different host — job.json says {job.get('host')!r}); "
+             "refusing to signal it")
+        return 1
     try:
         os.killpg(pid, signal.SIGTERM)
     except (ProcessLookupError, PermissionError, OSError):

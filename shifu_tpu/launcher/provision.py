@@ -74,6 +74,13 @@ def spec_from_xml(conf: dict, **overrides) -> ProvisionSpec:
     """Build a spec from shifu.provision.* keys, overridden by kwargs
     (CLI flags are the programmatic layer, like the reference's)."""
     from ..utils.xmlconfig import parse_bool
+    raw_timeout = conf.get(KEY_TIMEOUT, ProvisionSpec.ready_timeout_seconds)
+    try:
+        timeout = float(raw_timeout)
+    except (TypeError, ValueError):
+        raise ProvisionError(
+            f"{KEY_TIMEOUT} must be a number of seconds, got "
+            f"{raw_timeout!r}") from None
     spec = ProvisionSpec(
         name=conf.get(KEY_NAME, ""),
         accelerator_type=conf.get(KEY_ACCELERATOR, ""),
@@ -82,8 +89,7 @@ def spec_from_xml(conf: dict, **overrides) -> ProvisionSpec:
         runtime_version=conf.get(KEY_RUNTIME_VERSION,
                                  ProvisionSpec.runtime_version),
         spot=parse_bool(conf.get(KEY_SPOT, False)),
-        ready_timeout_seconds=float(
-            conf.get(KEY_TIMEOUT, ProvisionSpec.ready_timeout_seconds)),
+        ready_timeout_seconds=timeout,
     )
     fields = {k: v for k, v in overrides.items() if v}
     return replace(spec, **fields) if fields else spec

@@ -6,10 +6,14 @@ a CLS token is prepended, L pre-LN transformer blocks attend over the feature
 axis, and the CLS representation feeds the `shifu_output_0` head.  New
 capability over the reference (no attention anywhere — SURVEY.md section 5.7).
 
-TPU-first notes: attention runs through ops/attention.mha (float32 softmax,
-bf16 matmuls on the MXU); with a `seq`-axis mesh the same math is available
-sequence-parallel via ops/attention.ring_attention (feature-token counts
-~10^2-10^3 fit single-chip, so the model defaults to local attention).
+TPU-first notes: local attention routes through
+ops/pallas_small_attention.small_token_attention — on TPU, small token
+counts with small head dims take the batch-in-lanes pallas kernel (no
+(S, S) score tensor in HBM, true f32 softmax; ~2.5x the XLA path on the
+bench rung), everything else the XLA reference ops/attention.mha.  With a
+`seq`-axis mesh the same math is available sequence-parallel via
+ops/attention.ring_attention (feature-token counts ~10^2-10^3 fit
+single-chip, so the model defaults to local attention).
 """
 
 from __future__ import annotations
@@ -25,8 +29,9 @@ import numpy as np
 from jax.nn import initializers as jinit
 
 from ..config.schema import ModelSpec
-from ..ops.attention import mha, ring_attention, ulysses_attention
+from ..ops.attention import ring_attention, ulysses_attention
 from ..ops.pallas_attention import flash_attention
+from ..ops.pallas_small_attention import small_token_attention
 from ..ops.initializers import xavier_uniform
 from ..parallel.mesh import PIPE_AXIS, SEQ_AXIS
 from .base import ShifuDense, dtype_of
@@ -85,7 +90,12 @@ class TransformerBlock(nn.Module):
                   else ulysses_attention)
             attn = sp(q, k, v, self.mesh)
         else:
-            attn = mha(q, k, v)
+            # auto-routes to the batch-in-lanes pallas kernel on TPU for
+            # small token counts / head dims (feature-token attention's
+            # shape), where the classic score tensor is lane-padding-bound;
+            # falls back to mha everywhere else — and the kernel is the
+            # MORE precise path (true f32 VPU vs single-pass bf16 MXU)
+            attn = small_token_attention(q, k, v)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
         attn = nn.Dense(d, kernel_init=xavier_uniform, dtype=cdt,
                         param_dtype=dtype_of(self.spec.param_dtype),
@@ -149,7 +159,7 @@ def _block_forward(p: dict, x: jax.Array, spec: ModelSpec) -> jax.Array:
     k = k.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
     attn = (flash_attention(q, k, v) if spec.attention_impl == "flash"
-            else mha(q, k, v))
+            else small_token_attention(q, k, v))
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
     attn = attn @ p["proj_kernel"].astype(cdt) + p["proj_bias"].astype(cdt)
     x = x + attn

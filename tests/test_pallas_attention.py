@@ -168,6 +168,68 @@ def test_ft_transformer_flash_forced_kernel(monkeypatch):
     assert all(np.all(np.isfinite(np.asarray(g))) for g in leaves)
 
 
+# -- batch-in-lanes small-token attention kernel ----------------------------
+
+from shifu_tpu.ops.pallas_small_attention import (  # noqa: E402
+    _run_bwd, _run_fwd, small_attention_applicable, small_token_attention)
+
+
+@pytest.mark.parametrize("s,d,h", [(31, 8, 8), (16, 8, 2), (33, 4, 4),
+                                   (64, 16, 1), (7, 2, 3)])
+def test_small_attention_forward_matches_mha(s, d, h):
+    """The lanes kernel (interpret mode) == mha for small tokens/head dims,
+    including non-sublane-aligned S (masked pad rows) and non-128 B."""
+    q, k, v = _qkv(b=37, h=h, s=s, d=d, seed=1)
+    out = _run_fwd(q, k, v, d ** -0.5, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(mha(q, k, v)),
+                               rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("s,d,h", [(31, 8, 8), (12, 4, 2)])
+def test_small_attention_gradients_match_mha(s, d, h):
+    q, k, v = _qkv(b=19, h=h, s=s, d=d, seed=2)
+    g = _qkv(b=19, h=h, s=s, d=d, seed=3)[0]
+    dq, dk, dv = _run_bwd(q, k, v, g, d ** -0.5, True)
+    ref = jax.grad(lambda a, b, c: jnp.sum(mha(a, b, c) * g),
+                   argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip((dq, dk, dv), ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_small_attention_custom_vjp_roundtrip():
+    """The public wrapper with use_pallas=True (interpret on CPU) is
+    differentiable end to end and matches mha's value+grad."""
+    q, k, v = _qkv(b=8, h=2, s=9, d=4, seed=4)
+
+    def loss(fn):
+        return jax.value_and_grad(
+            lambda a: jnp.sum(fn(a, k, v) ** 2))(q)
+
+    val_k, grad_k = loss(lambda a, b, c: small_token_attention(
+        a, b, c, use_pallas=True))
+    val_r, grad_r = loss(mha)
+    np.testing.assert_allclose(float(val_k), float(val_r), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad_k), np.asarray(grad_r),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_small_attention_gating(monkeypatch):
+    """Auto mode: CPU routes to mha (interpret would be orders slower);
+    shapes outside the small-token envelope are not applicable; the env
+    escape hatch disables."""
+    assert small_attention_applicable(31, 8)
+    assert not small_attention_applicable(128, 8)   # S too large
+    assert not small_attention_applicable(31, 64)   # D too large
+    monkeypatch.setenv("SHIFU_TPU_NO_SMALL_ATTENTION", "1")
+    assert not small_attention_applicable(31, 8)
+    monkeypatch.delenv("SHIFU_TPU_NO_SMALL_ATTENTION")
+    # on the CPU backend auto never selects the kernel
+    q, k, v = _qkv(b=4, h=2, s=8, d=4, seed=5)
+    np.testing.assert_allclose(np.asarray(small_token_attention(q, k, v)),
+                               np.asarray(mha(q, k, v)), rtol=1e-6)
+
+
 @pytest.mark.slow
 def test_flash_wide_token_axis_gradients():
     """Token counts far beyond the block size (513 = a wide table's 512
