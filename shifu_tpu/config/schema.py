@@ -411,10 +411,12 @@ class CheckpointConfig:
     directory: str = ""
     save_every_epochs: int = 1
     # time-based cadence (reference parity: Supervisor save_model_secs=10,
-    # ssgd.py:124-128): also save mid-epoch on the per-batch tier when this
-    # many seconds elapsed since the last save.  0 disables.  A mid-epoch
-    # save records the CURRENT epoch, so resume replays the interrupted
-    # epoch from its start — a bounded re-application window, the price of
+    # ssgd.py:124-128): also save mid-epoch when this many seconds elapsed
+    # since the last save — per batch on the per-batch tier, per chunk on
+    # the staged/streamed tiers (whose long out-of-HBM epochs are exactly
+    # where mid-epoch durability matters).  0 disables.  A mid-epoch save
+    # records the CURRENT epoch, so resume replays the interrupted epoch
+    # from its start — a bounded re-application window, the price of
     # mid-epoch durability (the reference's restore was equally coarse).
     save_every_seconds: int = 0
     max_to_keep: int = 3

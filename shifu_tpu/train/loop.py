@@ -700,6 +700,11 @@ def train(job: JobConfig,
                     loss_acc = (loss_sum_blk if loss_acc is None
                                 else loss_acc + loss_sum_blk)
                     timer.mark_step_done()
+                    if not multihost:
+                        # chunk boundary = consistent state: SIGTERM drain
+                        # + time-cadence saves mid-epoch (long first epochs
+                        # must not lose an hour to a preemption)
+                        maybe_midtrain_save(epoch)
                 # batches that held at least one real row (pad-only batches
                 # contribute zero loss and must not skew train_error)
                 loss_n = stream_loader.real_batches
@@ -756,6 +761,11 @@ def train(job: JobConfig,
                                 else loss_acc + loss_sum_blk)
                     loss_n += nb
                     timer.mark_step_done()
+                    if not multihost:
+                        # chunk boundary = consistent state: SIGTERM drain +
+                        # time-cadence saves for out-of-HBM epochs, whose
+                        # length is exactly why mid-epoch durability matters
+                        maybe_midtrain_save(epoch)
             else:
                 import itertools
                 host_batches = pipe.batch_iterator(

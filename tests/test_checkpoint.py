@@ -11,13 +11,16 @@ from shifu_tpu.config import CheckpointConfig, RuntimeConfig
 from shifu_tpu.train import train
 
 
-def _with_ckpt(job, directory, epochs=None, async_save=False):
-    return job.replace(
+def _with_ckpt(job, directory, epochs=None, async_save=False,
+               save_every_seconds=0, data=None):
+    out = job.replace(
         train=job.train.__class__(epochs=epochs or job.train.epochs,
                                   optimizer=job.train.optimizer),
         runtime=RuntimeConfig(checkpoint=CheckpointConfig(
-            directory=directory, save_every_epochs=1, async_save=async_save)),
+            directory=directory, save_every_epochs=1, async_save=async_save,
+            save_every_seconds=save_every_seconds)),
     )
+    return out.replace(data=data) if data is not None else out
 
 
 def test_save_and_auto_resume(tmp_path, small_job, small_data):
@@ -112,6 +115,56 @@ def test_resume_disabled(tmp_path, small_job, small_data):
     r = train(job_no_resume, train_ds, valid_ds, console=lambda s: None)
     assert r.resumed_from_epoch == 0
     assert len(r.history) == 2
+
+
+def test_staged_tier_saves_mid_epoch(tmp_path, small_job, small_data):
+    """The staged (out-of-HBM) tier hits the time-cadence save point at
+    CHUNK boundaries, not just epoch ends — its epochs are long, which is
+    exactly where mid-epoch durability matters (round-3 addition).  And
+    when the LAST chunk's cadence save lands on the same step the terminal
+    save targets, the terminal save must still win (orbax would otherwise
+    silently no-op it): the finished job must resume as DONE."""
+    import dataclasses
+
+    from shifu_tpu.train import checkpoint as ckpt_lib
+
+    train_ds, valid_ds = small_data
+    d = str(tmp_path / "ckpt")
+    job = _with_ckpt(
+        small_job, d, epochs=1, save_every_seconds=1e-6,
+        data=dataclasses.replace(small_job.data, batch_size=256,
+                                 device_resident_bytes=0,  # force staged
+                                 block_batches=2))
+    train(job, train_ds, valid_ds, console=lambda s: None)
+    mgr = ckpt_lib.make_manager(d)
+    # multiple chunk-boundary saves, not just the terminal one
+    assert len(mgr.all_steps()) > 1, mgr.all_steps()
+    # the terminal save overwrote the colliding cadence save: a restart
+    # sees the job complete and trains ZERO further epochs
+    r2 = train(job, train_ds, valid_ds, console=lambda s: None)
+    assert r2.resumed_from_epoch == 1
+    assert r2.history == []
+
+
+def test_save_same_step_overwrites(tmp_path, small_job):
+    """checkpoint.save at an existing step REPLACES it (orbax's default
+    silently no-ops): extra advances and the PROGRESS marker never points
+    ahead of what restore returns (round-3 review finding, confirmed)."""
+    import json
+    import os
+
+    from shifu_tpu.train import checkpoint as ckpt_lib
+    from shifu_tpu.train import init_state
+
+    d = str(tmp_path / "ckpt")
+    mgr = ckpt_lib.make_manager(d)
+    state = init_state(small_job, 30)
+    ckpt_lib.save(mgr, 5, state, extra={"epoch": 0}, block=True)
+    ckpt_lib.save(mgr, 5, state, extra={"epoch": 1}, block=True)
+    _st, extra, step = ckpt_lib.restore_latest(mgr, state, with_extra=True)
+    assert (step, extra["epoch"]) == (5, 1)
+    with open(os.path.join(d, ckpt_lib.PROGRESS_MARKER)) as f:
+        assert json.load(f)["epoch"] == 1
 
 
 def test_async_save_defers_progress_marker(tmp_path, small_job):
