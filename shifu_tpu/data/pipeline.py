@@ -203,14 +203,18 @@ class StreamingLoader:
     """
 
     def __init__(self, schema: DataSchema, data: DataConfig,
-                 feature_dtype: str = "float32"):
+                 feature_dtype: str = "float32",
+                 host_index: int = 0, num_hosts: int = 1):
         self._schema = schema
         self._data = data
         self._feature_dtype = feature_dtype
         paths: list[str] = []
         for p in data.paths:
             paths.extend(reader.list_data_files(p))
-        self._items = list(enumerate(paths))
+        # same round-robin + GLOBAL file index as load_datasets, so row ids
+        # (and therefore the train/valid split) are identical either way
+        self._items = [(i, p) for i, p in enumerate(paths)
+                       if i % num_hosts == host_index]
         self._results: list[tuple[dict, np.ndarray]] = []
         self._datasets: Optional[tuple[TabularDataset, TabularDataset]] = None
         self.real_batches = 0  # set by first_epoch_blocks
@@ -356,6 +360,15 @@ class StreamingLoader:
                 np.zeros((0, 1), np.float32), np.zeros((0, 1), np.float32))
         return TabularDataset(np.concatenate(feats), np.concatenate(targs),
                               np.concatenate(weights))
+
+    def train_rows_total(self) -> int:
+        """Total TRAIN rows this host parsed (drains the background parse;
+        counts masks only — no array assembly), for skipped-row accounting
+        when a streamed epoch ends early."""
+        if self._datasets is not None:
+            return self._datasets[0].num_rows
+        self._drain()
+        return int(sum(int((~m).sum()) for _, m in self._results))
 
     def valid_dataset(self) -> TabularDataset:
         """The valid partition only — cheap (a few % of the rows), so the

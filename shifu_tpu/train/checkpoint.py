@@ -85,17 +85,19 @@ def save(manager: ocp.CheckpointManager, step: int, state: Any,
     composite = dict(state=ocp.args.StandardSave(state))
     if extra is not None:
         composite["extra"] = ocp.args.JsonSave(extra)
-    # same-step saves REPLACE: orbax silently no-ops (or with force=True,
-    # raises) on an existing step — but a terminal save can legitimately
-    # land on the same step as a time-cadence save from the last chunk
-    # boundary, with DIFFERENT extra (epoch+1 vs epoch); dropping it would
-    # leave a completed job looking unfinished and a restart would
-    # re-train the final epoch on top of its own weights
-    if step in manager.all_steps():
-        try:
-            manager.delete(step)
-        except Exception:
-            pass  # fall through: save() then reports the real problem
+    # same-step saves must still WIN: orbax silently no-ops (or with
+    # force=True, raises) on an existing step — but a terminal save can
+    # legitimately land on the same step as a time-cadence save from the
+    # last chunk boundary, with DIFFERENT extra (epoch+1 vs epoch);
+    # dropping it would leave a completed job looking unfinished and a
+    # restart would re-train the final epoch on top of its own weights.
+    # The key only ORDERS checkpoints (restore reads the latest; the true
+    # step lives in the saved state), so bump past the collision instead
+    # of delete-then-save — deleting first would destroy the newest
+    # durable checkpoint while its replacement is still in flight.
+    existing = set(manager.all_steps())
+    while step in existing:
+        step += 1
     manager.save(step, args=ocp.args.Composite(**composite), force=True)
     if block:
         manager.wait_until_finished()

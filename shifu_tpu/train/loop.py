@@ -329,19 +329,24 @@ def train(job: JobConfig,
     feature_dtype = "bfloat16" if wcast is not None else "float32"
 
     # streamed first epoch: defer the (blocking) load and start training on
-    # parsed blocks while the rest of the files parse in the background —
-    # single-host staged path only (multihost needs globally agreed sizes
-    # that exist only after the full parse)
+    # parsed blocks while the rest of the files parse in the background.
+    # Multihost streams too: every host parses its own file shard and the
+    # gang agrees per round — one small allgather — whether every host has
+    # a full chunk ready (chunks are collective dispatches, so counts must
+    # match everywhere; the first host to run dry ends the streamed epoch
+    # for all, leftover rows training via the retained dataset's epochs).
     stream_loader = None
     if train_ds is None:
         host, nhosts = mesh_lib.host_shard_info(mesh) if mesh else (0, 1)
         rate = job.train.bagging_sample_rate
         if (job.data.stream_first_epoch and not job.data.out_of_core
-                and nhosts == 1 and jax.process_count() == 1
+                and (jax.process_count() == 1 or mesh is not None)
                 and job.data.staged and job.data.drop_remainder
                 and not (0.0 < rate < 1.0)):
             stream_loader = pipe.StreamingLoader(job.schema, job.data,
-                                                 feature_dtype)
+                                                 feature_dtype,
+                                                 host_index=host,
+                                                 num_hosts=nhosts)
         else:
             train_ds, valid_ds = pipe.load_datasets(
                 job.schema, job.data, host, nhosts,
@@ -623,7 +628,7 @@ def train(job: JobConfig,
         if term_flag["hit"]:
             if manager is not None:
                 cur = int(jax.device_get(state.step))
-                if ckpt_lib.latest_step(manager) != cur:
+                if (ckpt_lib.latest_step(manager) or -1) < cur:
                     ckpt_lib.save(manager, cur, state,
                                   extra={"epoch": epoch}, block=True)
                 ckpt_lib.finalize(manager)
@@ -635,7 +640,10 @@ def train(job: JobConfig,
             return
         if time.monotonic() - last_save >= save_secs:
             cur = int(jax.device_get(state.step))
-            if ckpt_lib.latest_step(manager) != cur:  # step already durable?
+            if (ckpt_lib.latest_step(manager) or -1) < cur:  # durable yet?
+                # `<`: a collision-bumped save key can sit ABOVE the raw
+                # step (checkpoint.save bumps instead of deleting), and
+                # that still means this step's state is durable
                 ckpt_lib.save(manager, cur, state, extra={"epoch": epoch},
                               block=True)
             last_save = time.monotonic()
@@ -684,30 +692,100 @@ def train(job: JobConfig,
                 # streamed epoch and later staged epochs share ONE compiled
                 # scan program
                 nb_stream = staged_block_batches
-                # zero-weight tail padding is exact only for weight-gated
-                # losses without a per-step L2 term (see first_epoch_blocks)
-                pad_tail = (job.train.loss in ("weighted_mse", "weighted_bce")
-                            and job.model.l2_scale <= 0)
                 console(f"Streaming first epoch: training overlaps the "
                         f"background parse (batch {stream_bs}, "
                         f"{nb_stream} batches/chunk)")
-                for blocks in pipe.prefetch_to_device(
-                        stream_loader.first_epoch_blocks(
-                            stream_bs, nb_stream, pad_tail=pad_tail),
-                        mesh, size=job.data.prefetch, put_fn=_block_put_fn()):
-                    timer.mark_input_ready()
-                    state, loss_sum_blk = epoch_scan_step(state, blocks)
-                    loss_acc = (loss_sum_blk if loss_acc is None
-                                else loss_acc + loss_sum_blk)
-                    timer.mark_step_done()
-                    if not multihost:
+                if multihost:
+                    # collective streamed epoch: each round every host pulls
+                    # ONE local chunk (blocking — so "no chunk" means its
+                    # stream ENDED, not that it is slow) and an allgather
+                    # agrees whether all have one; the first dry host stops
+                    # the round for everyone.  No tail padding: partial
+                    # chunks stay in the retained dataset for later epochs.
+                    # A 1-deep background puller assembles round N+1's chunk
+                    # while round N computes (the allgather only gates
+                    # DISPATCH, not the pull).
+                    import queue as queue_lib
+                    import threading as threading_lib
+
+                    from jax.experimental import multihost_utils
+                    local_stream_bs = stream_bs // nproc
+                    put_fn = _block_put_fn()
+                    chunk_q: "queue_lib.Queue" = queue_lib.Queue(maxsize=1)
+
+                    def _pull():
+                        # H2D placement happens HERE (it is process-local —
+                        # only the scan dispatch is collective), so round
+                        # N+1's assembly AND transfer overlap round N's
+                        # compute.  Errors (a corrupt file) must reach the
+                        # main loop: a dead puller with no sentinel would
+                        # hang this host on get() and its peers in the
+                        # allgather.
+                        try:
+                            for c in stream_loader.first_epoch_blocks(
+                                    local_stream_bs, nb_stream,
+                                    pad_tail=False):
+                                chunk_q.put(put_fn(c))
+                        except BaseException as e:  # noqa: BLE001
+                            chunk_q.put(e)
+                            return
+                        chunk_q.put(None)
+
+                    threading_lib.Thread(target=_pull, daemon=True).start()
+                    while True:
+                        pending = chunk_q.get()
+                        if isinstance(pending, BaseException):
+                            # failing this host tears the gang down via the
+                            # pod launcher — the peers' allgather times out
+                            # rather than hanging forever
+                            raise pending
+                        have = np.asarray(0 if pending is None else 1)
+                        if int(np.min(multihost_utils.process_allgather(
+                                have))) == 0:
+                            break  # a dropped held chunk cost one transfer;
+                            # its rows stay in the retained dataset
+                        timer.mark_input_ready()
+                        state, loss_sum_blk = epoch_scan_step(state, pending)
+                        loss_acc = (loss_sum_blk if loss_acc is None
+                                    else loss_acc + loss_sum_blk)
+                        loss_n += nb_stream
+                        timer.mark_step_done()
+                    if epoch + 1 >= job.train.epochs:
+                        # epochs=1: there IS no later epoch to train the
+                        # rows the agreed rounds did not cover
+                        skipped = (stream_loader.train_rows_total()
+                                   - loss_n * local_stream_bs)
+                        if skipped > 0:
+                            console(
+                                f"streamed epoch left {skipped} of this "
+                                "host's rows untrained (the gang stops when "
+                                "the smallest shard runs dry) and no later "
+                                "epoch will train them — rebalance file "
+                                "shards or run more epochs")
+                else:
+                    # zero-weight tail padding is exact only for weight-
+                    # gated losses without a per-step L2 term (see
+                    # first_epoch_blocks)
+                    pad_tail = (job.train.loss in ("weighted_mse",
+                                                   "weighted_bce")
+                                and job.model.l2_scale <= 0)
+                    for blocks in pipe.prefetch_to_device(
+                            stream_loader.first_epoch_blocks(
+                                stream_bs, nb_stream, pad_tail=pad_tail),
+                            mesh, size=job.data.prefetch,
+                            put_fn=_block_put_fn()):
+                        timer.mark_input_ready()
+                        state, loss_sum_blk = epoch_scan_step(state, blocks)
+                        loss_acc = (loss_sum_blk if loss_acc is None
+                                    else loss_acc + loss_sum_blk)
+                        timer.mark_step_done()
                         # chunk boundary = consistent state: SIGTERM drain
                         # + time-cadence saves mid-epoch (long first epochs
                         # must not lose an hour to a preemption)
                         maybe_midtrain_save(epoch)
-                # batches that held at least one real row (pad-only batches
-                # contribute zero loss and must not skew train_error)
-                loss_n = stream_loader.real_batches
+                    # batches that held at least one real row (pad-only
+                    # batches contribute zero loss, must not skew the error)
+                    loss_n = stream_loader.real_batches
                 # end-of-epoch eval needs only the (small) valid partition;
                 # the train partition's assembly + global shuffle waits for
                 # the next epoch that actually consumes it (an epochs=1 job
