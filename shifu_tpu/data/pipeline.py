@@ -222,6 +222,7 @@ class StreamingLoader:
         import queue
         import threading
         self._q: "queue.Queue" = queue.Queue(maxsize=4)
+        self._abort = False  # see abort_blocks()
         self._thread = threading.Thread(target=self._produce, daemon=True)
         self._thread.start()
 
@@ -295,14 +296,25 @@ class StreamingLoader:
             return {k: v.reshape(block_batches, batch_size, *v.shape[1:])
                     for k, v in flat.items()}
 
+        import queue as queue_lib
         while True:
-            item = self._q.get()
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue_lib.Empty:
+                if self._abort:
+                    return
+                continue
             if item is None:
                 break
             if isinstance(item, BaseException):
                 raise item
             cols, valid_mask = item
             self._results.append((cols, valid_mask))
+            if self._abort:
+                # cooperative shutdown (abort_blocks): the item was already
+                # RETAINED above, so nothing is lost; the caller's _drain
+                # takes over the queue from here
+                return
             tm = ~valid_mask
             if tm.any():
                 buf.append({k: v[tm] for k, v in cols.items()})
@@ -360,6 +372,15 @@ class StreamingLoader:
                 np.zeros((0, 1), np.float32), np.zeros((0, 1), np.float32))
         return TabularDataset(np.concatenate(feats), np.concatenate(targs),
                               np.concatenate(weights))
+
+    def abort_blocks(self) -> None:
+        """Cooperative shutdown of a first_epoch_blocks consumer running in
+        ANOTHER thread (the streamed epoch's prefetch producer): the
+        generator exits at its next poll instead of blocking on the parse
+        queue forever, so datasets()/_drain never race it for items.
+        Safe because every item the generator consumed was already appended
+        to the retained results before any early return."""
+        self._abort = True
 
     def train_rows_total(self) -> int:
         """Total TRAIN rows this host parsed (drains the background parse;

@@ -92,37 +92,59 @@ def _xla_lookup(table: jax.Array, ids: jax.Array) -> jax.Array:
 # FLOPs) scale with the vocab, so the MXU formulation wins only for small
 # vocabs — measured 2.3x the XLA gather at V=1000/D=16/B=32k on a v5e chip
 # (15.1M -> 35.1M lookup-rows/s); gathers win as V grows past a few thousand.
-# The byte bound keeps the materialized (B, Nc, V) operand (f32 in the
-# backward) from eating HBM on wide/many-field batches.
+# The byte bound sizes BATCH CHUNKS: the materialized (B, Nc, V) one-hot
+# operand (f32 in the backward) must not eat HBM on wide/many-field
+# batches, so oversized batches process in sequential chunks that each fit
+# the budget — the MXU formulation keeps its ~5x win at ANY batch size
+# instead of falling off a cliff to the gather past a threshold.
 _ONEHOT_MAX_VOCAB = 2048
-_ONEHOT_MAX_BYTES = 1 << 30  # f32 one-hot operand budget
+_ONEHOT_MAX_BYTES = 1 << 30  # f32 one-hot operand budget PER CHUNK
 
 
 def _onehot_ok(vocab: int, n_lookups: int) -> bool:
     import os
+    del n_lookups  # any size: the strategy chunks the batch to the budget
     try:
         cap = int(os.environ.get("SHIFU_TPU_ONEHOT_EMBED_MAX_VOCAB",
                                  _ONEHOT_MAX_VOCAB))
     except ValueError:
         cap = _ONEHOT_MAX_VOCAB
-    return (jax.default_backend() == "tpu" and 0 < vocab <= cap
-            and n_lookups * vocab * 4 <= _ONEHOT_MAX_BYTES)
+    return jax.default_backend() == "tpu" and 0 < vocab <= cap
 
 
-def _onehot_lookup(table: jax.Array, ids: jax.Array) -> jax.Array:
+def _onehot_num_chunks(n_lookups: int, vocab: int) -> int:
+    return max(1, -(-(n_lookups * vocab * 4) // _ONEHOT_MAX_BYTES))
+
+
+def _onehot_lookup_chunk(table: jax.Array, ids: jax.Array) -> jax.Array:
     # MXU formulation of the lookup: rows select via one_hot @ table.  The
     # one-hot row has a single exact 1.0, so the result is bit-identical to
     # the gather — including its out-of-range semantics (take_along_axis:
     # ids in [-V, 0) wrap, anything outside [-V, V) NaN-fills), so dirty
     # ids behave identically whichever strategy the auto path picks.
     v = table.shape[1]
-    ids = ids.astype(jnp.int32)
     wrapped = jnp.where(ids < 0, ids + v, ids)
     valid = (ids >= -v) & (ids < v)
     oh = jax.nn.one_hot(wrapped, v, dtype=table.dtype)  # invalid -> zero row
     out = jnp.einsum("bfv,fvd->bfd", oh, table)
     return jnp.where(valid[..., None], out,
                      jnp.asarray(jnp.nan, out.dtype))
+
+
+def _onehot_lookup(table: jax.Array, ids: jax.Array) -> jax.Array:
+    ids = ids.astype(jnp.int32)
+    b = ids.shape[0]
+    k = _onehot_num_chunks(ids.size, table.shape[1])
+    if k <= 1 or b < 2 * k:
+        return _onehot_lookup_chunk(table, ids)
+    # sequential batch chunks (lax.map = scan): per-row independent, so the
+    # chunked result is bit-identical to the unchunked one
+    chunk = -(-b // k)
+    k = -(-b // chunk)
+    idsp = jnp.pad(ids, ((0, chunk * k - b), (0, 0)))  # pad ids are valid 0s
+    out = jax.lax.map(lambda c: _onehot_lookup_chunk(table, c),
+                      idsp.reshape(k, chunk, *ids.shape[1:]))
+    return out.reshape(chunk * k, *out.shape[2:])[:b]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -170,16 +192,41 @@ def _fwd(table, ids, use_pallas):
     return _forward(table, ids, use_pallas), (ids, table.shape, dtype_carrier)
 
 
+def _onehot_grad_chunk(ids: jax.Array, v: int, g: jax.Array) -> jax.Array:
+    wrapped = jnp.where(ids < 0, ids + v, ids)
+    oh = jax.nn.one_hot(wrapped, v, dtype=jnp.float32)
+    return jnp.einsum("bfv,bfd->fvd", oh, g.astype(jnp.float32))
+
+
 def _onehot_grad(ids: jax.Array, table_shape, g: jax.Array) -> jax.Array:
     """MXU gradient: dtable = one_hot(ids)^T @ g — the scatter-add expressed
     as a matmul.  Matches the scatter path's out-of-range handling exactly:
     ids in [-V, 0) wrap (`.at[].add` wraps negatives), anything outside
-    [-V, V) contributes nothing (one_hot's zero row == the scatter drop)."""
+    [-V, V) contributes nothing (one_hot's zero row == the scatter drop).
+    Oversized batches accumulate over sequential chunks (float32 partial
+    sums — same dtype the single einsum accumulates in; chunking only
+    reassociates the additions)."""
     v = table_shape[1]
     ids = ids.astype(jnp.int32)
-    wrapped = jnp.where(ids < 0, ids + v, ids)
-    oh = jax.nn.one_hot(wrapped, v, dtype=jnp.float32)
-    return jnp.einsum("bfv,bfd->fvd", oh, g.astype(jnp.float32))
+    b = ids.shape[0]
+    k = _onehot_num_chunks(ids.size, v)
+    if k <= 1 or b < 2 * k:
+        return _onehot_grad_chunk(ids, v, g)
+    chunk = -(-b // k)
+    k = -(-b // chunk)
+    pad = chunk * k - b
+    idsp = jnp.pad(ids, ((0, pad), (0, 0)))
+    gp = jnp.pad(g, ((0, pad),) + ((0, 0),) * (g.ndim - 1))  # zero grads
+
+    def body(acc, xs):
+        ids_c, g_c = xs
+        return acc + _onehot_grad_chunk(ids_c, v, g_c), None
+
+    out, _ = jax.lax.scan(
+        body, jnp.zeros(table_shape, jnp.float32),
+        (idsp.reshape(k, chunk, *ids.shape[1:]),
+         gp.reshape(k, chunk, *g.shape[1:])))
+    return out
 
 
 def _scatter_grad(ids: jax.Array, table_shape, g: jax.Array) -> jax.Array:

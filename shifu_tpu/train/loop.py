@@ -702,48 +702,33 @@ def train(job: JobConfig,
                     # agrees whether all have one; the first dry host stops
                     # the round for everyone.  No tail padding: partial
                     # chunks stay in the retained dataset for later epochs.
-                    # A 1-deep background puller assembles round N+1's chunk
-                    # while round N computes (the allgather only gates
-                    # DISPATCH, not the pull).
-                    import queue as queue_lib
-                    import threading as threading_lib
-
+                    # prefetch_to_device(size=1) runs the pull AND the H2D
+                    # placement (process-local; only the scan dispatch is
+                    # collective) in its producer thread, so round N+1's
+                    # chunk overlaps round N's compute, with the shared
+                    # helper's error forwarding (a corrupt file fails this
+                    # host — the pod launcher tears the gang down — instead
+                    # of hanging everyone).
                     from jax.experimental import multihost_utils
                     local_stream_bs = stream_bs // nproc
-                    put_fn = _block_put_fn()
-                    chunk_q: "queue_lib.Queue" = queue_lib.Queue(maxsize=1)
-
-                    def _pull():
-                        # H2D placement happens HERE (it is process-local —
-                        # only the scan dispatch is collective), so round
-                        # N+1's assembly AND transfer overlap round N's
-                        # compute.  Errors (a corrupt file) must reach the
-                        # main loop: a dead puller with no sentinel would
-                        # hang this host on get() and its peers in the
-                        # allgather.
-                        try:
-                            for c in stream_loader.first_epoch_blocks(
-                                    local_stream_bs, nb_stream,
-                                    pad_tail=False):
-                                chunk_q.put(put_fn(c))
-                        except BaseException as e:  # noqa: BLE001
-                            chunk_q.put(e)
-                            return
-                        chunk_q.put(None)
-
-                    threading_lib.Thread(target=_pull, daemon=True).start()
+                    stream_end = object()
+                    it = pipe.prefetch_to_device(
+                        stream_loader.first_epoch_blocks(
+                            local_stream_bs, nb_stream, pad_tail=False),
+                        mesh, size=1, put_fn=_block_put_fn())
                     while True:
-                        pending = chunk_q.get()
-                        if isinstance(pending, BaseException):
-                            # failing this host tears the gang down via the
-                            # pod launcher — the peers' allgather times out
-                            # rather than hanging forever
-                            raise pending
-                        have = np.asarray(0 if pending is None else 1)
+                        pending = next(it, stream_end)
+                        have = np.asarray(0 if pending is stream_end else 1)
                         if int(np.min(multihost_utils.process_allgather(
                                 have))) == 0:
-                            break  # a dropped held chunk cost one transfer;
-                            # its rows stay in the retained dataset
+                            # a peer ran dry: shut the producer down BEFORE
+                            # the loader is touched again (it would race
+                            # _drain for parse results and pin its pending
+                            # device chunks in HBM for the rest of the job)
+                            stream_loader.abort_blocks()
+                            for _ in it:
+                                pass  # frees the <=2 in-flight device blocks
+                            break
                         timer.mark_input_ready()
                         state, loss_sum_blk = epoch_scan_step(state, pending)
                         loss_acc = (loss_sum_blk if loss_acc is None

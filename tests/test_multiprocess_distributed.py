@@ -289,6 +289,71 @@ def test_multihost_streamed_first_epoch(tmp_path):
 
 
 @pytest.mark.slow
+def test_multihost_streamed_epoch_unbalanced_shards(tmp_path):
+    """Unbalanced file shards: one host's stream runs dry first, the gang
+    stops the streamed epoch collectively (abort path — the producer must
+    shut down cleanly, not race the dataset assembly), and with epochs=1
+    the richer host warns about its untrained rows."""
+    import json as json_lib
+
+    from shifu_tpu.data import synthetic
+
+    mc = {"dataSet": {"targetColumnName": "target"},
+          "train": {"validSetRate": 0.1, "numTrainEpochs": 1,
+                    "algorithm": "NN",
+                    "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                               "ActivationFunc": ["relu"],
+                               "LearningRate": 0.01, "Optimizer": "adam"}}}
+    cols = [{"columnNum": 0, "columnName": "target", "columnFlag": "Target"}]
+    cols += [{"columnNum": i, "columnName": f"f{i}", "columnType": "N",
+              "finalSelect": True} for i in range(1, 9)]
+    (tmp_path / "ModelConfig.json").write_text(json_lib.dumps(mc))
+    (tmp_path / "ColumnConfig.json").write_text(json_lib.dumps(cols))
+    schema = synthetic.make_schema(num_features=8)
+    data_dir = tmp_path / "data"
+    # round-robin by index: host0 <- files 0,2; host1 <- files 1,3.
+    # host1's shard is ~20x smaller, so it runs dry first.
+    big = synthetic.make_rows(8000, schema, seed=8, noise=0.3)
+    small = synthetic.make_rows(400, schema, seed=9, noise=0.3)
+    synthetic.write_files(big[:4000], str(data_dir), num_files=1)
+    import gzip as gzip_lib
+    import os as os_lib
+
+    def write_one(rows, name):
+        text = "\n".join("|".join(f"{v:.6f}" for v in r) for r in rows) + "\n"
+        with gzip_lib.open(os_lib.path.join(str(data_dir), name), "wt") as f:
+            f.write(text)
+    write_one(small[:200], "part-10001.gz")
+    write_one(big[4000:], "part-10002.gz")
+    write_one(small[200:], "part-10003.gz")
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env.update({"SHIFU_TPU_PLATFORM": "cpu", "SHIFU_TPU_CPU_DEVICES": "1",
+                "PYTHONPATH": os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))})
+    out = tmp_path / "job"
+    r = subprocess.run(
+        [sys.executable, "-m", "shifu_tpu.launcher.cli", "train",
+         "--modelconfig", str(tmp_path / "ModelConfig.json"),
+         "--columnconfig", str(tmp_path / "ColumnConfig.json"),
+         "--data", str(data_dir),
+         "--batch-size", "64",
+         "--output", str(out), "--hosts", "local:2"],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=str(tmp_path))
+    if r.returncode != 0 and "gloo" in (r.stdout + r.stderr):
+        pytest.skip("no gloo cpu collectives in this jax build")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Streaming first epoch" in r.stdout
+    assert "Epoch 0:" in r.stdout
+    # the chief (big shard) reports its untrained rows for the epochs=1 job
+    assert "untrained" in r.stdout, r.stdout
+    for f in ("GenericModelConfig.json", "weights.npz"):
+        assert (out / "final_model" / f).exists(), f
+
+
+@pytest.mark.slow
 def test_pod_ssh_transient_connect_failure_retries(tmp_path):
     """An ssh client dying rc=255 BEFORE any output (connect-level fault:
     host still booting, flaky network) retries THAT host with backoff

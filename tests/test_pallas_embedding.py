@@ -109,7 +109,35 @@ def test_onehot_lookup_matches_gather_exactly(monkeypatch):
     np.testing.assert_allclose(np.asarray(forced_grad),
                                np.asarray(scatter_grad), rtol=1e-6, atol=1e-6)
 
-    # budget predicate: vocab cap and the f32 one-hot byte bound
+    # budget predicate: vocab cap only — batch size no longer disqualifies
+    # (oversized batches chunk to the byte budget instead)
     monkeypatch.undo()
     assert not pe._onehot_ok(pe._ONEHOT_MAX_VOCAB + 1, 10)
-    assert not pe._onehot_ok(2048, (pe._ONEHOT_MAX_BYTES // (2048 * 4)) + 1)
+    assert pe._onehot_num_chunks(
+        (pe._ONEHOT_MAX_BYTES // (2048 * 4)) + 1, 2048) == 2
+
+
+def test_onehot_chunked_matches_unchunked(monkeypatch):
+    """Past the per-chunk byte budget the one-hot strategy processes the
+    batch in sequential chunks: forward bit-identical (rows are
+    independent), gradient equal to the scatter reference within f32
+    accumulation reassociation."""
+    from shifu_tpu.ops import pallas_embedding as pe
+
+    rng = np.random.default_rng(5)
+    table = jnp.asarray(rng.standard_normal((3, 40, 8)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(-50, 60, (101, 3)).astype(np.int32))
+    # shrink the budget so this small batch needs ~4 chunks (incl. padding)
+    monkeypatch.setattr(pe, "_ONEHOT_MAX_BYTES", 101 * 3 * 40)
+    assert pe._onehot_num_chunks(ids.size, 40) > 1
+    got = np.asarray(pe._onehot_lookup(table, ids))
+    monkeypatch.setattr(pe, "_ONEHOT_MAX_BYTES", 1 << 30)
+    want = np.asarray(pe._onehot_lookup(table, ids))
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+    np.testing.assert_array_equal(np.nan_to_num(got), np.nan_to_num(want))
+
+    g = jnp.asarray(rng.standard_normal((101, 3, 8)).astype(np.float32))
+    monkeypatch.setattr(pe, "_ONEHOT_MAX_BYTES", 101 * 3 * 40)
+    chunked = np.asarray(pe._onehot_grad(ids, table.shape, g))
+    ref = np.asarray(pe._scatter_grad(ids, table.shape, g))
+    np.testing.assert_allclose(chunked, ref, rtol=1e-6, atol=1e-6)
