@@ -111,6 +111,34 @@ def _sustained_rate(call, sync, samples_per_call: float, *,
     }
 
 
+def _h2d_bandwidth_bytes_per_sec(trials: int = 3) -> float:
+    """Shared-tunnel host->device bandwidth via a two-point solve: a single
+    short transfer folds the rig's fixed ~60-110 ms dispatch/readback
+    latency into the bandwidth (the exact artifact `_sustained_rate`
+    removes from the compute tiers), so time a small and a large transfer
+    and fit the difference."""
+    import jax
+
+    small = np.zeros((8 << 20) // 4, np.float32)
+    large = np.zeros((32 << 20) // 4, np.float32)
+    jax.device_put(small)  # warm any allocation path
+
+    def t_of(buf) -> float:
+        best = None
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            h = jax.device_put(buf)
+            float(h[0])  # D2H readback: the only true sync on this rig
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    t_small, t_large = t_of(small), t_of(large)
+    if t_large <= t_small:  # noise swamped the fit: long-window average
+        return float(32 << 20) / max(t_large, 1e-9)
+    return float((32 << 20) - (8 << 20)) / (t_large - t_small)
+
+
 def _best_rate(fn, units_per_call: int, trials: int = 3, reps: int = 10) -> float:
     """Best-of-N timed windows (resists interference from the shared host:
     the scoring/parse tiers run on CPU while the TPU tunnel and any
@@ -196,8 +224,9 @@ def _ladder_extras(mesh, n_chips: int, peak_tflops) -> dict:
                               activations=("relu", "relu"), num_experts=8,
                               compute_dtype="bfloat16"), 32768, 32),
         # batch 8192: the batch-in-lanes small-token attention kernel
-        # (ops/pallas_small_attention.py) peaks there on a v5e (~334k vs
-        # 131k samples/s/chip with the classic XLA path at any batch)
+        # (ops/pallas_small_attention.py) peaks there on a v5e (393k vs
+        # 142k samples/s/chip on the XLA path under the deconvolved clock;
+        # 32k batch measures lower)
         ("ft_transformer", ModelSpec(model_type="ft_transformer", token_dim=64,
                                      num_layers=3, num_attention_heads=8,
                                      compute_dtype="bfloat16"), 8192, 16),
@@ -415,18 +444,10 @@ def main() -> None:
         # tunneled chip's host link runs ~3 orders below a real host's
         # PCIe/DMA path; the tier should be judged as a fraction of this,
         # not of the resident tier)
-        probe = np.zeros((32 << 20) // 4, np.float32)  # 32 MiB
-        jax.device_put(probe)  # warm any allocation path
-        h2d_best = 0.0  # bytes/s over the whole host link
-        for _ in range(3):
-            t0 = time.perf_counter()
-            h = jax.device_put(probe)
-            float(h[0])  # D2H readback: the only true sync here
-            h2d_best = max(h2d_best,
-                           float(32 << 20) / (time.perf_counter() - t0))
+        h2d_best = _h2d_bandwidth_bytes_per_sec()
         extras["h2d_bandwidth_mb_per_sec"] = round(h2d_best / 1e6, 1)
         # bf16 wire row: features bf16, target+weight stay f32 (wire_cast_fn)
-        wire_bytes = 30 * 2 + 4 + 4
+        wire_bytes = num_features * 2 + 4 + 4
         extras["staged_h2d_roofline_fraction"] = round(
             best * n_chips * wire_bytes / h2d_best, 3)
     except Exception as e:
@@ -583,15 +604,8 @@ def main() -> None:
             # tunnel's host->device bandwidth (it swings with co-tenant
             # load), so record the ceiling it implies at the bf16 wire
             # format alongside the measured tiers
-            probe = np.zeros((16 << 20) // 4, np.float32)
-            jax.device_put(probe)
-            h2d = 0.0  # bytes/s
-            for _ in range(3):
-                t0 = time.perf_counter()
-                h = jax.device_put(probe)
-                float(h[0])
-                h2d = max(h2d, float(16 << 20) / (time.perf_counter() - t0))
-            wire_row = 30 * 2 + 4 + 4  # bf16 features + f32 target/weight
+            h2d = _h2d_bandwidth_bytes_per_sec()
+            wire_row = num_features * 2 + 4 + 4  # bf16 feats + f32 tgt/wgt
             extras["e2e_h2d_ceiling_samples_per_sec_per_chip"] = round(
                 h2d / wire_row / n_chips, 1)
             train_fn(e2e_job(), console=lambda s: None)  # warm: compiles

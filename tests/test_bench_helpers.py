@@ -1,0 +1,67 @@
+"""Unit tests for bench.py's timing helpers.
+
+The two-point deconvolution (`_sustained_rate`) is what makes every
+device-rate number in BENCH_r*.json mean "sustained device throughput"
+rather than "tunnel latency": these tests pin that it recovers the true
+per-call cost from windows polluted by a large fixed dispatch/readback
+overhead, and that it degrades to a plain long-window average when there
+is nothing to solve.
+"""
+
+from __future__ import annotations
+
+import time
+
+import bench
+
+
+class _FakeClock:
+    """Deterministic perf_counter: call() costs `w` seconds, sync() costs
+    `c` seconds — so a window of r calls takes exactly w*r + c."""
+
+    def __init__(self, w: float, c: float):
+        self.now = 0.0
+        self.w = w
+        self.c = c
+
+    def call(self):
+        self.now += self.w
+        return "handle"
+
+    def sync(self, h):
+        assert h == "handle"
+        self.now += self.c
+
+
+def test_sustained_rate_deconvolves_fixed_overhead(monkeypatch):
+    clk = _FakeClock(w=0.005, c=0.060)  # 60 ms fixed cost, 5 ms true work
+    monkeypatch.setattr(time, "perf_counter", lambda: clk.now)
+    rate, diag = bench._sustained_rate(clk.call, clk.sync, 1000.0)
+    # naive short windows would report ~1000/0.035 = 28k; the solve must
+    # recover the true 1000/0.005 = 200k
+    assert abs(rate - 200_000.0) / 200_000.0 < 0.01
+    assert abs(diag["fixed_overhead_ms"] - 60.0) < 1.0
+    # the corroborating long window is within a few percent of the solve
+    assert diag["long_window_rate"] > 0.8 * rate
+
+
+def test_sustained_rate_degenerate_fixed_cost_only(monkeypatch):
+    # per-call work below the solver's resolution: must not divide by ~0 or
+    # return a wild extrapolation — falls back to the long-window average
+    clk = _FakeClock(w=0.0, c=0.050)
+    monkeypatch.setattr(time, "perf_counter", lambda: clk.now)
+    rate, diag = bench._sustained_rate(clk.call, clk.sync, 1000.0)
+    assert rate > 0
+    r_lo, r_hi = diag["reps"]
+    assert rate <= 1000.0 * r_hi / 0.050 * 1.01  # bounded by window math
+
+
+def test_sustained_rate_reps_grow_to_target(monkeypatch):
+    # with tiny per-call cost the adaptive reps must grow far beyond the
+    # 2-call probe so the device-work term dominates the window
+    clk = _FakeClock(w=0.0005, c=0.060)
+    monkeypatch.setattr(time, "perf_counter", lambda: clk.now)
+    rate, diag = bench._sustained_rate(clk.call, clk.sync, 100.0)
+    r_lo, r_hi = diag["reps"]
+    assert r_hi >= 100
+    assert abs(rate - 100.0 / 0.0005) / (100.0 / 0.0005) < 0.01
