@@ -112,15 +112,18 @@ def _sustained_rate(call, sync, samples_per_call: float, *,
 
 
 def _h2d_bandwidth_bytes_per_sec(trials: int = 3) -> float:
-    """Shared-tunnel host->device bandwidth via a two-point solve: a single
-    short transfer folds the rig's fixed ~60-110 ms dispatch/readback
-    latency into the bandwidth (the exact artifact `_sustained_rate`
-    removes from the compute tiers), so time a small and a large transfer
-    and fit the difference."""
+    """Host->device bandwidth via a two-point solve: a single short
+    transfer folds the rig's fixed ~60-110 ms dispatch/readback latency
+    into the bandwidth (the exact artifact `_sustained_rate` removes from
+    the compute tiers), so time a small and a large transfer and fit the
+    difference.  The large transfer grows until it clearly dominates the
+    small one (fast links would otherwise hand the fit a noise-scale time
+    difference), and the fit is clamped to a sanity window around the
+    plain large-transfer average."""
     import jax
 
-    small = np.zeros((8 << 20) // 4, np.float32)
-    large = np.zeros((32 << 20) // 4, np.float32)
+    small_b = 8 << 20
+    small = np.zeros(small_b // 4, np.float32)
     jax.device_put(small)  # warm any allocation path
 
     def t_of(buf) -> float:
@@ -133,10 +136,18 @@ def _h2d_bandwidth_bytes_per_sec(trials: int = 3) -> float:
             best = dt if best is None else min(best, dt)
         return best
 
-    t_small, t_large = t_of(small), t_of(large)
-    if t_large <= t_small:  # noise swamped the fit: long-window average
-        return float(32 << 20) / max(t_large, 1e-9)
-    return float((32 << 20) - (8 << 20)) / (t_large - t_small)
+    t_small = t_of(small)
+    large_b = 32 << 20
+    while True:
+        t_large = t_of(np.zeros(large_b // 4, np.float32))
+        if t_large >= 2.0 * t_small or large_b >= (512 << 20):
+            break
+        large_b *= 4
+    naive = float(large_b) / max(t_large, 1e-9)  # includes the fixed cost
+    if t_large <= t_small:  # noise swamped the fit
+        return naive
+    fit = float(large_b - small_b) / (t_large - t_small)
+    return min(max(fit, naive), 10.0 * naive)
 
 
 def _best_rate(fn, units_per_call: int, trials: int = 3, reps: int = 10) -> float:
@@ -177,11 +188,19 @@ def _rung_flops_per_sample(spec, num_features: int, n_cat: int,
                + 2 * dm * 1)                  # head
         return 3.0 * fwd
     if spec.model_type in ("wide_deep", "deepfm"):
-        embed = n_cat * 2 * vocab * d         # one-hot matmul per table
+        # ask the REAL strategy selector (backend + env-override aware) so
+        # the FLOPs accounting matches the path the chip actually ran
+        from shifu_tpu.ops.pallas_embedding import _onehot_ok
+        if _onehot_ok(vocab, 0):              # one-hot matmul per table
+            embed = n_cat * 2 * vocab * d
+            first_order = n_cat * 2 * vocab
+        else:                                 # gather path: no matmul FLOPs
+            embed = n_cat * 2 * d
+            first_order = n_cat * 2
         deep_in = n_num + n_cat * d
         fwd = embed + dense_chain([deep_in, *spec.hidden_nodes, 1])
         if spec.model_type == "deepfm":
-            fwd += n_cat * 2 * vocab          # wide/FM first-order one-hots
+            fwd += first_order                # wide/FM first-order terms
         return 3.0 * fwd
     if spec.model_type == "moe_mlp":
         # every token computes all experts (dense moe on one chip), + gate
@@ -199,7 +218,10 @@ def _rung_flops_per_sample(spec, num_features: int, n_cat: int,
 def _ladder_extras(mesh, n_chips: int, peak_tflops) -> dict:
     """Device-resident train throughput + analytic MFU for BASELINE ladder
     rungs 2-5 (Wide&Deep, DeepFM w/ embeddings, multi-task, MoE,
-    FT-Transformer)."""
+    FT-Transformer) plus the BASELINE-shaped variants: the ~1000-column
+    Wide&Deep of config #2 and the high-cardinality DeepFM of config #3
+    (vocab 100k exercises the sharded-gather embedding path — the one-hot
+    MXU strategy caps out at vocab 2048)."""
     import jax
     import jax.numpy as jnp
 
@@ -209,46 +231,56 @@ def _ladder_extras(mesh, n_chips: int, peak_tflops) -> dict:
     from shifu_tpu.parallel.sharding import shard_blocks
     from shifu_tpu.train import init_state, make_device_epoch_step
 
+    def dlrm_spec(model_type, **kw):
+        return ModelSpec(model_type=model_type, hidden_nodes=(100, 100),
+                         activations=("relu", "relu"), embedding_dim=16,
+                         compute_dtype="bfloat16", **kw)
+
+    # (name, spec, batch, n_blocks, features, n_categorical, vocab)
     rungs = [
-        ("wide_deep", ModelSpec(model_type="wide_deep", hidden_nodes=(100, 100),
-                                activations=("relu", "relu"), embedding_dim=16,
-                                compute_dtype="bfloat16"), 32768, 32),
-        ("deepfm", ModelSpec(model_type="deepfm", hidden_nodes=(100, 100),
-                             activations=("relu", "relu"), embedding_dim=16,
-                             compute_dtype="bfloat16"), 32768, 32),
+        ("wide_deep", dlrm_spec("wide_deep"), 32768, 32, 30, 6, 1000),
+        ("deepfm", dlrm_spec("deepfm"), 32768, 32, 30, 6, 1000),
+        # BASELINE config #2 shape: ~1000-column ColumnConfig risk model
+        ("wide_deep_1000col", dlrm_spec("wide_deep"), 8192, 16, 1000, 50,
+         1000),
+        # BASELINE config #3 shape: high-cardinality CTR categoricals
+        ("deepfm_100kvocab", dlrm_spec("deepfm"), 32768, 32, 30, 6, 100_000),
         ("multitask", ModelSpec(model_type="multitask", hidden_nodes=(100, 100),
                                 activations=("relu", "relu"), num_heads=2,
                                 head_names=("shifu_output_0", "shifu_output_1"),
-                                compute_dtype="bfloat16"), 32768, 32),
+                                compute_dtype="bfloat16"), 32768, 32, 30, 0,
+         1000),
         ("moe_mlp", ModelSpec(model_type="moe_mlp", hidden_nodes=(100, 100),
                               activations=("relu", "relu"), num_experts=8,
-                              compute_dtype="bfloat16"), 32768, 32),
+                              compute_dtype="bfloat16"), 32768, 32, 30, 0,
+         1000),
         # batch 8192: the batch-in-lanes small-token attention kernel
         # (ops/pallas_small_attention.py) peaks there on a v5e (393k vs
         # 142k samples/s/chip on the XLA path under the deconvolved clock;
         # 32k batch measures lower)
         ("ft_transformer", ModelSpec(model_type="ft_transformer", token_dim=64,
                                      num_layers=3, num_attention_heads=8,
-                                     compute_dtype="bfloat16"), 8192, 16),
+                                     compute_dtype="bfloat16"), 8192, 16, 30,
+         0, 1000),
     ]
     out = {}
     rng = np.random.default_rng(7)
-    for name, spec, bs, nb in rungs:
+    for name, spec, bs, nb, n_feat, n_cat, vocab in rungs:
       try:
-        n_cat = 6 if spec.model_type in ("wide_deep", "deepfm") else 0
         n_tgt = spec.num_heads
-        schema = synthetic.make_schema(num_features=30, num_categorical=n_cat,
-                                       vocab_size=1000, num_targets=n_tgt)
+        schema = synthetic.make_schema(num_features=n_feat,
+                                       num_categorical=n_cat,
+                                       vocab_size=vocab, num_targets=n_tgt)
         job = JobConfig(
             schema=schema, data=DataConfig(batch_size=bs), model=spec,
             train=TrainConfig(
                 epochs=1, loss="weighted_mse",
                 optimizer=OptimizerConfig(name="adadelta", learning_rate=0.003)),
         ).validate()
-        feats = rng.standard_normal((nb, bs, 30)).astype(np.float32)
+        feats = rng.standard_normal((nb, bs, n_feat)).astype(np.float32)
         if n_cat:  # integer ids (stored as floats) in the categorical tail
-            feats[..., 30 - n_cat:] = rng.integers(
-                0, 1000, (nb, bs, n_cat)).astype(np.float32)
+            feats[..., n_feat - n_cat:] = rng.integers(
+                0, vocab, (nb, bs, n_cat)).astype(np.float32)
         host_blocks = {
             "features": feats,
             "target": (rng.random((nb, bs, n_tgt)) < 0.5).astype(np.float32),
@@ -256,7 +288,8 @@ def _ladder_extras(mesh, n_chips: int, peak_tflops) -> dict:
         }
         blocks = (shard_blocks(host_blocks, mesh) if mesh is not None
                   else {k: jax.device_put(v) for k, v in host_blocks.items()})
-        state = init_state(job, 30, mesh)
+        del host_blocks, feats
+        state = init_state(job, n_feat, mesh)
         step = make_device_epoch_step(job, mesh)
         order = jnp.arange(nb, dtype=jnp.int32)
         st, last = step(state, blocks, order)
@@ -272,7 +305,7 @@ def _ladder_extras(mesh, n_chips: int, peak_tflops) -> dict:
         one_epoch = None  # the closure pins this rung's device blocks
         del blocks, holder
         out[f"ladder_{name}_samples_per_sec_per_chip"] = round(best, 1)
-        flops = _rung_flops_per_sample(spec, 30, n_cat, 1000)
+        flops = _rung_flops_per_sample(spec, n_feat, n_cat, vocab)
         out[f"ladder_{name}_flops_per_sample"] = round(flops, 1)
         if peak_tflops:
             out[f"ladder_{name}_mfu"] = round(
