@@ -432,12 +432,16 @@ def main() -> None:
     # -- staged tier: the out-of-HBM input path real big jobs use ----------
     # (VERDICT r2 weak #5: the tier pitched for out-of-HBM jobs had no bench
     # number).  Steady state: host blocks -> chunked wire-bf16 H2D (prefetch
-    # thread) -> one scan per chunk.
+    # thread) -> one scan per chunk.  Sized to ~6 H2D chunks per epoch for
+    # any sweep winner, so the un-overlapped pipeline-fill chunk is a small
+    # fraction of the epoch (the old 8-batch sizing = 2 chunks made fill
+    # HALF the measurement)
     try:
         from shifu_tpu.data import pipeline as pipe_lib
         from shifu_tpu.train import make_epoch_scan_step
 
-        stg_rows = 8 * batch_size
+        stg_chunk = max(1, 524288 // batch_size)  # batches per H2D chunk
+        stg_rows = 6 * stg_chunk * batch_size     # ~6 chunks for ANY winner
         ds = pipe_lib.TabularDataset(
             rng.standard_normal((stg_rows, num_features)).astype(np.float32),
             (rng.random((stg_rows, 1)) < 0.5).astype(np.float32),
@@ -451,7 +455,7 @@ def main() -> None:
         put_fn = (lambda b: put(wcast(b))) if wcast else put
         scan = make_epoch_scan_step(job, mesh)
         stg_state = init_state(job, num_features, mesh)
-        chunk = max(1, 524288 // batch_size)
+        chunk = stg_chunk
 
         def staged_epoch(epoch):
             nonlocal stg_state
