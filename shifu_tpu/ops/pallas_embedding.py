@@ -9,9 +9,12 @@ Kernel design (TPU-first): the ids are a *scalar-prefetch* argument, so each
 grid step's BlockSpec index_map reads the id and the Pallas pipeline DMAs
 exactly the selected table row HBM->VMEM, double-buffered across grid steps —
 the table itself never materializes in VMEM.  Per grid step the kernel body
-is a pure VMEM copy of one (1, 1, D) row.  The backward pass is a scatter-add
-(XLA `.at[].add`) under a custom VJP, since training-time gradient scatter is
-bandwidth-bound and XLA's implementation is already optimal for it.
+is a pure VMEM copy of one (1, 1, D) row.  The backward pass picks one of
+three gradient strategies under a custom VJP: small-vocab tables become
+one-hot matmuls on the MXU (`_onehot_grad`), large-vocab tables on TPU use
+per-table 1-D segment reductions (`_segment_grad` — 4.2x the combined 2-D
+scatter-add on a v5e), and CPU (or an explicit use_pallas=False reference
+request) keeps the plain XLA `.at[].add` scatter (`_scatter_grad`).
 
 CPU/testing: falls back to `interpret=True` off-TPU so the same code path is
 unit-tested on the virtual CPU mesh.  On real TPU hardware the kernel is
@@ -242,12 +245,34 @@ def _scatter_grad(ids: jax.Array, table_shape, g: jax.Array) -> jax.Array:
         g.reshape(-1, table_shape[-1]).astype(jnp.float32))
 
 
+def _segment_grad(ids: jax.Array, table_shape, g: jax.Array) -> jax.Array:
+    """The same gradient as `_scatter_grad`, lowered as NC independent 1-D
+    segment reductions instead of one combined 2-D scatter — XLA:TPU turns
+    the per-table form into a far faster program (measured 4.2x on a v5e
+    at vocab 100k: 11.2M vs 2.6M update-rows/s; no pre-sort needed, a sort
+    actually measured slower).  Id semantics match the scatter exactly:
+    negative ids wrap once, anything outside [-V, V) contributes nothing
+    (segment_sum drops out-of-range segment ids the way `.at[].add` drops
+    out-of-bounds updates)."""
+    nc, v, _ = table_shape
+    ids = ids.astype(jnp.int32)
+    wrapped = jnp.where(ids < 0, ids + v, ids)
+    gf = g.astype(jnp.float32)
+    return jnp.stack([
+        jax.ops.segment_sum(gf[:, f, :], wrapped[:, f], num_segments=v)
+        for f in range(nc)])
+
+
 def _bwd(use_pallas, res, g):
     ids, table_shape, dtype_carrier = res
     table_dtype = dtype_carrier.dtype
     auto = use_pallas is None
     if auto and _onehot_ok(table_shape[1], ids.size):
         return _onehot_grad(ids, table_shape, g).astype(table_dtype), None
+    if auto and jax.default_backend() == "tpu":
+        # CPU scatters fine; TPU does not.  Auto-path only: an explicit
+        # use_pallas=False keeps the reference scatter-add for A/Bs.
+        return _segment_grad(ids, table_shape, g).astype(table_dtype), None
     return _scatter_grad(ids, table_shape, g).astype(table_dtype), None
 
 

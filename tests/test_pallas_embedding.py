@@ -141,3 +141,24 @@ def test_onehot_chunked_matches_unchunked(monkeypatch):
     chunked = np.asarray(pe._onehot_grad(ids, table.shape, g))
     ref = np.asarray(pe._scatter_grad(ids, table.shape, g))
     np.testing.assert_allclose(chunked, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_segment_grad_matches_scatter_grad():
+    """The TPU gather-path gradient (per-table segment reductions) equals
+    the scatter-add reference for every id class: in-range, duplicate,
+    negative-wrapping [-V, 0), and dropped outside [-V, V)."""
+    from shifu_tpu.ops import pallas_embedding as pe
+
+    rng = np.random.default_rng(11)
+    table_shape = (4, 37, 8)
+    # dense duplicates plus every boundary class
+    ids = rng.integers(-80, 90, (257, 4)).astype(np.int32)
+    ids[0] = [0, 36, -1, -37]       # wrap boundaries
+    ids[1] = [-38, 37, 89, -80]     # all dropped
+    ids[2] = ids[3] = [5, 5, 5, 5]  # duplicates
+    g = rng.standard_normal((257, 4, 8)).astype(np.float32)
+    got = np.asarray(pe._segment_grad(jnp.asarray(ids), table_shape,
+                                      jnp.asarray(g)))
+    want = np.asarray(pe._scatter_grad(jnp.asarray(ids), table_shape,
+                                       jnp.asarray(g)))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
