@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from ..config.schema import ModelSpec
 from .base import MLPTrunk, ShifuDense, dtype_of
-from .embedding import (CategoricalEmbed, FieldLayout, NumericEmbed,
+from .embedding import (FieldLayout, NumericEmbed, paired_cat_embed,
                         split_features)
 
 
@@ -35,19 +35,22 @@ class DeepFM(nn.Module):
     def __call__(self, features: jax.Array, *, train: bool = False) -> jax.Array:
         numeric, ids = split_features(features, self.layout)
 
-        # field vectors (B, F, k): numeric + categorical share the FM space
+        # field vectors (B, F, k): numeric + categorical share the FM space.
+        # The k-dim FM/deep table and the scalar first-order table read the
+        # SAME ids, so they share one fused lookup (embedding.fused_lookup)
+        # — the gather/segment-grad cost is per-row, not per-byte.
         vecs = []
+        cat_first = None
         if self.layout.num_numeric:
             vecs.append(NumericEmbed(layout=self.layout, dim=self.spec.embedding_dim,
                                      param_dtype=self.spec.param_dtype,
                                      compute_dtype=self.spec.compute_dtype,
                                      name="numeric_embedding")(numeric))
         if self.layout.num_categorical:
-            vecs.append(CategoricalEmbed(layout=self.layout,
-                                         dim=self.spec.embedding_dim,
-                                         param_dtype=self.spec.param_dtype,
-                                         compute_dtype=self.spec.compute_dtype,
-                                         name="cat_embedding")(ids))
+            cat_vec, cat_first = paired_cat_embed(
+                self.layout, self.spec, "cat_embedding", "first_order_cat",
+                ids)
+            vecs.append(cat_vec)
         v = jnp.concatenate(vecs, axis=1)  # (B, F, k)
 
         # first-order terms (B, H)
@@ -57,11 +60,7 @@ class DeepFM(nn.Module):
                            compute_dtype=self.spec.compute_dtype,
                            name="first_order_numeric")(
             numeric.astype(dtype_of(self.spec.compute_dtype)))
-        if self.layout.num_categorical:
-            cat_first = CategoricalEmbed(layout=self.layout, dim=self.spec.num_heads,
-                                         param_dtype=self.spec.param_dtype,
-                                         compute_dtype=self.spec.compute_dtype,
-                                         name="first_order_cat")(ids)
+        if cat_first is not None:
             first = first + jnp.sum(cat_first, axis=1)
 
         # FM second-order: 0.5 * ((sum v)^2 - sum v^2), summed over k -> (B, 1)

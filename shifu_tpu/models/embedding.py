@@ -83,7 +83,11 @@ class CategoricalEmbed(nn.Module):
 
     Tables are stacked per field (ragged vocabs padded to the max) so one
     gather serves all fields — fewer, larger ops for XLA, and a single
-    sharding rule puts the vocab axis on `model`.
+    sharding rule puts the vocab axis on `model`.  `table()` exposes the
+    compute-dtype table so a caller holding several embeds over the SAME
+    ids can concat along dim and pay ONE lookup (see fused_lookup) — the
+    per-update cost of a gather/segment-grad pair is mostly per-row, not
+    per-byte, so two lookups cost nearly twice one.
     """
 
     layout: FieldLayout
@@ -91,24 +95,75 @@ class CategoricalEmbed(nn.Module):
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
 
-    @nn.compact
+    def setup(self):
+        if self.layout.num_categorical:
+            max_vocab = max(self.layout.vocab_sizes)
+            # one stacked table (num_fields, max_vocab, dim); per-field rows
+            # beyond that field's vocab are dead weight but keep shapes static
+            self.embedding = self.param(
+                "embedding", xavier_uniform,
+                (self.layout.num_categorical, max_vocab, self.dim),
+                dtype_of(self.param_dtype))
+
+    def table(self) -> jax.Array:
+        return self.embedding.astype(dtype_of(self.compute_dtype))
+
     def __call__(self, ids: jax.Array) -> jax.Array:
         if self.layout.num_categorical == 0:
             return jnp.zeros((ids.shape[0], 0, self.dim),
                              dtype_of(self.compute_dtype))
-        max_vocab = max(self.layout.vocab_sizes)
-        # one stacked table (num_fields, max_vocab, dim); per-field rows beyond
-        # that field's vocab are dead weight but keep shapes static
-        table = self.param(
-            "embedding", xavier_uniform,
-            (self.layout.num_categorical, max_vocab, self.dim),
-            dtype_of(self.param_dtype))
-        table = table.astype(dtype_of(self.compute_dtype))
         # gather per field: ids (B, Nc) -> (B, Nc, dim).  Routed through
         # ops/pallas_embedding.embedding_lookup: XLA gather by default, the
         # manual-DMA Pallas kernel under SHIFU_TPU_PALLAS=1.
         from ..ops.pallas_embedding import embedding_lookup
-        return embedding_lookup(table, ids.astype(jnp.int32))
+        return embedding_lookup(self.table(), ids.astype(jnp.int32))
+
+
+def fused_lookup(embeds: Sequence[CategoricalEmbed], ids: jax.Array
+                 ) -> list[jax.Array]:
+    """One lookup for several CategoricalEmbeds sharing the same ids.
+
+    Concats the tables along dim (cheap: HBM copy, exact), gathers once,
+    splits the result back per embed.  Identical values to calling each
+    embed separately; roughly halves the sparse-path cost for the models
+    that pair a k-dim FM/deep table with a scalar first-order table over
+    the same fields (DeepFM, Wide&Deep).
+
+    Under the SHIFU_TPU_PALLAS=1 opt-in the embeds are looked up
+    separately instead: the manual-DMA kernel requires D % 128 == 0, and
+    a concat of a 128-aligned table with a scalar one would silently
+    demote BOTH to the XLA gather.
+    """
+    from ..ops.pallas_embedding import embedding_lookup
+    from ..ops.pallas_common import pallas_opt_in
+
+    if pallas_opt_in():
+        return [e(ids) for e in embeds]
+    fused = embedding_lookup(
+        jnp.concatenate([e.table() for e in embeds], axis=-1),
+        ids.astype(jnp.int32))
+    outs, off = [], 0
+    for e in embeds:
+        outs.append(fused[..., off:off + e.dim])
+        off += e.dim
+    return outs
+
+
+def paired_cat_embed(layout: FieldLayout, spec: ModelSpec, big_name: str,
+                     small_name: str, ids: jax.Array
+                     ) -> tuple[jax.Array, jax.Array]:
+    """The (embedding_dim table, num_heads table) pair over shared ids
+    that DeepFM and Wide&Deep both use, through one fused lookup.
+    Returns ((B, Nc, embedding_dim), (B, Nc, num_heads))."""
+    big, small = fused_lookup(
+        [CategoricalEmbed(layout=layout, dim=spec.embedding_dim,
+                          param_dtype=spec.param_dtype,
+                          compute_dtype=spec.compute_dtype, name=big_name),
+         CategoricalEmbed(layout=layout, dim=spec.num_heads,
+                          param_dtype=spec.param_dtype,
+                          compute_dtype=spec.compute_dtype,
+                          name=small_name)], ids)
+    return big, small
 
 
 class NumericEmbed(nn.Module):

@@ -17,7 +17,8 @@ import jax.numpy as jnp
 from ..config.schema import DataSchema, ModelSpec
 from ..ops.initializers import xavier_uniform
 from .base import MLPTrunk, ShifuDense, dtype_of
-from .embedding import CategoricalEmbed, FieldLayout, field_layout, split_features
+from .embedding import (FieldLayout, field_layout, paired_cat_embed,
+                        split_features)
 
 
 class WideDeep(nn.Module):
@@ -36,21 +37,19 @@ class WideDeep(nn.Module):
                           param_dtype=self.spec.param_dtype,
                           compute_dtype=self.spec.compute_dtype,
                           name="wide_linear")(numeric)
+        # wide per-id bias + deep embedding read the SAME ids: one fused
+        # lookup (embedding.fused_lookup) — gather/segment-grad cost is
+        # per-row, not per-byte
+        emb = None
         if self.layout.num_categorical:
-            # per-field scalar bias per id == one-hot wide weights
-            cat_bias = CategoricalEmbed(layout=self.layout, dim=self.spec.num_heads,
-                                        param_dtype=self.spec.param_dtype,
-                                        compute_dtype=self.spec.compute_dtype,
-                                        name="wide_cat_embedding")(ids)
+            emb, cat_bias = paired_cat_embed(
+                self.layout, self.spec, "deep_embedding",
+                "wide_cat_embedding", ids)
             wide = wide + jnp.sum(cat_bias, axis=1)
 
         # -- deep: MLP over [numeric, cat embeddings] ------------------------
         deep_in = numeric
-        if self.layout.num_categorical:
-            emb = CategoricalEmbed(layout=self.layout, dim=self.spec.embedding_dim,
-                                   param_dtype=self.spec.param_dtype,
-                                   compute_dtype=self.spec.compute_dtype,
-                                   name="deep_embedding")(ids)
+        if emb is not None:
             deep_in = jnp.concatenate(
                 [numeric, emb.reshape(emb.shape[0], -1)], axis=-1)
         deep = MLPTrunk(spec=self.spec, name="trunk")(deep_in, train=train)
