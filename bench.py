@@ -542,9 +542,11 @@ def main() -> None:
     # device-resident training throughput for the rest of the BASELINE
     # model ladder (configs 2-5); each rung pays a compile, so the whole
     # ladder runs by default but can be skipped with SHIFU_TPU_BENCH_FAST
-    if _past_deadline():
+    if os.environ.get("SHIFU_TPU_BENCH_FAST"):
+        pass  # fast mode skips the ladder regardless of budget
+    elif _past_deadline():
         extras["ladder_skipped"] = "soft deadline (SHIFU_TPU_BENCH_DEADLINE)"
-    elif not os.environ.get("SHIFU_TPU_BENCH_FAST"):
+    else:
         try:
             extras.update(_ladder_extras(mesh, n_chips, peak))
         except Exception as e:

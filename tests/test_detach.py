@@ -122,3 +122,49 @@ def test_status_unknown_dir(tmp_path):
     r = _cli(["status", str(tmp_path / "nope")])
     assert r.returncode == 1
     assert json.loads(r.stdout.strip())["state"] == "UNKNOWN"
+
+
+@pytest.mark.slow
+def test_detached_timeout_is_terminal_and_reported(job_files):
+    """--detach + --timeout: the daemon's supervised child hits the job
+    deadline ONCE (terminal, no restart loop — the round-2 verdict bug
+    class), the daemon exits with the timeout code, and `status` reports
+    FAILED with exit 3 within bounded wall time."""
+    out = job_files / "out_t"
+    _submit(job_files, out, extra=["--epochs", "50000", "--timeout", "5"])
+    deadline = time.monotonic() + 150  # >> 5s timeout, << a restart loop
+    state = {}
+    while time.monotonic() < deadline:
+        r = _cli(["status", str(out)])
+        state = json.loads(r.stdout.strip().splitlines()[-1])
+        if state["state"] in ("FINISHED", "FAILED", "DEAD"):
+            break
+        time.sleep(1)
+    log = (out / "supervisor.log")
+    assert state["state"] == "FAILED", (
+        state, log.read_text() if log.exists() else "no log")
+    assert state["exit"] == 3  # EXIT_TIMEOUT, recorded as the job's report
+
+
+@pytest.mark.slow
+def test_detached_daemon_unclean_death_reports_dead(job_files):
+    """SIGKILL the daemon directly (no chance to write job.status): status
+    must report DEAD — never RUNNING (stale pid) or FINISHED."""
+    out = job_files / "out_u"
+    _submit(job_files, out, extra=["--epochs", "50000"])
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and not (out / "console.board").exists():
+        time.sleep(0.5)
+    assert (out / "console.board").exists(), "job never started"
+    pid = json.loads((out / "job.json").read_text())["pid"]
+    os.killpg(pid, signal.SIGKILL)
+    deadline = time.monotonic() + 30
+    state = {}
+    while time.monotonic() < deadline:
+        r = _cli(["status", str(out)])
+        state = json.loads(r.stdout.strip().splitlines()[-1])
+        if state["state"] != "RUNNING":
+            break
+        time.sleep(0.5)
+    assert state["state"] == "DEAD", state
+    assert state.get("exit") is None
