@@ -543,7 +543,7 @@ def main() -> None:
     # model ladder (configs 2-5); each rung pays a compile, so the whole
     # ladder runs by default but can be skipped with SHIFU_TPU_BENCH_FAST
     if os.environ.get("SHIFU_TPU_BENCH_FAST"):
-        pass  # fast mode skips the ladder regardless of budget
+        extras["ladder_skipped"] = "SHIFU_TPU_BENCH_FAST"
     elif _past_deadline():
         extras["ladder_skipped"] = "soft deadline (SHIFU_TPU_BENCH_DEADLINE)"
     else:

@@ -157,7 +157,13 @@ def test_detached_daemon_unclean_death_reports_dead(job_files):
         time.sleep(0.5)
     assert (out / "console.board").exists(), "job never started"
     pid = json.loads((out / "job.json").read_text())["pid"]
-    os.killpg(pid, signal.SIGKILL)
+    try:
+        os.killpg(pid, signal.SIGKILL)
+    except ProcessLookupError:
+        log = out / "supervisor.log"
+        raise AssertionError(
+            "daemon died before the test could SIGKILL it: "
+            + (log.read_text()[-2000:] if log.exists() else "no log"))
     deadline = time.monotonic() + 30
     state = {}
     while time.monotonic() < deadline:

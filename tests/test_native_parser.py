@@ -201,3 +201,39 @@ def test_tab_delimiter_empty_cells_align():
         np.testing.assert_array_equal(np.isnan(nat), np.isnan(py), err_msg=payload)
         np.testing.assert_array_equal(np.nan_to_num(nat), np.nan_to_num(py),
                                       err_msg=payload)
+
+
+def test_fuzz_garbage_inputs_never_crash(tmp_path):
+    """Adversarial ingest: random binary junk, truncated gzip, embedded
+    NULs, absurd tokens — every case must surface a Python exception (or
+    parse to SOME matrix) and never kill the process.  The native tier is
+    C++: a segfault here would take the whole trainer down."""
+    import gzip as gz
+
+    rng = np.random.default_rng(99)
+    cases = []
+    # 1: pure random bytes with a .gz name (bad magic)
+    cases.append(("junk.gz", rng.integers(0, 256, 4096, dtype=np.uint8)
+                  .tobytes()))
+    # 2: valid gzip wrapping random binary (decodes, then tokenizes junk)
+    cases.append(("bin.gz", gz.compress(
+        rng.integers(0, 256, 8192, dtype=np.uint8).tobytes())))
+    # 3: truncated gzip (valid header, cut mid-stream)
+    full = gz.compress(b"1|2|3\n" * 500)
+    cases.append(("trunc.gz", full[: len(full) // 2]))
+    # 4: plain text with NUL bytes, huge exponents, empty fields, long line
+    weird = (b"1\x002|3|\xff\xfe|1e999999|-inf|nan||5\n"
+             + b"|".join(b"9" * 4000 for _ in range(40)) + b"\n")
+    cases.append(("weird.psv", weird))
+    # 5: empty file and delimiter-only lines
+    cases.append(("empty.psv", b""))
+    cases.append(("delims.psv", b"|||||\n|||||\n"))
+    for name, payload in cases:
+        p = tmp_path / name
+        p.write_bytes(payload)
+        try:
+            out = native_parser.parse_file(str(p))
+            assert out is None or hasattr(out, "shape"), (name, type(out))
+        except Exception as e:  # controlled failure is the contract
+            assert isinstance(e, (ValueError, OSError, RuntimeError)), (
+                name, type(e), e)
