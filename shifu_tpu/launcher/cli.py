@@ -994,7 +994,64 @@ def run_export(args) -> int:
     return EXIT_OK
 
 
+def _arm_pdeathsig() -> None:
+    """Supervised attempt children die with their supervisor.
+
+    The supervisor spawns attempts in their OWN session (so kill-tree
+    reaches the gang), which also detaches them from the supervisor's
+    fate: a SIGTERM is forwarded by handler, but an UNCATCHABLE
+    supervisor death (SIGKILL, OOM kill) would orphan the attempt to
+    train its full epoch budget alone — observed as a 50k-epoch child
+    spinning after its detached daemon was SIGKILLed.  When the
+    supervisor marks the environment (supervisor.ENV_PDEATHSIG = its own
+    pid), arm Linux PR_SET_PDEATHSIG(SIGTERM) so the kernel itself
+    delivers the drain signal on parent death; SIGTERM (not SIGKILL) so
+    the train loop's drain still checkpoints.  Closes the fork->arm race
+    by self-signaling when os.getppid() no longer matches the recorded
+    spawner — a pid compare, not a `== 1` check, so a supervisor that
+    legitimately IS pid 1 (container entrypoint) or a subreaper
+    environment cannot false-positive.
+    """
+    # literal env name: supervisor.ENV_PDEATHSIG (kept in sync by
+    # tests/test_launcher.py); the cold path (status/attach/kill polls)
+    # must not import the supervisor module just to read this.
+    # Value: "<spawner_pid>" or "<spawner_pid>:<signum>".  The spawner
+    # picks the signal: SIGTERM (default) for a single supervised child
+    # whose drain handler checkpoints; SIGKILL for gang ranks — a rank
+    # must terminate IMMEDIATELY on dispatcher death (divergent drains
+    # deadlock collectives, train/loop.py), and libraries in the rank
+    # (orbax preemption hooks) register SIGTERM handlers that would
+    # swallow a catchable signal and leave the rank training forever.
+    # pop, don't read: the arm applies to THIS process only, and any
+    # descendant spawned with inherited env (a hook shelling out to
+    # `shifu-tpu export`, a rank, a nested dispatcher) would otherwise see
+    # a stale parent pid, fail the getppid compare, and self-kill at
+    # startup; spawners that want armed children set the var fresh
+    val = os.environ.pop("SHIFU_TPU_PDEATHSIG", None)
+    if not val or sys.platform != "linux":
+        return
+    try:
+        import signal as signal_lib
+
+        parts = val.split(":")
+        expected_parent = int(parts[0])
+        sig = int(parts[1]) if len(parts) > 1 else int(signal_lib.SIGTERM)
+    except ValueError:
+        return
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.prctl(1, sig, 0, 0, 0)  # 1 = PR_SET_PDEATHSIG
+        if os.getppid() != expected_parent:
+            # parent died (or we were reparented) before the arm landed
+            os.kill(os.getpid(), sig)
+    except Exception:
+        pass  # best-effort hardening; never block startup
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    _arm_pdeathsig()
     _apply_platform_env()
     args = build_parser().parse_args(argv)
     if args.command in ("train", "score", "eval", "export"):

@@ -126,6 +126,19 @@ def _host_command(spec: PodSpec, rank: int, child_args: Sequence[str],
     if spec.transport == "local":
         env = dict(os.environ)
         env.update(env_contract)
+        # ranks die with THIS dispatcher even on its uncatchable death
+        # (cli._arm_pdeathsig).  SIGKILL, not SIGTERM: a rank must stop
+        # IMMEDIATELY (divergent drains deadlock gang collectives), and
+        # rank-side libraries register SIGTERM handlers that would swallow
+        # a catchable signal.  Set per-spawn — an inherited value from an
+        # armed ancestor would record the WRONG parent pid and self-kill
+        # the rank at startup.  ssh transport must NOT carry this: the
+        # dispatcher pid is meaningless on the remote host (the ssh -tt
+        # HUP tether covers remote parent-death there).
+        import signal as signal_lib
+
+        from .supervisor import ENV_PDEATHSIG
+        env[ENV_PDEATHSIG] = f"{os.getpid()}:{int(signal_lib.SIGKILL)}"
         return [sys.executable, *module_argv], env
     assigns = [f"{k}={v}" for k, v in env_contract.items()]
     remote = " ".join(

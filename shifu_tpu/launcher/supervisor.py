@@ -33,6 +33,15 @@ from typing import Optional, Sequence
 # (progress resets the restart budget) and re-derives a fresh deadline.
 EXIT_TIMEOUT = 3
 
+# set on supervised attempt children (VALUE = the spawning parent's pid):
+# cli._arm_pdeathsig reads it and arms PR_SET_PDEATHSIG(SIGTERM), so even
+# an uncatchable supervisor death (SIGKILL / OOM kill) tears the training
+# attempt down instead of leaving it spinning in its own session.  The pid
+# value (not a bare flag) lets the child close the fork->arm race by
+# comparing os.getppid() — correct even when the recorded parent is
+# legitimately pid 1 (container entrypoint) or under a subreaper
+ENV_PDEATHSIG = "SHIFU_TPU_PDEATHSIG"
+
 
 def _marker_epoch(ckpt_dir: str) -> int:
     """Epoch from the `PROGRESS` marker file (-1 if absent/unreadable);
@@ -314,7 +323,15 @@ def supervise(child_argv: Sequence[str],
             attempts += 1
             start = time.monotonic()
             probe = ProgressProbe(checkpoint_dir)
-            proc = subprocess.Popen(cmd, start_new_session=True)
+            # the child arms PR_SET_PDEATHSIG against THIS process at its
+            # startup (cli._arm_pdeathsig): an UNCATCHABLE supervisor death
+            # (SIGKILL, OOM kill) must not orphan a training process in its
+            # own session to spin forever — observed exactly that when a
+            # detached daemon was SIGKILLed out from under its attempt
+            child_env = dict(os.environ)
+            child_env[ENV_PDEATHSIG] = str(os.getpid())
+            proc = subprocess.Popen(cmd, start_new_session=True,
+                                    env=child_env)
             last_size = -1
             last_progress = time.monotonic()
             killed_for_hang = False

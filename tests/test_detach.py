@@ -147,6 +147,8 @@ def test_detached_timeout_is_terminal_and_reported(job_files):
 
 
 @pytest.mark.slow
+@pytest.mark.skipif(sys.platform != "linux",
+                    reason="pdeathsig reaping + /proc scan are Linux-only")
 def test_detached_daemon_unclean_death_reports_dead(job_files):
     """SIGKILL the daemon directly (no chance to write job.status): status
     must report DEAD — never RUNNING (stale pid) or FINISHED."""
@@ -174,3 +176,60 @@ def test_detached_daemon_unclean_death_reports_dead(job_files):
         time.sleep(0.5)
     assert state["state"] == "DEAD", state
     assert state.get("exit") is None
+    # NO SURVIVORS: the supervised attempt runs in its own session, so the
+    # daemon's SIGKILL cannot reach it by group — PR_SET_PDEATHSIG must
+    # reap it (without it, a 50000-epoch orphan spins at full CPU forever)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if not _procs_mentioning(str(out)):
+            break
+        time.sleep(0.5)
+    leftovers = _procs_mentioning(str(out))
+    assert not leftovers, f"orphaned training processes: {leftovers}"
+
+
+def _procs_mentioning(needle: str) -> list[int]:
+    """Pids (other than ours) whose cmdline contains `needle`."""
+    out = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == os.getpid():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                if needle.encode() in f.read():
+                    out.append(int(pid))
+        except OSError:
+            continue
+    return out
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(sys.platform != "linux",
+                    reason="pdeathsig reaping + /proc scan are Linux-only")
+def test_detached_multiprocess_unclean_death_no_survivors(job_files):
+    """The pod-rank variant of the orphan hazard: SIGKILL the daemon of a
+    --num-processes gang; the attempt dispatcher AND every rank must be
+    reaped (ranks arm PR_SET_PDEATHSIG against the dispatcher, the
+    dispatcher against the supervisor)."""
+    out = job_files / "out_mp"
+    _submit(job_files, out,
+            extra=["--epochs", "50000", "--num-processes", "2"])
+    deadline = time.monotonic() + 150
+    while time.monotonic() < deadline and not (out / "console.board").exists():
+        time.sleep(0.5)
+    assert (out / "console.board").exists(), "gang never started"
+    pid = json.loads((out / "job.json").read_text())["pid"]
+    try:
+        os.killpg(pid, signal.SIGKILL)
+    except ProcessLookupError:
+        log = out / "supervisor.log"
+        raise AssertionError(
+            "daemon died before the test could SIGKILL it: "
+            + (log.read_text()[-2000:] if log.exists() else "no log"))
+    deadline = time.monotonic() + 45
+    while time.monotonic() < deadline:
+        if not _procs_mentioning(str(out)):
+            break
+        time.sleep(0.5)
+    leftovers = _procs_mentioning(str(out))
+    assert not leftovers, f"orphaned gang processes: {leftovers}"

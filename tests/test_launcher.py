@@ -669,3 +669,14 @@ def test_score_cli_bad_native_artifact_reports(tmp_path, small_job):
     rc = cli.main(["score", "--model", art, "--input", str(inp),
                    "--engine", "native"])
     assert rc == 1
+
+
+def test_pdeathsig_env_name_in_sync():
+    """cli._arm_pdeathsig reads the env var by literal name (the cold
+    status/attach/kill path must not import the supervisor module); the
+    literal must match supervisor.ENV_PDEATHSIG."""
+    import inspect
+
+    from shifu_tpu.launcher import cli, supervisor
+    assert supervisor.ENV_PDEATHSIG == "SHIFU_TPU_PDEATHSIG"
+    assert '"SHIFU_TPU_PDEATHSIG"' in inspect.getsource(cli._arm_pdeathsig)
