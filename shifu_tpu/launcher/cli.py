@@ -442,8 +442,13 @@ def run_train(args) -> int:
             return run_train(args)
 
         try:
+            # marker in the job dir: an UNCLEAN dispatcher death between
+            # create and release must leave a trail `kill <job_dir>` (or
+            # an operator) can release from — see provision.write_marker
+            args.output = _resolve_out_dir(args)
             return prov.provision_and_run(
-                spec, _dispatch, keep=getattr(args, "keep_slice", False))
+                spec, _dispatch, keep=getattr(args, "keep_slice", False),
+                marker_dir=args.output)
         except prov.ProvisionError as e:
             print(f"provision: {e}", file=sys.stderr, flush=True)
             return EXIT_FAIL

@@ -124,6 +124,13 @@ def job_state(out_dir: str) -> dict:
     out: dict = {"job_dir": out_dir}
     if job:
         out.update(pid=job.get("pid"), submitted_at=job.get("submitted_at"))
+    try:  # surface an acquired-but-unreleased slice (provision.json)
+        from .provision import read_marker
+        marker = read_marker(out_dir)
+        if marker and marker.get("name"):
+            out["provisioned_slice"] = marker["name"]
+    except Exception:
+        pass
     if status is not None:
         rc = int(status.get("exit", 1))
         out.update(state="FINISHED" if rc == 0 else "FAILED", exit=rc,
@@ -203,22 +210,45 @@ def attach(out_dir: str, echo=print, poll_seconds: float = 0.5,
         return 0  # stop following; the job keeps running
 
 
+def _release_slice(out_dir: str, echo) -> None:
+    """Best-effort release of a provisioned slice the job dir records —
+    killing the application frees its compute (YARN-RM parity), and an
+    unclean dispatcher death must not leave a billing TPU behind."""
+    try:
+        from .provision import release_from_marker
+        release_from_marker(out_dir, echo=echo)
+    except Exception as e:
+        echo(f"provision: release check failed ({e}); see provision.json "
+             f"in {out_dir}")
+
+
 def kill(out_dir: str, echo=print, grace_seconds: float = 10.0) -> int:
     """SIGTERM the detached dispatcher's process group (it is a session
     leader, so the whole supervisor->gang tree drains), escalating to
-    SIGKILL; the client-side 'kill application' the reference had."""
+    SIGKILL; the client-side 'kill application' the reference had.  Also
+    releases a provisioned slice the job dir records (provision.json) —
+    including one left behind by an earlier unclean daemon death."""
     job = _read_json(os.path.join(out_dir, JOB_FILE))
     if not job or not isinstance(job.get("pid"), int):
         echo(f"no submitted job under {out_dir}")
+        # a FOREGROUND --provision run writes no job.json but may have
+        # left a provision.json trail (unclean dispatcher death) — the
+        # rescue release must still run
+        _release_slice(out_dir, echo)
         return 1
     pid = job["pid"]
     if not _alive(pid):
         echo(f"job pid {pid} is not running")
+        _release_slice(out_dir, echo)
         return 0
     if not _is_our_job(pid, job):
         echo(f"pid {pid} is not this job's dispatcher (recycled pid or a "
              f"different host — job.json says {job.get('host')!r}); "
              "refusing to signal it")
+        if not (job.get("host") and job["host"] != os.uname().nodename):
+            # same host, recycled pid: the dispatcher is truly gone — a
+            # recorded slice can still be released safely
+            _release_slice(out_dir, echo)
         return 1
     try:
         os.killpg(pid, signal.SIGTERM)
@@ -231,6 +261,7 @@ def kill(out_dir: str, echo=print, grace_seconds: float = 10.0) -> int:
     while time.monotonic() < deadline:
         if not _alive(pid):
             echo(f"job pid {pid} terminated")
+            _release_slice(out_dir, echo)
             return 0
         time.sleep(0.2)
     try:
@@ -238,4 +269,5 @@ def kill(out_dir: str, echo=print, grace_seconds: float = 10.0) -> int:
     except (ProcessLookupError, PermissionError, OSError):
         pass
     echo(f"job pid {pid} killed")
+    _release_slice(out_dir, echo)
     return 0

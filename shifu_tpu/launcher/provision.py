@@ -21,6 +21,7 @@ on PATH — the same technique as the fake-ssh transport e2e.
 from __future__ import annotations
 
 import json
+import os
 import shutil
 import subprocess
 import time
@@ -185,28 +186,101 @@ def worker_hosts(spec: ProvisionSpec) -> list[str]:
     return hosts
 
 
-def delete(spec: ProvisionSpec, echo=print) -> None:
+def delete(spec: ProvisionSpec, echo=print) -> bool:
     """Release the slice (idempotent best-effort: releasing twice or
-    releasing a failed create must not mask the original error)."""
+    releasing a failed create must not mask the original error).  Returns
+    True when gcloud accepted the delete — callers keeping a release
+    trail (the provision.json marker) must NOT clear it on False."""
     try:
         _run(["compute", "tpus", "queued-resources", "delete", spec.name,
               *_common(spec), "--quiet", "--force"])
         echo(f"provision: released {spec.name}")
+        return True
     except ProvisionError as e:
         echo(f"provision: release of {spec.name} failed ({e}); release "
              "manually with `gcloud compute tpus queued-resources delete`")
+        return False
+
+
+MARKER_FILE = "provision.json"
+
+
+def write_marker(spec: ProvisionSpec, out_dir: str, keep: bool = False,
+                 echo=print) -> None:
+    """Durable record of the acquired slice in the JOB DIR: if the
+    provisioning dispatcher dies uncleanly (SIGKILL, host loss) between
+    create and release, the billing slice would otherwise leak with no
+    record outside `gcloud list` — the marker lets `kill <job_dir>` (and
+    an operator reading the dir) find and release it.  Best-effort and
+    local-only: a remote job dir keeps its authoritative state in gcloud
+    itself."""
+    try:
+        from ..data import fsio
+        if fsio.is_remote(out_dir):
+            return
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, MARKER_FILE), "w") as f:
+            json.dump({"name": spec.name, "zone": spec.zone,
+                       "project": spec.project, "keep": bool(keep),
+                       "created_at": time.time()}, f)
+    except Exception as e:  # never fail the job for bookkeeping
+        echo(f"provision: could not record {MARKER_FILE} ({e})")
+
+
+def clear_marker(out_dir: str) -> None:
+    try:
+        os.unlink(os.path.join(out_dir, MARKER_FILE))
+    except OSError:
+        pass
+
+
+def read_marker(out_dir: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(out_dir, MARKER_FILE)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def release_from_marker(out_dir: str, echo=print) -> bool:
+    """Release the slice a marker records (used by `kill <job_dir>` —
+    YARN parity: killing the application frees its containers).  Returns
+    True when a release was attempted and the marker cleared; a marker
+    with keep=True is respected and left in place."""
+    marker = read_marker(out_dir)
+    if not marker or not marker.get("name"):
+        return False
+    if marker.get("keep"):
+        echo(f"provision: slice {marker['name']!r} was kept deliberately "
+             "(--keep-slice); not releasing")
+        return False
+    spec = ProvisionSpec(name=marker["name"],
+                         accelerator_type="-",  # delete needs name+zone only
+                         zone=marker.get("zone", ""),
+                         project=marker.get("project", ""))
+    if delete(spec, echo=echo):
+        clear_marker(out_dir)  # gcloud REFUSED -> keep the release trail
+        return True
+    return False
 
 
 def provision_and_run(spec: ProvisionSpec,
                       run_fn: Callable[[list[str]], int],
                       echo=print,
-                      keep: bool = False) -> int:
+                      keep: bool = False,
+                      marker_dir: Optional[str] = None) -> int:
     """The one-command lifecycle: nothing -> slice -> gang -> released.
 
     `run_fn(hosts)` runs the job (the pod dispatch) once the slice is
     ACTIVE; the slice is released on EVERY exit path unless `keep` (a
     failed run must not leak a billing TPU — the YARN analog was the RM
-    reclaiming containers when the app died)."""
+    reclaiming containers when the app died).  `marker_dir` records the
+    acquisition durably so even an UNCLEAN dispatcher death leaves a
+    release trail (write_marker) — written BEFORE the create call, so a
+    death mid-create still leaves the trail (a marker for a slice that
+    never materialized is harmless: delete is idempotent best-effort)."""
+    if marker_dir:
+        write_marker(spec, marker_dir, keep=keep, echo=echo)
     create(spec, echo=echo)
     try:
         await_ready(spec, echo=echo)
@@ -216,5 +290,5 @@ def provision_and_run(spec: ProvisionSpec,
     finally:
         if keep:
             echo(f"provision: keeping {spec.name} (--keep-slice)")
-        else:
-            delete(spec, echo=echo)
+        elif delete(spec, echo=echo) and marker_dir:
+            clear_marker(marker_dir)

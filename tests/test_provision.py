@@ -34,7 +34,7 @@ if "tpu-vm describe" in cmd:
         {{"ipAddress": "localhost"}}, {{"ipAddress": "localhost"}}]}}))
     sys.exit(0)
 if "queued-resources delete" in cmd:
-    sys.exit(0)
+    sys.exit(1 if os.environ.get("FAKE_GCLOUD_FAIL_DELETE") else 0)
 sys.exit(64)
 """
 
@@ -199,3 +199,96 @@ def test_train_provision_end_to_end(tmp_path):
     calls = [json.loads(l)
              for l in (tmp_path / "gcloud.log").read_text().splitlines()]
     assert calls[0][3] == "create" and calls[-1][3] == "delete"
+
+
+def test_marker_written_during_run_and_cleared_after(fake_gcloud, tmp_path):
+    """provision_and_run records the acquisition in the job dir while the
+    job runs (the release trail an unclean dispatcher death needs) and
+    clears it after the normal release."""
+    from shifu_tpu.launcher import provision as prov
+
+    spec = prov.ProvisionSpec(name="m1", accelerator_type="v5litepod-8",
+                              zone="us-west4-a")
+    out = tmp_path / "job"
+    seen = {}
+
+    def run_fn(hosts):
+        seen["marker"] = prov.read_marker(str(out))
+        return 0
+
+    rc = prov.provision_and_run(spec, run_fn, echo=lambda s: None,
+                                marker_dir=str(out))
+    assert rc == 0
+    assert seen["marker"]["name"] == "m1"
+    assert seen["marker"]["zone"] == "us-west4-a"
+    assert prov.read_marker(str(out)) is None  # cleared on release
+
+
+def test_marker_kept_slice_respected(fake_gcloud, tmp_path):
+    """--keep-slice: the marker stays (flagged) and release_from_marker
+    refuses to delete a deliberately kept slice."""
+    from shifu_tpu.launcher import provision as prov
+
+    spec = prov.ProvisionSpec(name="m2", accelerator_type="v5litepod-8",
+                              zone="us-west4-a")
+    out = tmp_path / "jobk"
+    rc = prov.provision_and_run(spec, lambda hosts: 0, echo=lambda s: None,
+                                keep=True, marker_dir=str(out))
+    assert rc == 0
+    marker = prov.read_marker(str(out))
+    assert marker and marker["keep"] is True
+    assert prov.release_from_marker(str(out), echo=lambda s: None) is False
+    assert prov.read_marker(str(out)) is not None  # still recorded
+
+
+def test_kill_releases_slice_after_unclean_daemon_death(fake_gcloud,
+                                                       tmp_path, monkeypatch):
+    """A provisioning daemon SIGKILLed between create and release leaks a
+    billing slice with only provision.json as the trail: `kill <job_dir>`
+    must find it, release through gcloud, and clear the marker."""
+    import json as _json
+
+    from shifu_tpu.launcher import detach, provision as prov
+
+    fake_bin, log = fake_gcloud
+    out = tmp_path / "leaked"
+    out.mkdir()
+    spec = prov.ProvisionSpec(name="leaked-slice",
+                              accelerator_type="v5litepod-8",
+                              zone="us-west4-a", project="p1")
+    prov.write_marker(spec, str(out))
+    # a GUARANTEED-dead pid: spawn and reap a real child (a hardcoded
+    # large pid can be live under raised kernel.pid_max)
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait()
+    (out / detach.JOB_FILE).write_text(_json.dumps(
+        {"pid": dead.pid, "host": os.uname().nodename}))
+    msgs = []
+    rc = detach.kill(str(out), echo=msgs.append)
+    assert rc == 0
+    assert any("released leaked-slice" in m for m in msgs), msgs
+    assert prov.read_marker(str(out)) is None
+    deletes = [c for c in _calls(log) if "delete" in c]
+    assert deletes and "leaked-slice" in deletes[-1]
+    assert "--project" in deletes[-1] and "p1" in deletes[-1]
+    # status surfaces nothing anymore; before the release it would have
+    prov.write_marker(spec, str(out))
+    st = detach.job_state(str(out))
+    assert st["provisioned_slice"] == "leaked-slice"
+
+
+def test_release_failure_keeps_marker(fake_gcloud, tmp_path, monkeypatch):
+    """A failed gcloud delete must NOT clear provision.json — the marker is
+    the only release trail for a still-billing slice."""
+    from shifu_tpu.launcher import provision as prov
+
+    out = tmp_path / "failrel"
+    spec = prov.ProvisionSpec(name="sticky", accelerator_type="v5litepod-8",
+                              zone="us-west4-a")
+    prov.write_marker(spec, str(out))
+    monkeypatch.setenv("FAKE_GCLOUD_FAIL_DELETE", "1")
+    assert prov.release_from_marker(str(out), echo=lambda s: None) is False
+    assert prov.read_marker(str(out)) is not None  # trail preserved
+    monkeypatch.delenv("FAKE_GCLOUD_FAIL_DELETE")
+    assert prov.release_from_marker(str(out), echo=lambda s: None) is True
+    assert prov.read_marker(str(out)) is None
