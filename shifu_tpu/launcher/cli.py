@@ -441,6 +441,22 @@ def run_train(args) -> int:
             args.provision = False  # re-entry takes the pod branch below
             return run_train(args)
 
+        # a scheduler SIGTERM mid-lifecycle would terminate Python WITHOUT
+        # running finally blocks (default disposition) — the release in
+        # provision_and_run's finally must still run, so SIGTERM raises
+        # SystemExit for the duration (the marker covers SIGKILL; this
+        # covers the catchable case without waiting for a manual `kill`)
+        import signal as signal_lib
+
+        def _term_to_exit(signum, frame):
+            raise SystemExit(128 + signum)
+
+        old_term, installed = None, False
+        try:
+            old_term = signal_lib.signal(signal_lib.SIGTERM, _term_to_exit)
+            installed = True  # old_term may be None (C-installed handler)
+        except ValueError:
+            pass  # non-main thread: no handler; the marker still covers it
         try:
             # marker in the job dir: an UNCLEAN dispatcher death between
             # create and release must leave a trail `kill <job_dir>` (or
@@ -452,6 +468,11 @@ def run_train(args) -> int:
         except prov.ProvisionError as e:
             print(f"provision: {e}", file=sys.stderr, flush=True)
             return EXIT_FAIL
+        finally:
+            if installed:
+                signal_lib.signal(signal_lib.SIGTERM,
+                                  old_term if old_term is not None
+                                  else signal_lib.SIG_DFL)
 
     if pod_hosts and ENV_PROCESS_ID not in os.environ:
         try:
@@ -1067,12 +1088,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from ..utils.compilecache import enable_persistent_cache
         enable_persistent_cache()
     if args.command == "train":
-        rc = run_train(args)
         # daemonized dispatcher: record the terminal state for `status`
+        # even when the run unwinds via SystemExit (the provision branch
+        # turns a scheduler SIGTERM into one so release finallys run) —
+        # a cleanly drained kill must read as FAILED(143), not DEAD
         from . import detach as detach_lib
         detached_dir = os.environ.get(detach_lib.ENV_DETACHED)
-        if detached_dir and not getattr(args, "detach", False):
-            detach_lib.write_status(detached_dir, rc)
+
+        def _record(rc: int) -> None:
+            if detached_dir and not getattr(args, "detach", False):
+                detach_lib.write_status(detached_dir, rc)
+
+        try:
+            rc = run_train(args)
+        except SystemExit as e:
+            _record(e.code if isinstance(e.code, int) else 1)
+            raise
+        _record(rc)
         return rc
     if args.command == "score":
         return run_score(args)

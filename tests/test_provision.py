@@ -292,3 +292,67 @@ def test_release_failure_keeps_marker(fake_gcloud, tmp_path, monkeypatch):
     monkeypatch.delenv("FAKE_GCLOUD_FAIL_DELETE")
     assert prov.release_from_marker(str(out), echo=lambda s: None) is True
     assert prov.read_marker(str(out)) is None
+
+
+@pytest.mark.slow
+def test_foreground_sigterm_releases_slice(tmp_path):
+    """SIGTERM a FOREGROUND `train --provision` while it awaits capacity:
+    Python's default SIGTERM disposition would skip finally blocks and
+    leak the slice — the CLI's handler must turn it into an unwind so the
+    release still runs (and the marker is cleared)."""
+    import signal as signal_lib
+    import time as time_lib
+
+    fake_bin = tmp_path / "bin"
+    fake_bin.mkdir()
+    (fake_bin / "gcloud").write_text(_FAKE_GCLOUD)
+    (fake_bin / "gcloud").chmod(0o755)
+    (tmp_path / "ModelConfig.json").write_text(json.dumps(
+        {"dataSet": {"targetColumnName": "target"},
+         "train": {"numTrainEpochs": 1, "algorithm": "NN",
+                   "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [4],
+                              "ActivationFunc": ["relu"]}}}))
+    (tmp_path / "ColumnConfig.json").write_text(json.dumps(
+        [{"columnNum": 0, "columnName": "target", "columnFlag": "Target"},
+         {"columnNum": 1, "columnName": "f1", "columnType": "N",
+          "finalSelect": True}]))
+    (tmp_path / "data").mkdir()
+    (tmp_path / "data" / "part-0.psv").write_text("1|0.5\n0|0.1\n")
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env.update({"SHIFU_TPU_PLATFORM": "cpu", "SHIFU_TPU_CPU_DEVICES": "1",
+                "PATH": f"{fake_bin}{os.pathsep}{env.get('PATH', '')}",
+                "FAKE_GCLOUD_LOG": str(tmp_path / "gcloud.log"),
+                "FAKE_GCLOUD_STATE": str(tmp_path / "gcloud.state"),
+                # hold in the capacity queue so SIGTERM lands mid-await
+                "FAKE_GCLOUD_STATES": "WAITING_FOR_RESOURCES",
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", "")})
+    out = tmp_path / "job"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "shifu_tpu.launcher.cli", "train",
+         "--modelconfig", str(tmp_path / "ModelConfig.json"),
+         "--columnconfig", str(tmp_path / "ColumnConfig.json"),
+         "--data", str(tmp_path / "data"), "--output", str(out),
+         "--provision", "--provision-name", "sigterm-slice",
+         "--accelerator-type", "v5litepod-8", "--zone", "us-west4-a"],
+        env=env, cwd=str(tmp_path))
+    log = tmp_path / "gcloud.log"
+    try:
+        deadline = time_lib.monotonic() + 120
+        while time_lib.monotonic() < deadline:
+            if any("describe" in c for c in _calls(log)):
+                break
+            time_lib.sleep(0.2)
+        assert any("describe" in c for c in _calls(log)), "never reached await"
+        proc.send_signal(signal_lib.SIGTERM)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:  # any assert/timeout: never leak the child
+            proc.kill()
+            proc.wait()
+    assert rc == 128 + signal_lib.SIGTERM, rc
+    calls = _calls(log)
+    deletes = [c for c in calls if "delete" in c]
+    assert deletes and "sigterm-slice" in deletes[-1], calls[-3:]
+    assert not (out / "provision.json").exists()
