@@ -110,6 +110,9 @@ def build_parser() -> argparse.ArgumentParser:
                                      "process tree (SIGTERM drain, then "
                                      "SIGKILL)")
     kl.add_argument("job_dir")
+    kl.add_argument("--force", action="store_true",
+                    help="release a provisioned slice even when the marker "
+                         "records a live foreground dispatcher")
 
     s = sub.add_parser("score", help="score rows with an exported artifact")
     s.add_argument("--model", required=True, help="artifact dir")
@@ -449,6 +452,12 @@ def run_train(args) -> int:
         import signal as signal_lib
 
         def _term_to_exit(signum, frame):
+            # first SIGTERM starts the unwind; LATER ones are ignored until
+            # the finally restores the disposition — schedulers often repeat
+            # SIGTERM on a cadence, and a second signal landing inside the
+            # release's own gcloud call would abort the delete and leak the
+            # slice the unwind exists to release
+            signal_lib.signal(signal_lib.SIGTERM, signal_lib.SIG_IGN)
             raise SystemExit(128 + signum)
 
         old_term, installed = None, False
@@ -1120,7 +1129,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.command == "attach":
         return detach_lib.attach(args.job_dir, from_start=not args.tail)
     if args.command == "kill":
-        return detach_lib.kill(args.job_dir)
+        return detach_lib.kill(args.job_dir,
+                               force=getattr(args, "force", False))
     return EXIT_FAIL
 
 

@@ -222,7 +222,8 @@ def _release_slice(out_dir: str, echo) -> None:
              f"in {out_dir}")
 
 
-def kill(out_dir: str, echo=print, grace_seconds: float = 10.0) -> int:
+def kill(out_dir: str, echo=print, grace_seconds: float = 10.0,
+         force: bool = False) -> int:
     """SIGTERM the detached dispatcher's process group (it is a session
     leader, so the whole supervisor->gang tree drains), escalating to
     SIGKILL; the client-side 'kill application' the reference had.  Also
@@ -233,7 +234,31 @@ def kill(out_dir: str, echo=print, grace_seconds: float = 10.0) -> int:
         echo(f"no submitted job under {out_dir}")
         # a FOREGROUND --provision run writes no job.json but may have
         # left a provision.json trail (unclean dispatcher death) — the
-        # rescue release must still run
+        # rescue release must still run.  But if the marker's recorded
+        # dispatcher is STILL ALIVE (a foreground run mid-training), a
+        # stray `kill` must not delete the slice out from under the live
+        # gang: refuse unless --force.
+        try:
+            from .provision import read_marker
+            marker = read_marker(out_dir)
+        except Exception:
+            marker = None
+        mpid = marker.get("pid") if marker else None
+        mhost = marker.get("host") if marker else None
+        if (not force and mhost and mhost != os.uname().nodename):
+            # shared-filesystem job dir: the dispatcher may be ALIVE on the
+            # recording host and this host's pid table says nothing about
+            # it — mirror the job.json path's host-mismatch refusal
+            echo(f"provision marker was written on {mhost!r} — run kill "
+                 "there (its pid table can check dispatcher liveness) or "
+                 "re-run with --force")
+            return 1
+        if (not force and isinstance(mpid, int) and _alive(mpid)
+                and _is_our_job(mpid, marker)):
+            echo(f"provision marker records a LIVE dispatcher (pid {mpid}) "
+                 "— a foreground --provision run is still using the slice; "
+                 "SIGTERM that process (or re-run with --force) instead")
+            return 1
         _release_slice(out_dir, echo)
         return 1
     pid = job["pid"]

@@ -190,13 +190,22 @@ def delete(spec: ProvisionSpec, echo=print) -> bool:
     """Release the slice (idempotent best-effort: releasing twice or
     releasing a failed create must not mask the original error).  Returns
     True when gcloud accepted the delete — callers keeping a release
-    trail (the provision.json marker) must NOT clear it on False."""
+    trail (the provision.json marker) must NOT clear it on False.
+
+    A NOT_FOUND answer counts as a successful release: the resource never
+    materialized (create itself failed) or is already gone, and either way
+    there is nothing left to bill — treating it as failure would pin the
+    marker forever and make every later `kill` retry a delete that can
+    never succeed."""
     try:
         _run(["compute", "tpus", "queued-resources", "delete", spec.name,
               *_common(spec), "--quiet", "--force"])
         echo(f"provision: released {spec.name}")
         return True
     except ProvisionError as e:
+        if "NOT_FOUND" in str(e) or "not found" in str(e).lower():
+            echo(f"provision: {spec.name} not found — nothing to release")
+            return True
         echo(f"provision: release of {spec.name} failed ({e}); release "
              "manually with `gcloud compute tpus queued-resources delete`")
         return False
@@ -219,9 +228,13 @@ def write_marker(spec: ProvisionSpec, out_dir: str, keep: bool = False,
         if fsio.is_remote(out_dir):
             return
         os.makedirs(out_dir, exist_ok=True)
+        # the dispatcher's pid+host let a later `kill <job_dir>` tell a
+        # LIVE foreground provision run (which writes no job.json) from a
+        # dead one before releasing the slice out from under a gang
         with open(os.path.join(out_dir, MARKER_FILE), "w") as f:
             json.dump({"name": spec.name, "zone": spec.zone,
                        "project": spec.project, "keep": bool(keep),
+                       "pid": os.getpid(), "host": os.uname().nodename,
                        "created_at": time.time()}, f)
     except Exception as e:  # never fail the job for bookkeeping
         echo(f"provision: could not record {MARKER_FILE} ({e})")
@@ -278,17 +291,35 @@ def provision_and_run(spec: ProvisionSpec,
     acquisition durably so even an UNCLEAN dispatcher death leaves a
     release trail (write_marker) — written BEFORE the create call, so a
     death mid-create still leaves the trail (a marker for a slice that
-    never materialized is harmless: delete is idempotent best-effort)."""
+    never materialized is harmless: delete answers NOT_FOUND, which counts
+    as released, so the marker drains instead of orphaning)."""
     if marker_dir:
         write_marker(spec, marker_dir, keep=keep, echo=echo)
-    create(spec, echo=echo)
+    release = True
     try:
+        # create() inside the release scope: a failed create still runs
+        # the delete (NOT_FOUND -> released) so the marker never orphans.
+        # EXCEPT name collisions: ALREADY_EXISTS means a slice this run
+        # did NOT create (e.g. an earlier --keep-slice run) — releasing
+        # it would tear down a live slice we don't own, so drop only our
+        # marker and leave the resource alone.
+        try:
+            create(spec, echo=echo)
+        except ProvisionError as e:
+            if ("ALREADY_EXISTS" in str(e)
+                    or "already exists" in str(e).lower()):
+                release = False
+                if marker_dir:
+                    clear_marker(marker_dir)
+            raise
         await_ready(spec, echo=echo)
         hosts = worker_hosts(spec)
         echo(f"provision: {len(hosts)} worker hosts: {', '.join(hosts)}")
         return run_fn(hosts)
     finally:
-        if keep:
+        if not release:
+            pass
+        elif keep:
             echo(f"provision: keeping {spec.name} (--keep-slice)")
         elif delete(spec, echo=echo) and marker_dir:
             clear_marker(marker_dir)

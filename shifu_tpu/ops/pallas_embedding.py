@@ -245,22 +245,60 @@ def _scatter_grad(ids: jax.Array, table_shape, g: jax.Array) -> jax.Array:
         g.reshape(-1, table_shape[-1]).astype(jnp.float32))
 
 
+# Per-table unrolled segment sums measured fastest at small field counts
+# (NC=6), but the unroll emits NC independent ops — at the 1000-column
+# rung's ~50 fields the backward HLO grows linearly and compile time with
+# it.  Wide schemas therefore flatten to ONE segment_sum over NC*V
+# segments (constant op count at any width); the crossover is coarse and
+# overridable for A/Bs.
+_SEGMENT_FLAT_MIN_FIELDS = 16
+
+
+def _segment_flat_min_fields() -> int:
+    import os
+    try:
+        return int(os.environ.get("SHIFU_TPU_SEGMENT_FLAT_MIN_FIELDS",
+                                  _SEGMENT_FLAT_MIN_FIELDS))
+    except ValueError:
+        return _SEGMENT_FLAT_MIN_FIELDS
+
+
 def _segment_grad(ids: jax.Array, table_shape, g: jax.Array) -> jax.Array:
-    """The same gradient as `_scatter_grad`, lowered as NC independent 1-D
-    segment reductions instead of one combined 2-D scatter — XLA:TPU turns
-    the per-table form into a far faster program (measured 4.2x on a v5e
-    at vocab 100k: 11.2M vs 2.6M update-rows/s; no pre-sort needed, a sort
+    """The same gradient as `_scatter_grad`, lowered as 1-D segment
+    reductions instead of one combined 2-D scatter — XLA:TPU turns the
+    segment form into a far faster program (measured 4.2x on a v5e at
+    vocab 100k: 11.2M vs 2.6M update-rows/s; no pre-sort needed, a sort
     actually measured slower).  Id semantics match the scatter exactly:
     negative ids wrap once, anything outside [-V, V) contributes nothing
     (segment_sum drops out-of-range segment ids the way `.at[].add` drops
-    out-of-bounds updates)."""
+    out-of-bounds updates).
+
+    Narrow schemas keep the per-table unroll (fastest at NC=6); wide ones
+    (NC >= SHIFU_TPU_SEGMENT_FLAT_MIN_FIELDS) flatten every (row, field)
+    update into one segment_sum over NC*V segments so the backward program
+    stays one op regardless of field count.  The threshold env is read at
+    TRACE time: under jit it bakes into the compiled program, so A/Bs must
+    set it before the first compile (fresh process / fresh jit), not flip
+    it mid-run."""
     nc, v, _ = table_shape
     ids = ids.astype(jnp.int32)
     wrapped = jnp.where(ids < 0, ids + v, ids)
     gf = g.astype(jnp.float32)
-    return jnp.stack([
-        jax.ops.segment_sum(gf[:, f, :], wrapped[:, f], num_segments=v)
-        for f in range(nc)])
+    if nc < _segment_flat_min_fields():
+        return jnp.stack([
+            jax.ops.segment_sum(gf[:, f, :], wrapped[:, f], num_segments=v)
+            for f in range(nc)])
+    # flattened: segment id = field*V + wrapped id.  Out-of-range ids must
+    # be masked BEFORE the field offset (id V+3 in field f would otherwise
+    # alias into field f+1's table); NC*V is one past the last segment, so
+    # segment_sum drops it — same drop semantics as the per-table form.
+    valid = (wrapped >= 0) & (wrapped < v)
+    field = jnp.broadcast_to(jnp.arange(nc, dtype=jnp.int32)[None, :],
+                             wrapped.shape)
+    flat = jnp.where(valid, field * v + wrapped, nc * v)
+    out = jax.ops.segment_sum(gf.reshape(-1, gf.shape[-1]), flat.reshape(-1),
+                              num_segments=nc * v + 1)
+    return out[:nc * v].reshape(table_shape)
 
 
 def _bwd(use_pallas, res, g):

@@ -162,3 +162,33 @@ def test_segment_grad_matches_scatter_grad():
     want = np.asarray(pe._scatter_grad(jnp.asarray(ids), table_shape,
                                        jnp.asarray(g)))
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_segment_grad_flattened_matches_scatter_grad(monkeypatch):
+    """Wide schemas take the FLATTENED single-segment_sum form (one op at
+    any field count instead of an NC-long unroll): same gradient as the
+    scatter reference, including the id classes where flattening could go
+    wrong — an id >= V must DROP, not alias into the next field's table,
+    and an id < -V must drop, not shift into the previous field's."""
+    from shifu_tpu.ops import pallas_embedding as pe
+
+    rng = np.random.default_rng(13)
+    nc, v, d = 20, 37, 8  # nc >= the flat-form threshold
+    table_shape = (nc, v, d)
+    ids = rng.integers(-80, 90, (129, nc)).astype(np.int32)
+    ids[0, :4] = [0, v - 1, -1, -v]         # wrap boundaries
+    ids[1, :4] = [v, v + 3, -v - 1, 89]     # alias candidates: all dropped
+    ids[2] = ids[3] = 5                     # duplicates
+    g = rng.standard_normal((129, nc, d)).astype(np.float32)
+    monkeypatch.setenv("SHIFU_TPU_SEGMENT_FLAT_MIN_FIELDS", "16")
+    got = np.asarray(pe._segment_grad(jnp.asarray(ids), table_shape,
+                                      jnp.asarray(g)))
+    want = np.asarray(pe._scatter_grad(jnp.asarray(ids), table_shape,
+                                       jnp.asarray(g)))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    # forcing the per-table form on the same inputs agrees too (the A/B
+    # switch the threshold env exists for)
+    monkeypatch.setenv("SHIFU_TPU_SEGMENT_FLAT_MIN_FIELDS", "1000")
+    per_table = np.asarray(pe._segment_grad(jnp.asarray(ids), table_shape,
+                                            jnp.asarray(g)))
+    np.testing.assert_allclose(per_table, want, rtol=1e-6, atol=1e-6)

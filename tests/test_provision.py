@@ -20,6 +20,13 @@ with open(os.environ["FAKE_GCLOUD_LOG"], "a") as f:
     f.write(json.dumps(args) + chr(10))
 cmd = " ".join(args)
 if "queued-resources create" in cmd:
+    mode = os.environ.get("FAKE_GCLOUD_FAIL_CREATE")
+    if mode == "ALREADY_EXISTS":
+        sys.stderr.write("ERROR: ALREADY_EXISTS: resource exists" + chr(10))
+        sys.exit(1)
+    if mode:
+        sys.stderr.write("ERROR: (gcloud) quota exceeded" + chr(10))
+        sys.exit(1)
     sys.exit(0)
 if "queued-resources describe" in cmd:
     sf = os.environ["FAKE_GCLOUD_STATE"]
@@ -34,6 +41,9 @@ if "tpu-vm describe" in cmd:
         {{"ipAddress": "localhost"}}, {{"ipAddress": "localhost"}}]}}))
     sys.exit(0)
 if "queued-resources delete" in cmd:
+    if os.environ.get("FAKE_GCLOUD_DELETE_NOT_FOUND"):
+        sys.stderr.write("ERROR: NOT_FOUND: no such queued resource" + chr(10))
+        sys.exit(1)
     sys.exit(1 if os.environ.get("FAKE_GCLOUD_FAIL_DELETE") else 0)
 sys.exit(64)
 """
@@ -294,6 +304,124 @@ def test_release_failure_keeps_marker(fake_gcloud, tmp_path, monkeypatch):
     assert prov.read_marker(str(out)) is None
 
 
+def test_failed_create_drains_marker(fake_gcloud, tmp_path, monkeypatch):
+    """create() itself failing (quota, bad flags) must not orphan the
+    provision.json marker: the release path still runs, gcloud answers
+    NOT_FOUND (the resource never materialized), and NOT_FOUND counts as
+    released so the marker drains instead of pinning a phantom slice."""
+    from shifu_tpu.launcher import provision as prov
+
+    out = tmp_path / "nocreate"
+    spec = prov.ProvisionSpec(name="phantom", accelerator_type="v5litepod-8",
+                              zone="us-west4-a")
+    monkeypatch.setenv("FAKE_GCLOUD_FAIL_CREATE", "1")
+    monkeypatch.setenv("FAKE_GCLOUD_DELETE_NOT_FOUND", "1")
+    with pytest.raises(prov.ProvisionError, match="quota"):
+        prov.provision_and_run(spec, lambda hosts: 0, echo=lambda s: None,
+                               marker_dir=str(out))
+    assert prov.read_marker(str(out)) is None  # no phantom slice recorded
+
+
+def test_delete_not_found_counts_as_released(fake_gcloud, tmp_path,
+                                             monkeypatch):
+    """An already-gone resource (operator deleted it by hand) must let the
+    marker drain: a NOT_FOUND delete is a successful release, not a
+    failure to retry forever."""
+    from shifu_tpu.launcher import provision as prov
+
+    out = tmp_path / "gone"
+    spec = prov.ProvisionSpec(name="gone-slice",
+                              accelerator_type="v5litepod-8",
+                              zone="us-west4-a")
+    prov.write_marker(spec, str(out))
+    monkeypatch.setenv("FAKE_GCLOUD_DELETE_NOT_FOUND", "1")
+    assert prov.release_from_marker(str(out), echo=lambda s: None) is True
+    assert prov.read_marker(str(out)) is None
+
+
+def test_already_exists_create_failure_releases_nothing(fake_gcloud,
+                                                        tmp_path,
+                                                        monkeypatch):
+    """A name-collision create (ALREADY_EXISTS: e.g. a prior --keep-slice
+    run holds the name) must NOT run the release drain — deleting would
+    tear down a live slice this run never created.  Only our marker is
+    dropped."""
+    from shifu_tpu.launcher import provision as prov
+
+    _, log = fake_gcloud
+    out = tmp_path / "collide"
+    spec = prov.ProvisionSpec(name="held", accelerator_type="v5litepod-8",
+                              zone="us-west4-a")
+    monkeypatch.setenv("FAKE_GCLOUD_FAIL_CREATE", "ALREADY_EXISTS")
+    with pytest.raises(prov.ProvisionError, match="ALREADY_EXISTS"):
+        prov.provision_and_run(spec, lambda hosts: 0, echo=lambda s: None,
+                               marker_dir=str(out))
+    assert prov.read_marker(str(out)) is None  # our marker dropped
+    assert not [c for c in _calls(log) if "delete" in c]  # slice untouched
+
+
+def test_kill_refuses_cross_host_marker(fake_gcloud, tmp_path):
+    """A marker written on ANOTHER host (shared-filesystem job dir) must
+    not be released from here — this host's pid table says nothing about
+    the recording host's dispatcher; --force overrides."""
+    import json as _json
+
+    from shifu_tpu.launcher import detach, provision as prov
+
+    _, log = fake_gcloud
+    out = tmp_path / "nfs"
+    out.mkdir()
+    (out / prov.MARKER_FILE).write_text(_json.dumps(
+        {"name": "far-slice", "zone": "us-west4-a", "project": "",
+         "keep": False, "pid": 1234, "host": "other-host.example"}))
+    msgs = []
+    assert detach.kill(str(out), echo=msgs.append) == 1
+    assert any("other-host.example" in m for m in msgs), msgs
+    assert not [c for c in _calls(log) if "delete" in c]
+    detach.kill(str(out), echo=msgs.append, force=True)
+    assert [c for c in _calls(log) if "delete" in c]
+
+
+def test_kill_refuses_live_foreground_provision(fake_gcloud, tmp_path):
+    """A foreground `train --provision` run writes no job.json but its
+    marker records the dispatcher pid: a stray `kill <job_dir>` while that
+    dispatcher is ALIVE must refuse to delete the slice out from under the
+    live gang — and --force must override for a stuck operator."""
+    from shifu_tpu.launcher import detach, provision as prov
+
+    _, log = fake_gcloud
+    out = tmp_path / "live"
+    spec = prov.ProvisionSpec(name="live-slice",
+                              accelerator_type="v5litepod-8",
+                              zone="us-west4-a")
+    # a LIVE stand-in dispatcher whose cmdline mentions shifu_tpu
+    live = subprocess.Popen(
+        [sys.executable, "-c",
+         "import shifu_tpu, time; time.sleep(600)"],
+        env={**os.environ, "PYTHONPATH":
+             REPO + os.pathsep + os.environ.get("PYTHONPATH", "")})
+    try:
+        prov.write_marker(spec, str(out))
+        # overwrite the recorded pid with the live stand-in's
+        marker = prov.read_marker(str(out))
+        marker["pid"] = live.pid
+        with open(os.path.join(str(out), prov.MARKER_FILE), "w") as f:
+            json.dump(marker, f)
+        msgs = []
+        rc = detach.kill(str(out), echo=msgs.append)
+        assert rc == 1
+        assert any("LIVE dispatcher" in m for m in msgs), msgs
+        assert prov.read_marker(str(out)) is not None  # slice untouched
+        assert not [c for c in _calls(log) if "delete" in c]
+        # --force releases anyway
+        rc = detach.kill(str(out), echo=msgs.append, force=True)
+        assert prov.read_marker(str(out)) is None
+        assert [c for c in _calls(log) if "delete" in c]
+    finally:
+        live.kill()
+        live.wait()
+
+
 @pytest.mark.slow
 def test_foreground_sigterm_releases_slice(tmp_path):
     """SIGTERM a FOREGROUND `train --provision` while it awaits capacity:
@@ -329,6 +457,7 @@ def test_foreground_sigterm_releases_slice(tmp_path):
                 "FAKE_GCLOUD_STATES": "WAITING_FOR_RESOURCES",
                 "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", "")})
     out = tmp_path / "job"
+    child_log = open(tmp_path / "child.log", "wb")  # diagnosable on timeout
     proc = subprocess.Popen(
         [sys.executable, "-m", "shifu_tpu.launcher.cli", "train",
          "--modelconfig", str(tmp_path / "ModelConfig.json"),
@@ -336,22 +465,38 @@ def test_foreground_sigterm_releases_slice(tmp_path):
          "--data", str(tmp_path / "data"), "--output", str(out),
          "--provision", "--provision-name", "sigterm-slice",
          "--accelerator-type", "v5litepod-8", "--zone", "us-west4-a"],
-        env=env, cwd=str(tmp_path))
+        env=env, cwd=str(tmp_path), stdout=child_log,
+        stderr=subprocess.STDOUT)
     log = tmp_path / "gcloud.log"
+
+    def _tail() -> str:
+        child_log.flush()
+        try:
+            return (tmp_path / "child.log").read_text()[-2000:]
+        except OSError:
+            return "<no child log>"
+
     try:
-        deadline = time_lib.monotonic() + 120
+        deadline = time_lib.monotonic() + 180
         while time_lib.monotonic() < deadline:
             if any("describe" in c for c in _calls(log)):
                 break
             time_lib.sleep(0.2)
-        assert any("describe" in c for c in _calls(log)), "never reached await"
+        assert any("describe" in c for c in _calls(log)), \
+            f"never reached await; child output:\n{_tail()}"
         proc.send_signal(signal_lib.SIGTERM)
-        rc = proc.wait(timeout=60)
+        # generous margin: this rig is 1-core, and the release unwind has
+        # to start a fresh interpreter for the fake gcloud delete
+        rc = proc.wait(timeout=180)
+    except subprocess.TimeoutExpired:
+        raise AssertionError(
+            f"child did not exit after SIGTERM; output:\n{_tail()}")
     finally:
         if proc.poll() is None:  # any assert/timeout: never leak the child
             proc.kill()
             proc.wait()
-    assert rc == 128 + signal_lib.SIGTERM, rc
+        child_log.close()
+    assert rc == 128 + signal_lib.SIGTERM, (rc, _tail())
     calls = _calls(log)
     deletes = [c for c in calls if "delete" in c]
     assert deletes and "sigterm-slice" in deletes[-1], calls[-3:]
