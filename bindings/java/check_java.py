@@ -134,6 +134,49 @@ def check_statements(stripped: str, path: str) -> None:
                 f"{path}:{ln}: statement may be missing a ';': {c[:60]!r}")
 
 
+# types resolvable without an import: java.lang plus generic-parameter
+# single letters (the compiler's implicit universe for these sources)
+_JAVA_LANG = {
+    "String", "Object", "System", "Math", "Thread", "StringBuilder",
+    "Integer", "Long", "Double", "Float", "Boolean", "Character", "Byte",
+    "Short", "Void", "Number", "Iterable", "Comparable", "Runnable",
+    "CharSequence", "Class", "Exception", "RuntimeException", "Error",
+    "Throwable", "IllegalStateException", "IllegalArgumentException",
+    "NullPointerException", "IndexOutOfBoundsException",
+    "UnsupportedOperationException", "AutoCloseable", "Cloneable",
+    "Override", "Deprecated", "SuppressWarnings", "FunctionalInterface",
+    "SafeVarargs",
+}
+
+
+def check_types(stripped: str, path: Path) -> None:
+    """Unresolvable-type detection — the typo class javac catches first
+    (a misspelled class name) that none of the other passes see.
+
+    Every CamelCase identifier used as a type must resolve to: an import's
+    simple name, a type declared in this file, a sibling source in the same
+    package, java.lang, or a single-letter generic parameter.  Identifiers
+    after a '.' are members of an already-resolved qualifier, and ALL_CAPS
+    identifiers are constants by Java convention — both skipped."""
+    imported = set(re.findall(r"^\s*import\s+(?:static\s+)?[\w.]*?(\w+)\s*;",
+                              stripped, re.M))
+    declared = set(re.findall(
+        r"\b(?:class|interface|enum|record)\s+(\w+)", stripped))
+    siblings = {p.stem for p in path.parent.glob("*.java")}
+    known = imported | declared | siblings | _JAVA_LANG
+    for m in re.finditer(r"(\.\s*)?\b([A-Za-z_]\w*)\b", stripped):
+        qualifier, name = m.group(1), m.group(2)
+        if qualifier or not name[0].isupper() or len(name) == 1:
+            continue
+        if name.isupper() or "_" in name:  # ALL_CAPS constant convention
+            continue
+        if name not in known:
+            ln = stripped.count("\n", 0, m.start(2)) + 1
+            raise JavaCheckError(
+                f"{path}:{ln}: type {name!r} resolves to no import, "
+                "declaration, sibling source, or java.lang class")
+
+
 def exported_c_symbols(scorer_cc: Path) -> set[str]:
     src = scorer_cc.read_text()
     return set(re.findall(r"\b(shifu_\w+)\s*\(", src))
@@ -157,6 +200,7 @@ def check_file(path: Path, c_symbols: set[str]) -> None:
     check_balance(stripped, str(path))
     check_structure(src, stripped, path)
     check_statements(stripped, str(path))
+    check_types(stripped, path)
     check_abi(src, str(path), c_symbols)
 
 

@@ -1,0 +1,169 @@
+/*
+ * The drop-in Shifu plug-in adapter: `Computable` over the shifu_tpu
+ * native scoring engine.
+ *
+ * A Shifu deployment loads eval models through its `Computable` interface;
+ * the reference eval module IS such a plug-in (`TensorflowModel implements
+ * Computable`, shifu-tensorflow-eval/src/main/java/ml/shifu/shifu/
+ * tensorflow/TensorflowModel.java:29-30).  This class is the shifu_tpu
+ * successor: `init(GenericModelConfig)` reads the SAME properties the
+ * reference read — modelpath / inputnames / outputnames / tags
+ * (TensorflowModel.java:112-172, validation order and error semantics
+ * mirrored) — and `compute(MLData)` scores one row of doubles
+ * (TensorflowModel.java:52-109) by delegating to {@link ShifuTpuModel},
+ * which calls the dependency-free libshifu_scorer C ABI through
+ * java.lang.foreign instead of the 200MB libtensorflow_jni runtime.
+ *
+ * Differences from the reference, by design:
+ *  - `tags` selected a SavedModel graph variant; the shifu_tpu artifact has
+ *    exactly one scoring program (model.bin), so tags are validated for
+ *    contract parity (non-null, non-empty) and otherwise ignored.
+ *  - The reference fed properties[inputNames[i]] (i >= 1) as extra input
+ *    tensors per call (TensorflowModel.java:74-87); shifu_tpu bakes those
+ *    values into model.bin at export time (export/artifact.py extra_inputs
+ *    -> native kConstant inputs), so init only verifies each extra
+ *    inputname has its property present — the engine already carries the
+ *    values.
+ *  - The native library path comes from the `nativelib` property, the
+ *    `shifu.tpu.scorer.lib` system property, or the SHIFU_TPU_SCORER_LIB
+ *    environment variable, in that order (the reference's JNI runtime rode
+ *    in on java.library.path implicitly).
+ *
+ * Compile against shifu-core + encog (the interfaces below); see
+ * README.md for the JDK 22+ / CI contract.
+ */
+package ml.shifu.shifu.tpu;
+
+import java.nio.file.Path;
+import java.util.List;
+import java.util.Map;
+
+import org.encog.ml.data.MLData;
+
+import ml.shifu.shifu.container.obj.GenericModelConfig;
+import ml.shifu.shifu.core.Computable;
+
+public class ShifuTpuComputable implements Computable {
+
+    public Map<String, Object> properties;
+
+    private boolean initiate = false;
+
+    private String modelPath;
+
+    private String[] inputNames;
+
+    private String outputNames;
+
+    private String[] tags;
+
+    private ShifuTpuModel model;
+
+    @Override
+    public double compute(MLData input) {
+        if (!initiate || model == null) {
+            // same guard the reference threw before scoring
+            // (TensorflowModel.java:55-57)
+            throw new IllegalStateException("shifu_tpu model not initialized.");
+        }
+        // reference contract: one row of doubles in, one double score out
+        // (TensorflowModel.java:52-109; it downcast to float and fed the
+        // graph — the native engine here takes the doubles directly)
+        return model.compute(input.getData());
+    }
+
+    @Override
+    public void init(GenericModelConfig config) {
+        if (this.initiate) {
+            return;
+        }
+        if (config == null) {
+            // reference: RuntimeException("Config is null"),
+            // TensorflowModel.java:118-121
+            throw new RuntimeException("Config is null");
+        }
+        this.properties = config.getProperties();
+        if (this.properties == null || this.properties.size() == 0) {
+            throw new RuntimeException("Properties is null");
+        }
+        this.modelPath = (String) this.properties.get("modelpath");
+        List<String> inputs = config.getInputnames();
+        this.inputNames = (inputs == null) ? null
+                : inputs.toArray(new String[0]);
+        Object outputs = this.properties.get("outputnames");
+        if (outputs instanceof String) {
+            this.outputNames = (String) outputs;
+        } else if (outputs instanceof String[]) {
+            // reference: a single-element array is accepted, more is an
+            // error (TensorflowModel.java:131-140)
+            String[] arr = (String[]) outputs;
+            if (arr.length == 1) {
+                this.outputNames = arr[0];
+            } else {
+                throw new IllegalArgumentException(
+                        "Output now only support single output in inference.");
+            }
+        }
+
+        @SuppressWarnings("unchecked")
+        List<String> tagList = (List<String>) this.properties.get("tags");
+        this.tags = (tagList == null) ? null
+                : tagList.toArray(new String[0]);
+
+        // reference validation order + messages (TensorflowModel.java:147-166)
+        if (this.modelPath == null || this.modelPath.isEmpty()) {
+            throw new RuntimeException("Model path is null");
+        }
+        if (this.inputNames == null || this.inputNames.length == 0) {
+            throw new RuntimeException("Input names is null");
+        }
+        if (this.outputNames == null || this.outputNames.isEmpty()) {
+            throw new RuntimeException("Output names is null");
+        }
+        if (this.tags == null || this.tags.length == 0) {
+            throw new RuntimeException("Tags is null");
+        }
+        // extra-input parity: every inputname past the feature row must
+        // carry its constant value in properties (export wrote both; the
+        // values themselves already live inside model.bin)
+        for (int i = 1; i < this.inputNames.length; i++) {
+            if (!this.properties.containsKey(this.inputNames[i])) {
+                throw new RuntimeException(
+                        "Missing property for input " + this.inputNames[i]);
+            }
+        }
+
+        this.model = new ShifuTpuModel(
+                resolveLibrary(), Path.of(this.modelPath));
+        this.initiate = true;
+    }
+
+    @Override
+    public void releaseResource() {
+        if (this.model != null) {
+            this.model.close();
+            this.model = null;
+        }
+        this.initiate = false;
+    }
+
+    private Path resolveLibrary() {
+        Object prop = (this.properties == null) ? null
+                : this.properties.get("nativelib");
+        if (prop instanceof String && !((String) prop).isEmpty()) {
+            return Path.of((String) prop);
+        }
+        String sys = System.getProperty("shifu.tpu.scorer.lib");
+        if (sys != null && !sys.isEmpty()) {
+            return Path.of(sys);
+        }
+        String env = System.getenv("SHIFU_TPU_SCORER_LIB");
+        if (env != null && !env.isEmpty()) {
+            return Path.of(env);
+        }
+        throw new RuntimeException(
+                "Native scorer library not configured: set the 'nativelib' "
+                        + "property, the shifu.tpu.scorer.lib system "
+                        + "property, or SHIFU_TPU_SCORER_LIB");
+    }
+}

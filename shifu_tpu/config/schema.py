@@ -134,17 +134,33 @@ class DataConfig:
     # when the model computes in bfloat16 anyway (the model casts inputs
     # first — models/base.py) and no categorical id columns ride in features
     # (ids > 256 are not bf16-exact); halves H2D bytes and the resident
-    # tier's HBM footprint.  "float32"/"bfloat16" force a choice.
+    # tier's HBM footprint.  "float32"/"bfloat16" force a choice.  "int8"
+    # quantizes features to a per-column affine grid on the host and
+    # dequantizes on device (train/step.py make_wire_decode): 1 byte/value
+    # on the wire — 2x the effective H2D roofline of bf16 — at a max
+    # rounding error of wire_int8_clip/254 per value, which ZSCALE-
+    # normalized data tolerates (AUC parity pinned by
+    # tests/test_wire_int8.py).  int8 requires a categorical-free feature
+    # matrix (ids cannot ride an affine grid; JobConfig.validate enforces).
     wire_dtype: str = "auto"
+    # symmetric per-column clip for the int8 wire grid, in (normalized)
+    # feature units: values quantize to round(x * 127/clip) in [-127, 127],
+    # so anything beyond +-clip saturates.  Shifu ZSCALE clamps at 4-6
+    # sigma, so the default 8.0 never clips in-contract data.
+    wire_int8_clip: float = 8.0
 
     def validate(self) -> None:
         if not (0.0 <= self.valid_ratio < 1.0):
             raise ConfigError(f"valid_ratio must be in [0,1): {self.valid_ratio}")
         if self.batch_size <= 0:
             raise ConfigError("batch_size must be positive")
-        if self.wire_dtype not in ("auto", "float32", "bfloat16"):
+        if self.wire_dtype not in ("auto", "float32", "bfloat16", "int8"):
             raise ConfigError(
-                f"wire_dtype must be auto/float32/bfloat16: {self.wire_dtype!r}")
+                f"wire_dtype must be auto/float32/bfloat16/int8: "
+                f"{self.wire_dtype!r}")
+        if self.wire_int8_clip <= 0:
+            raise ConfigError(
+                f"wire_int8_clip must be positive: {self.wire_int8_clip}")
 
 
 # ---------------------------------------------------------------------------
@@ -488,6 +504,14 @@ class JobConfig:
             # memmap-backed out-of-core shards into RAM
             raise ConfigError("bagging_sample_rate < 1 is not supported with "
                               "out-of-core datasets")
+        if self.data.wire_dtype == "int8" and self.schema.categorical_indices:
+            # integer ids cannot ride an affine quantization grid (an id of
+            # 300 would saturate at the clip); embedding models keep
+            # f32/bf16 wire
+            raise ConfigError(
+                "wire_dtype=int8 requires a categorical-free feature matrix "
+                f"({len(self.schema.categorical_indices)} categorical "
+                "columns selected); use auto/bfloat16/float32")
         return self
 
     # -- serialization ------------------------------------------------------

@@ -322,11 +322,22 @@ def train(job: JobConfig,
     job = job.validate()
     console = console or (lambda s: print(s, flush=True))
 
-    # features-on-the-wire cast (bf16 when the model computes bf16 anyway):
-    # halves H2D bytes, host RAM, and the resident tier's HBM footprint —
-    # the loaders store features directly in the wire dtype
+    # features-on-the-wire cast (bf16 when the model computes bf16 anyway;
+    # int8 quantization when configured): halves/quarters H2D bytes and the
+    # resident tier's HBM footprint.  The loaders store features directly
+    # in the wire dtype (bf16 cast or int8 quantize at parse time), so the
+    # per-block cast below only fires for in-memory datasets callers pass
+    # in as f32
+    wmode = pipe.wire_mode(job.schema, job.data, job.model.compute_dtype)
     wcast = pipe.wire_cast_fn(job.schema, job.data, job.model.compute_dtype)
-    feature_dtype = "bfloat16" if wcast is not None else "float32"
+    if wmode == "bfloat16":
+        feature_dtype = "bfloat16"
+    elif wmode == "int8":
+        # loaders quantize at parse time; the clip rides in the cache key so
+        # a changed grid never reuses stale quantized cache entries
+        feature_dtype = f"int8c{job.data.wire_int8_clip:g}"
+    else:
+        feature_dtype = "float32"
 
     # streamed first epoch: defer the (blocking) load and start training on
     # parsed blocks while the rest of the files parse in the background.
@@ -523,8 +534,11 @@ def train(job: JobConfig,
         # rows_for_blocks prefix — a host deciding from its raw local shard
         # size could pick a different tier and deadlock the collectives
         feat_row_bytes = train_ds.features.nbytes // max(train_ds.num_rows, 1)
-        if wcast is not None and train_ds.features.dtype == np.float32:
-            feat_row_bytes //= 2  # bf16 on device (loader may pre-cast)
+        if train_ds.features.dtype == np.float32:
+            if wmode == "int8":
+                feat_row_bytes //= 4  # int8 on device
+            elif wmode == "bfloat16":
+                feat_row_bytes //= 2  # bf16 on device (loader may pre-cast)
         per_row_bytes = (feat_row_bytes
                          + (train_ds.target.nbytes + train_ds.weight.nbytes)
                          // max(train_ds.num_rows, 1))

@@ -16,6 +16,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config.schema import JobConfig
@@ -24,6 +25,31 @@ from ..parallel import sharding as shard_lib
 from .train_state import TrainState
 
 Batch = dict[str, jax.Array]
+
+
+def make_wire_decode(job: JobConfig):
+    """On-device inverse of the int8 wire quantization (x = q*scale +
+    offset, computed in f32 before the model's own compute-dtype cast), or
+    None when the job's wire format is not int8.  The grid is the same
+    static per-column one the host encoded with (data/pipeline.wire_params),
+    so decode needs no data-dependent state — it closes over two (F,)
+    constants and fuses into the first layer's HLO."""
+    from ..data import pipeline as pipe
+
+    if pipe.wire_mode(job.schema, job.data,
+                      job.model.compute_dtype) != "int8":
+        return None
+    scale, offset = pipe.wire_params(job.schema, job.data)
+    s = jnp.asarray(scale)
+    o = jnp.asarray(offset) if np.any(offset) else None
+
+    def decode(features: jax.Array) -> jax.Array:
+        if features.dtype != jnp.int8:  # static: raw-f32 callers pass through
+            return features
+        x = features.astype(jnp.float32) * s
+        return x if o is None else x + o
+
+    return decode
 
 
 def make_loss_fn(job: JobConfig):
@@ -38,17 +64,21 @@ def make_loss_fn(job: JobConfig):
     l2 = job.model.l2_scale
     use_dropout = job.model.dropout_rate > 0
     drop_seed = job.train.seed ^ 0x6B0_D0_1  # distinct from init's key stream
+    decode = make_wire_decode(job)
 
     def loss_fn(params, apply_fn, batch: Batch,
                 step: Optional[jax.Array] = None) -> jax.Array:
+        feats = batch["features"]
+        if decode is not None:
+            feats = decode(feats)
         if use_dropout:
             rng = jax.random.fold_in(
                 jax.random.PRNGKey(drop_seed),
                 step if step is not None else jnp.int32(0))
-            logits = apply_fn({"params": params}, batch["features"],
+            logits = apply_fn({"params": params}, feats,
                               train=True, rngs={"dropout": rng})
         else:
-            logits = apply_fn({"params": params}, batch["features"])
+            logits = apply_fn({"params": params}, feats)
         loss = base(logits, batch["target"], batch["weight"])
         if l2 > 0:
             loss = loss + losses_lib.l2_penalty(params, l2)
@@ -301,10 +331,16 @@ def make_local_sgd_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
 
 
 def make_eval_step(job: JobConfig) -> Callable[[TrainState, Batch], jax.Array]:
-    """Scores (sigmoid probabilities) for a batch — the eval forward pass."""
+    """Scores (sigmoid probabilities) for a batch — the eval forward pass.
+    Accepts int8 wire batches (same decode as training, so eval sees the
+    exact features the train step saw)."""
+    decode = make_wire_decode(job)
 
     def score(state: TrainState, batch: Batch) -> jax.Array:
-        logits = state.apply_fn({"params": state.params}, batch["features"])
+        feats = batch["features"]
+        if decode is not None:
+            feats = decode(feats)
+        logits = state.apply_fn({"params": state.params}, feats)
         return jax.nn.sigmoid(logits)
 
     return jax.jit(score)

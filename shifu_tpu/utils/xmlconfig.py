@@ -69,6 +69,10 @@ KEY_DATA_READ_THREADS = "shifu.data.read-threads"
 # HBM budget for the device-resident input tier (bytes); datasets above it
 # use the staged-blocks tier
 KEY_DATA_RESIDENT_BYTES = "shifu.data.device-resident-bytes"
+# features-on-the-wire dtype: auto / float32 / bfloat16 / int8 (int8 = the
+# quantized wire, data/pipeline.wire_params; clip in normalized units)
+KEY_DATA_WIRE_DTYPE = "shifu.data.wire-dtype"
+KEY_DATA_WIRE_INT8_CLIP = "shifu.data.wire-int8-clip"
 
 
 def parse_sharding_rules(value: str) -> tuple:
@@ -190,6 +194,14 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         import dataclasses
         data = dataclasses.replace(
             data, read_threads=int(conf[KEY_DATA_READ_THREADS]))
+    if KEY_DATA_WIRE_DTYPE in conf:
+        import dataclasses
+        data = dataclasses.replace(
+            data, wire_dtype=conf[KEY_DATA_WIRE_DTYPE].strip().lower())
+    if KEY_DATA_WIRE_INT8_CLIP in conf:
+        import dataclasses
+        data = dataclasses.replace(
+            data, wire_int8_clip=float(conf[KEY_DATA_WIRE_INT8_CLIP]))
 
     import dataclasses
     rt_kw: dict[str, Any] = {}

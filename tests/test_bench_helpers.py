@@ -65,3 +65,55 @@ def test_sustained_rate_reps_grow_to_target(monkeypatch):
     r_lo, r_hi = diag["reps"]
     assert r_hi >= 100
     assert abs(rate - 100.0 / 0.0005) / (100.0 / 0.0005) < 0.01
+
+
+def test_headline_is_capture_proof():
+    """The stdout line must stay under the driver's tail-capture budget no
+    matter how many tiers the full record grows — and must always carry the
+    metric/value/vs_baseline triple the round artifact hangs on."""
+    import json
+
+    full = {"metric": "tabular_train_samples_per_sec_per_chip",
+            "value": 531e6, "unit": "samples/sec/chip", "vs_baseline": 849.6,
+            "n_chips": 1, "global_batch": 98304, "model": "mlp"}
+    # bloat the record with every optional key plus 200 junk tiers
+    for k in bench._HEADLINE_OPTIONAL:
+        full.setdefault(k, 123456.789)
+    for i in range(200):
+        full[f"tier_{i}_diagnostic"] = "x" * 50
+    line = json.dumps(bench._headline(full))
+    assert len(line) <= bench._HEADLINE_BUDGET
+    parsed = json.loads(line)
+    for k in ("metric", "value", "vs_baseline"):
+        assert k in parsed
+    # junk diagnostics never reach the headline
+    assert not any(k.startswith("tier_") for k in parsed)
+    # priority fields made it in ahead of the tail
+    assert "mfu" in parsed
+    assert "e2e_cached_disk_samples_per_sec_per_chip" in parsed
+
+
+def test_rate_stats_fields(monkeypatch):
+    """_rate_stats records best/median/min so a cross-round delta is
+    classifiable as noise or regression from the artifact alone."""
+    times = iter([0.0, 1.0, 1.0, 3.0, 3.0, 7.0, 7.0, 9.0, 9.0, 13.0])
+    monkeypatch.setattr(time, "perf_counter", lambda: next(times))
+    extras = {}
+    bench._rate_stats(extras, "k", lambda: None, 100, trials=5, reps=1)
+    # windows: 1s, 2s, 4s, 2s, 4s -> rates 100, 50, 25, 50, 25
+    assert extras["k"] == 100.0
+    assert extras["k_median"] == 50.0
+    assert extras["k_min"] == 25.0
+
+
+def test_rung_hbm_model_dominated_by_table_at_high_vocab():
+    """At CTR-scale vocab the dense-grad + Adadelta term (8x table bytes)
+    dominates the model — the property that makes fraction-of-HBM the
+    honest lens for the 100k-vocab rung."""
+    import dataclasses
+
+    spec = type("S", (), {"embedding_dim": 16})()
+    b = bench._rung_hbm_bytes_per_step(spec, 32768, 30, 6, 100_000)
+    table = 6 * 100_000 * 16 * 4
+    assert b >= 8 * table
+    assert 8 * table / b > 0.5

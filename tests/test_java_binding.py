@@ -118,7 +118,7 @@ def test_java_sources_structurally_valid(tmp_path):
     r = subprocess.run([sys_mod.executable, checker],
                        capture_output=True, text=True, timeout=60)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert r.stdout.count("OK") == 2
+    assert r.stdout.count("OK") == 3  # Model + Smoke + Computable adapter
 
     # the validator actually catches the typo classes it claims to:
     src_path = os.path.join(java_dir, "ml", "shifu", "shifu", "tpu",
@@ -132,6 +132,9 @@ def test_java_sources_structurally_valid(tmp_path):
                                     '"shifu_scorer_load', 1),
         "bad_symbol": src.replace('"shifu_scorer_load"',
                                   '"shifu_scorer_laod"', 1),
+        # the check_types pass: a misspelled class name (javac's most
+        # common first error) must not ship
+        "bad_type": src.replace("MemorySegment seg", "MemorySegmen seg", 1),
     }
     for name, text in cases.items():
         bad = broken_dir / "ShifuTpuModel.java"
@@ -139,6 +142,64 @@ def test_java_sources_structurally_valid(tmp_path):
         r2 = subprocess.run([sys_mod.executable, checker, str(bad)],
                             capture_output=True, text=True, timeout=60)
         assert r2.returncode != 0, f"validator missed the {name} typo"
+    # same for the adapter: a misspelled Shifu interface type
+    adapter_src = open(os.path.join(java_dir, "ml", "shifu", "shifu", "tpu",
+                                    "ShifuTpuComputable.java")).read()
+    bad = broken_dir / "ShifuTpuComputable.java"
+    bad.write_text(adapter_src.replace("GenericModelConfig config",
+                                       "GenericModelconfig config", 1))
+    r3 = subprocess.run([sys_mod.executable, checker, str(bad)],
+                        capture_output=True, text=True, timeout=60)
+    assert r3.returncode != 0, "validator missed a misspelled Shifu type"
+
+
+def test_computable_adapter_contract(binding_artifact):
+    """The Shifu plug-in adapter (ShifuTpuComputable implements Computable)
+    against the REAL exported artifact: its init() reads exactly the
+    properties the reference read (modelpath/inputnames/outputnames/tags,
+    TensorflowModel.java:112-172), and its compute() delegates to the same
+    native call the ctypes path scores with.  No JVM exists here, so the
+    adapter's init parse/validation logic is replayed in Python against the
+    artifact's GenericModelConfig.json + the properties Shifu injects, and
+    the delegation target (ShifuTpuModel.compute == shifu_scorer_compute)
+    is the value the binding_artifact fixture already scored."""
+    import json
+
+    lib, artifact, single, _batch = binding_artifact
+    adapter = open(os.path.join(JAVA_DIR, "ml", "shifu", "shifu", "tpu",
+                                "ShifuTpuComputable.java")).read()
+
+    # the adapter reads exactly these keys — keep source and sidecar in sync
+    for key in ('"modelpath"', '"outputnames"', '"tags"', '"nativelib"'):
+        assert key in adapter, f"adapter no longer reads {key}"
+    assert "getInputnames()" in adapter
+    assert "implements Computable" in adapter
+    assert "model.compute(input.getData())" in adapter  # the delegation
+
+    with open(os.path.join(artifact, "GenericModelConfig.json")) as f:
+        sidecar = json.load(f)
+    # Shifu's loader injects modelpath into properties before calling
+    # init(config) — replay that, then the adapter's validation gates
+    props = dict(sidecar["properties"])
+    props["modelpath"] = artifact
+    inputnames = sidecar["inputnames"]
+    assert props.get("modelpath")
+    assert inputnames and inputnames[0] == "shifu_input_0"
+    out = props.get("outputnames")
+    assert isinstance(out, str) and out  # the reference's String branch
+    tags = props.get("tags")
+    assert isinstance(tags, list) and tags
+    for name in inputnames[1:]:  # extra-input parity gate
+        assert name in props, f"sidecar lost the value for input {name!r}"
+
+    # the delegation target produces the fixture's reference score (same
+    # .so, same model.bin, same row the C harness scores)
+    from shifu_tpu.runtime import NativeScorer
+    ns = NativeScorer(props["modelpath"])
+    row = _gen(np.arange(8, dtype=np.int64)).astype(np.float64)
+    got = ns.compute(row)
+    ns.close()
+    assert got == pytest.approx(single, abs=1e-12)
 
 
 def test_java_smoke_when_jdk_present(binding_artifact, tmp_path):
