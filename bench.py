@@ -620,14 +620,65 @@ def main() -> None:
                 stg_state, last = scan(stg_state, blk)
             float(last)
 
+        # same tier on the int8 wire: the out-of-HBM path big jobs use is
+        # exactly where halving wire bytes pays (1 B/feature vs 2).  The
+        # int8 variant is isolated — its failure records staged_int8_error
+        # and degrades to the bf16-only measurement, never erasing it
+        staged_epoch_q = None
+        try:
+            import dataclasses as _dc2
+            job_qs = job.replace(
+                data=_dc2.replace(job.data, wire_dtype="int8"))
+            wcast_q = pipe_lib.wire_cast_fn(schema, job_qs.data,
+                                            job_qs.model.compute_dtype)
+            # quantize ONCE up front — the product path encodes at parse
+            # time (load_datasets int8 storage), so steady-state epochs
+            # stage int8 host arrays with no per-block encode cost
+            qcols = wcast_q({"features": ds.features})
+            ds_q = pipe_lib.TabularDataset(qcols["features"], ds.target,
+                                           ds.weight)
+            scan_q = make_epoch_scan_step(job_qs, mesh)
+            stq_state = init_state(job_qs, num_features, mesh)
+
+            def staged_epoch_q(epoch):
+                nonlocal stq_state
+                last = None
+                for blk in pipe_lib.prefetch_to_device(
+                        pipe_lib.staged_epoch_blocks(ds_q, batch_size,
+                                                     epoch=epoch,
+                                                     block_batches=chunk),
+                        mesh, size=2, put_fn=put):
+                    stq_state, last = scan_q(stq_state, blk)
+                float(last)
+
+            staged_epoch_q(0)  # compile the int8 variant
+        except Exception as e:
+            extras["staged_int8_error"] = str(e)[:200]
+            staged_epoch_q = None
+
         staged_epoch(0)  # compile both chunk shapes
-        best = 0.0
+        # INTERLEAVED bf16/int8 epochs: a drifting co-tenant load spike on
+        # the shared host cannot bias one format's best-of window.  Both
+        # record incrementally so a failing later rep keeps earlier ones.
+        best = best_q = 0.0
         for e in range(1, 4):
             t0 = time.perf_counter()
             staged_epoch(e)
             best = max(best, (stg_rows // batch_size) * batch_size
                        / (time.perf_counter() - t0) / n_chips)
-        extras["staged_samples_per_sec_per_chip"] = round(best, 1)
+            extras["staged_samples_per_sec_per_chip"] = round(best, 1)
+            if staged_epoch_q is None:
+                continue
+            try:
+                t0 = time.perf_counter()
+                staged_epoch_q(e)
+                best_q = max(best_q, (stg_rows // batch_size) * batch_size
+                             / (time.perf_counter() - t0) / n_chips)
+                extras["staged_int8_samples_per_sec_per_chip"] = round(
+                    best_q, 1)
+            except Exception as e2:
+                extras["staged_int8_error"] = str(e2)[:200]
+                staged_epoch_q = None
         del ds, stg_state
 
         # raw H2D bandwidth — the staged tier's roofline on this rig (the
@@ -640,6 +691,10 @@ def main() -> None:
         wire_bytes = num_features * 2 + 4 + 4
         extras["staged_h2d_roofline_fraction"] = round(
             best * n_chips * wire_bytes / h2d_best, 3)
+        if best_q > 0:
+            wire_bytes_q = num_features * 1 + 4 + 4
+            extras["staged_int8_h2d_roofline_fraction"] = round(
+                best_q * n_chips * wire_bytes_q / h2d_best, 3)
     except _SkipTier:
         pass
     except Exception as e:
@@ -837,27 +892,29 @@ def main() -> None:
                 best_cold, 1)
             for p in paths:
                 read_file_cached(p, cache_dir=cdir)
-            train_fn(e2e_job(cache=cdir), console=lambda s: None)  # project
-            best_bf16 = 0.0
-            for _ in range(2):
+            # warm both formats (compile + populate each format's cache
+            # entries — the wire grid rides in the cache key), then measure
+            # INTERLEAVED bf16/int8 reps so a drifting co-tenant load spike
+            # on the shared host cannot bias one format's best-of window
+            train_fn(e2e_job(cache=cdir), console=lambda s: None)
+            train_fn(e2e_job(cache=cdir, wire="int8"), console=lambda s: None)
+            best_bf16 = best_cached = 0.0
+            for _ in range(3):
+                # record INCREMENTALLY: a failing rep (transient tunnel
+                # error) must not discard the reps already measured
                 r = train_fn(e2e_job(cache=cdir), console=lambda s: None)
                 best_bf16 = max(best_bf16,
                                 n_train / r.history[0].epoch_time / n_chips)
-            extras["e2e_cached_disk_bf16_samples_per_sec_per_chip"] = round(
-                best_bf16, 1)
-            extras["e2e_auc_bf16"] = round(r.history[0].valid_auc, 4)
-            # int8 wire: project once (separate cache entries — the wire
-            # grid rides in the cache key), then measure steady state
-            train_fn(e2e_job(cache=cdir, wire="int8"), console=lambda s: None)
-            best_cached = 0.0
-            for _ in range(3):
+                extras["e2e_cached_disk_bf16_samples_per_sec_per_chip"] = \
+                    round(best_bf16, 1)
+                extras["e2e_auc_bf16"] = round(r.history[0].valid_auc, 4)
                 r = train_fn(e2e_job(cache=cdir, wire="int8"),
                              console=lambda s: None)
                 best_cached = max(best_cached,
                                   n_train / r.history[0].epoch_time / n_chips)
-            extras["e2e_cached_disk_samples_per_sec_per_chip"] = round(
-                best_cached, 1)
-            extras["e2e_auc_int8"] = round(r.history[0].valid_auc, 4)
+                extras["e2e_cached_disk_samples_per_sec_per_chip"] = round(
+                    best_cached, 1)
+                extras["e2e_auc_int8"] = round(r.history[0].valid_auc, 4)
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
             shutil.rmtree(cdir, ignore_errors=True)
@@ -907,6 +964,7 @@ _HEADLINE_OPTIONAL = (
     "e2e_auc_bf16",
     "resident_int8_samples_per_sec_per_chip",
     "staged_samples_per_sec_per_chip",
+    "staged_int8_samples_per_sec_per_chip",
     "staged_h2d_roofline_fraction",
     "ladder_deepfm_100kvocab_samples_per_sec_per_chip",
     "ladder_deepfm_100kvocab_hbm_roofline_fraction",

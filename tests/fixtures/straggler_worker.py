@@ -85,10 +85,41 @@ def main() -> None:
     r = train(job, train_ds=tds, valid_ds=vds, mesh=mesh,
               console=lines.append)
     assert np.isfinite(r.history[-1].train_error)
-
     straggler = [l for l in lines if "hosts by input time" in l]
+
+    # -- streamed multihost first epoch: the tier where disk parse actually
+    # happens.  The slow rank stalls in ITS OWN first_epoch_blocks producer
+    # (before the round allgather), so only the timed local pull — not the
+    # gang-synchronizing agreement — may enter the straggler sort.  The
+    # data dir is SHARED (written by the test before spawn): file-shard
+    # round-robin needs every host to see the same global listing.
+    tmp = os.environ["STRAGGLER_DATA_DIR"]
+    if rank == slow_rank:
+        # restore the staged-tier injection first: only the STREAMED pull
+        # may be slow in this run, so the assertion isolates the streamed
+        # path's timing
+        pipe.staged_epoch_blocks = orig
+        orig_blocks = pipe.StreamingLoader.first_epoch_blocks
+
+        def slow_first_epoch_blocks(self, *a, **k):
+            time.sleep(2.0)
+            yield from orig_blocks(self, *a, **k)
+
+        pipe.StreamingLoader.first_epoch_blocks = slow_first_epoch_blocks
+
+    import dataclasses
+    sjob = job.replace(data=dataclasses.replace(
+        job.data, paths=(tmp,), valid_ratio=0.1, stream_first_epoch=True))
+    slines: list[str] = []
+    rs = train(sjob, mesh=mesh, console=slines.append)
+    assert np.isfinite(rs.history[-1].train_error)
+    stream_straggler = [l for l in slines if "hosts by input time" in l]
+    streamed = any("Streaming first epoch" in l for l in slines)
+
     distributed.barrier()
-    print("RESULT " + json.dumps({"process": rank, "lines": straggler}),
+    print("RESULT " + json.dumps({"process": rank, "lines": straggler,
+                                  "stream_lines": stream_straggler,
+                                  "streamed": streamed}),
           flush=True)
 
 

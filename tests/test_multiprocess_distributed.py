@@ -100,32 +100,47 @@ def test_straggler_line_names_slow_rank():
     artificially stalled, and the chief's slowest-first per-host line
     (profiler.straggler_line — successor of the AM's worker sort,
     TensorflowSession.java:515-549) must name rank 2 first."""
+    import tempfile
+
+    from shifu_tpu.data import synthetic
+
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "fixtures", "straggler_worker.py")
     port = _free_port()
     nproc, slow_rank = 4, 2
+    import shutil
+
+    # shared streamed-epoch data: one file per rank off a global listing
+    data_dir = tempfile.mkdtemp(prefix="straggler_data_")
+    schema = synthetic.make_schema(num_features=6)
+    synthetic.write_files(synthetic.make_rows(1024, schema, seed=7),
+                          data_dir, num_files=nproc)
     base_env = {k: v for k, v in os.environ.items()
                 if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     base_env.update({
         "SHIFU_TPU_COORDINATOR": f"127.0.0.1:{port}",
         "SHIFU_TPU_NUM_PROCESSES": str(nproc),
         "STRAGGLER_SLOW_RANK": str(slow_rank),
+        "STRAGGLER_DATA_DIR": data_dir,
     })
     procs = []
-    for pid in range(nproc):
-        env = {**base_env, "SHIFU_TPU_PROCESS_ID": str(pid)}
-        procs.append(subprocess.Popen(
-            [sys.executable, "-u", worker], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=_TIMEOUT_S)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("straggler worker timed out")
-        outs.append((p.returncode, out))
+    try:
+        for pid in range(nproc):
+            env = {**base_env, "SHIFU_TPU_PROCESS_ID": str(pid)}
+            procs.append(subprocess.Popen(
+                [sys.executable, "-u", worker], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("straggler worker timed out")
+            outs.append((p.returncode, out))
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
     if any("RESULT-SKIP" in out for _, out in outs):
         pytest.skip("jax build lacks gloo CPU collectives")
     results = {}
@@ -150,6 +165,14 @@ def test_straggler_line_names_slow_rank():
         # and every rank appears
         for r in range(nproc):
             assert f"[{r}]" in line, line
+    # streamed multihost first epoch: the stalled rank's slow PARSE leads
+    # epoch 0's line — the timed local pull, not the round allgather that
+    # synchronizes the gang, feeds the sort
+    assert results[0]["streamed"], "first epoch did not stream"
+    stream_lines = results[0]["stream_lines"]
+    assert stream_lines, "chief printed no straggler line for the stream run"
+    first = stream_lines[0].split("slowest first):")[1].split("|")[0]
+    assert f"[{slow_rank}]" in first, stream_lines[0]
 
 
 def test_pod_spec_parsing(tmp_path):

@@ -181,6 +181,43 @@ def test_disk_path_stores_int8_and_caches(tmp_path, learnable_rows):
     assert r1.history[-1].valid_auc > 0.6
 
 
+def test_local_sgd_trains_on_int8_wire(learnable_rows):
+    """SAGN local-SGD (vmapped per-shard replicas) composes with the int8
+    wire: the reshaped int8 shard batches decode inside the per-shard loss."""
+    from shifu_tpu.train import train
+
+    job = _job(wire="int8")
+    job = job.replace(
+        data=dataclasses.replace(job.data, device_resident_bytes=0,
+                                 block_batches=4),
+        train=dataclasses.replace(job.train, local_sgd_window=2,
+                                  epochs=2,
+                                  optimizer=dataclasses.replace(
+                                      job.train.optimizer, name="sgd",
+                                      learning_rate=0.05)))
+    tds, vds = _split(learnable_rows, job)
+    r = train(job, train_ds=tds, valid_ds=vds, console=lambda s: None)
+    assert np.isfinite(r.history[-1].train_error)
+    assert np.isfinite(r.history[-1].valid_auc)
+
+
+def test_eval_pads_partial_batch_int8(learnable_rows):
+    """Full-dataset eval under the int8 wire with a row count that does NOT
+    divide the eval batch: the zero-weight tail pads BEFORE the quantize
+    cast, and every real row still scores."""
+    from shifu_tpu.train import evaluate, init_state
+    from shifu_tpu.train.step import make_eval_step
+
+    job = _job(wire="int8")
+    tds, vds = _split(learnable_rows, job)
+    odd = pipe.TabularDataset(vds.features[:257], vds.target[:257],
+                              vds.weight[:257])
+    state = init_state(job, job.schema.feature_count)
+    err, auc = evaluate(state, odd, job, make_eval_step(job))
+    assert np.isfinite(err)
+    assert np.isfinite(auc)
+
+
 def test_xml_keys_reach_wire_config():
     """shifu.data.wire-dtype / wire-int8-clip flow from the Hadoop-style
     XML layer onto DataConfig (the CLI's config surface)."""
