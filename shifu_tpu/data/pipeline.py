@@ -73,9 +73,7 @@ def _load_one_projected(item: tuple[int, str], schema: DataSchema,
         # equals quantizing at device_put time): 1/4 the host RAM, 1/4 the
         # projected-cache bytes, zero per-epoch encode cost
         scale, offset = wire_params(schema, data)
-        x = cols["features"].astype(np.float32, copy=False)
-        q = np.clip(np.rint((x - offset) * (1.0 / scale)), -127, 127)
-        cols["features"] = q.astype(np.int8)
+        cols["features"] = wire_quantize(cols["features"], scale, offset)
     n = cols["features"].shape[0]
     row_ids = ((np.uint64(file_idx) << np.uint64(40))
                + np.arange(n, dtype=np.uint64))
@@ -177,6 +175,17 @@ def wire_mode(schema: DataSchema, data: DataConfig,
     return mode
 
 
+def wire_quantize(x: np.ndarray, scale: np.ndarray,
+                  offset: np.ndarray) -> np.ndarray:
+    """The ONE int8 wire encoder (grid contract single-sourced: callers at
+    parse time, per-block cast time, and the bench all share it; the
+    device-side inverse is train/step.make_wire_decode):
+    round((x - offset) / scale), saturated to [-127, 127], int8."""
+    xf = np.asarray(x, np.float32)
+    q = np.clip(np.rint((xf - offset) * (1.0 / scale)), -127, 127)
+    return q.astype(np.int8)
+
+
 def wire_params(schema: DataSchema,
                 data: DataConfig) -> tuple[np.ndarray, np.ndarray]:
     """Per-column (scale, offset) vectors for the int8 wire grid.
@@ -208,17 +217,13 @@ def wire_cast_fn(schema: DataSchema, data: DataConfig,
     mode = wire_mode(schema, data, model_compute_dtype)
     if mode == "int8":
         scale, offset = wire_params(schema, data)
-        inv = (1.0 / scale).astype(np.float32)
-        shift = offset.astype(np.float32)
 
         def cast_q(b: dict) -> dict:
             f = b.get("features")
             if f is None or f.dtype == np.int8:  # already wire dtype
                 return b
-            x = np.asarray(f, np.float32)  # bf16-stored input quantizes too
-            q = np.clip(np.rint((x - shift) * inv), -127, 127)
             out = dict(b)
-            out["features"] = q.astype(np.int8)
+            out["features"] = wire_quantize(f, scale, offset)
             return out
 
         return cast_q

@@ -105,12 +105,15 @@ def _is_our_job(pid: int, job: Optional[dict]) -> bool:
     """Guard against stale/recycled pids and wrong-machine job dirs: the
     recorded pid must belong to a shifu_tpu dispatcher ON the recording
     host — an unclean daemon death followed by pid reuse must not make
-    `kill` SIGKILL an innocent process tree."""
+    `kill` SIGKILL an innocent process tree.  Both spellings are matched:
+    `python -m shifu_tpu...` AND the installed `shifu-tpu` console script
+    (whose cmdline carries only the hyphenated form)."""
     if job and job.get("host") and job["host"] != os.uname().nodename:
         return False
     try:
         with open(f"/proc/{pid}/cmdline", "rb") as f:
-            return b"shifu_tpu" in f.read()
+            cmd = f.read()
+        return b"shifu_tpu" in cmd or b"shifu-tpu" in cmd
     except OSError:
         # no /proc (or no permission): fall back to pid liveness alone
         return True
@@ -210,16 +213,41 @@ def attach(out_dir: str, echo=print, poll_seconds: float = 0.5,
         return 0  # stop following; the job keeps running
 
 
-def _release_slice(out_dir: str, echo) -> None:
+def _release_slice(out_dir: str, echo, force: bool = False) -> bool:
     """Best-effort release of a provisioned slice the job dir records —
     killing the application frees its compute (YARN-RM parity), and an
-    unclean dispatcher death must not leave a billing TPU behind."""
+    unclean dispatcher death must not leave a billing TPU behind.
+
+    Guarded at THIS level so every kill() branch gets it: when the marker
+    records a LIVE provisioning dispatcher (a foreground `--provision` run
+    — it writes no job.json, so a stale job.json in the same dir must not
+    bypass the check) or was written on another host (this host's pid
+    table proves nothing), refuse unless `force`.  Returns False when the
+    release was refused."""
     try:
-        from .provision import release_from_marker
+        from .provision import read_marker, release_from_marker
+        marker = read_marker(out_dir)
+        if marker and not force:
+            mpid = marker.get("pid")
+            mhost = marker.get("host")
+            if mhost and mhost != os.uname().nodename:
+                echo(f"provision marker was written on {mhost!r} — run kill "
+                     "there (its pid table can check dispatcher liveness) "
+                     "or re-run with --force")
+                return False
+            if (isinstance(mpid, int) and _alive(mpid)
+                    and _is_our_job(mpid, marker)):
+                echo(f"provision marker records a LIVE dispatcher (pid "
+                     f"{mpid}) — a foreground --provision run is still "
+                     "using the slice; SIGTERM that process (or re-run "
+                     "with --force) instead")
+                return False
         release_from_marker(out_dir, echo=echo)
+        return True
     except Exception as e:
         echo(f"provision: release check failed ({e}); see provision.json "
              f"in {out_dir}")
+        return True
 
 
 def kill(out_dir: str, echo=print, grace_seconds: float = 10.0,
@@ -234,46 +262,27 @@ def kill(out_dir: str, echo=print, grace_seconds: float = 10.0,
         echo(f"no submitted job under {out_dir}")
         # a FOREGROUND --provision run writes no job.json but may have
         # left a provision.json trail (unclean dispatcher death) — the
-        # rescue release must still run.  But if the marker's recorded
-        # dispatcher is STILL ALIVE (a foreground run mid-training), a
-        # stray `kill` must not delete the slice out from under the live
-        # gang: refuse unless --force.
-        try:
-            from .provision import read_marker
-            marker = read_marker(out_dir)
-        except Exception:
-            marker = None
-        mpid = marker.get("pid") if marker else None
-        mhost = marker.get("host") if marker else None
-        if (not force and mhost and mhost != os.uname().nodename):
-            # shared-filesystem job dir: the dispatcher may be ALIVE on the
-            # recording host and this host's pid table says nothing about
-            # it — mirror the job.json path's host-mismatch refusal
-            echo(f"provision marker was written on {mhost!r} — run kill "
-                 "there (its pid table can check dispatcher liveness) or "
-                 "re-run with --force")
-            return 1
-        if (not force and isinstance(mpid, int) and _alive(mpid)
-                and _is_our_job(mpid, marker)):
-            echo(f"provision marker records a LIVE dispatcher (pid {mpid}) "
-                 "— a foreground --provision run is still using the slice; "
-                 "SIGTERM that process (or re-run with --force) instead")
-            return 1
-        _release_slice(out_dir, echo)
+        # rescue release must still run.  _release_slice refuses when the
+        # marker records a LIVE dispatcher or a foreign host (a stray
+        # `kill` must not delete the slice under a live gang).
+        _release_slice(out_dir, echo, force=force)
         return 1
     pid = job["pid"]
     if not _alive(pid):
         echo(f"job pid {pid} is not running")
-        _release_slice(out_dir, echo)
-        return 0
+        # exit 1 when a recorded slice was deliberately NOT released (live
+        # foreground dispatcher / foreign host): the operator must act
+        return 0 if _release_slice(out_dir, echo, force=force) else 1
     if not _is_our_job(pid, job):
         echo(f"pid {pid} is not this job's dispatcher (recycled pid or a "
              f"different host — job.json says {job.get('host')!r}); "
              "refusing to signal it")
         if not (job.get("host") and job["host"] != os.uname().nodename):
             # same host, recycled pid: the dispatcher is truly gone — a
-            # recorded slice can still be released safely
-            _release_slice(out_dir, echo)
+            # recorded slice can still be released safely (the marker
+            # guard in _release_slice still protects a separate live
+            # foreground run sharing this dir)
+            _release_slice(out_dir, echo, force=force)
         return 1
     try:
         os.killpg(pid, signal.SIGTERM)
@@ -286,13 +295,11 @@ def kill(out_dir: str, echo=print, grace_seconds: float = 10.0,
     while time.monotonic() < deadline:
         if not _alive(pid):
             echo(f"job pid {pid} terminated")
-            _release_slice(out_dir, echo)
-            return 0
+            return 0 if _release_slice(out_dir, echo, force=force) else 1
         time.sleep(0.2)
     try:
         os.killpg(pid, signal.SIGKILL)
     except (ProcessLookupError, PermissionError, OSError):
         pass
     echo(f"job pid {pid} killed")
-    _release_slice(out_dir, echo)
-    return 0
+    return 0 if _release_slice(out_dir, echo, force=force) else 1

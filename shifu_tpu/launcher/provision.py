@@ -196,14 +196,22 @@ def delete(spec: ProvisionSpec, echo=print) -> bool:
     materialized (create itself failed) or is already gone, and either way
     there is nothing left to bill — treating it as failure would pin the
     marker forever and make every later `kill` retry a delete that can
-    never succeed."""
+    never succeed.  The match is anchored to the RESOURCE (the NOT_FOUND
+    API code, or 'not found' near the slice's own name): a 'project foo
+    not found' / 'zone bar not found' environment error at release time
+    must stay a FAILURE so the still-billing slice keeps its trail."""
+    import re as re_lib
     try:
         _run(["compute", "tpus", "queued-resources", "delete", spec.name,
               *_common(spec), "--quiet", "--force"])
         echo(f"provision: released {spec.name}")
         return True
     except ProvisionError as e:
-        if "NOT_FOUND" in str(e) or "not found" in str(e).lower():
+        msg = str(e)
+        name = re_lib.escape(spec.name)
+        if ("NOT_FOUND" in msg
+                or re_lib.search(name + r".{0,60}not found", msg, re_lib.I)
+                or re_lib.search(r"not found.{0,60}" + name, msg, re_lib.I)):
             echo(f"provision: {spec.name} not found — nothing to release")
             return True
         echo(f"provision: release of {spec.name} failed ({e}); release "
@@ -294,6 +302,22 @@ def provision_and_run(spec: ProvisionSpec,
     never materialized is harmless: delete answers NOT_FOUND, which counts
     as released, so the marker drains instead of orphaning)."""
     if marker_dir:
+        # a marker dir holds ONE release trail: clobbering a previous
+        # run's marker for a DIFFERENT slice — or for a deliberately KEPT
+        # one — would destroy the only record of a still-billing TPU.
+        # Refuse loudly; overwriting our own (same-name, unkept) stale
+        # trail is fine — delete is idempotent for the same resource.
+        existing = read_marker(marker_dir)
+        if existing and existing.get("name") and (
+                existing["name"] != spec.name or existing.get("keep")):
+            raise ProvisionError(
+                f"{marker_dir}/{MARKER_FILE} already records slice "
+                f"{existing['name']!r}"
+                + (" (kept with --keep-slice)" if existing.get("keep")
+                   else "")
+                + " — release it first (`shifu-tpu kill --force "
+                f"{marker_dir}` or gcloud delete) or use a different "
+                "--output")
         write_marker(spec, marker_dir, keep=keep, echo=echo)
     release = True
     try:

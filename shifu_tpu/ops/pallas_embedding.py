@@ -30,6 +30,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 from .pallas_common import pltpu
@@ -263,6 +264,16 @@ def _segment_flat_min_fields() -> int:
         return _SEGMENT_FLAT_MIN_FIELDS
 
 
+def _segment_use_flat(nc: int, v: int) -> bool:
+    """Route wide schemas to the flattened single-segment_sum form — but
+    ONLY while the flat id space nc*V (+1 sentinel) fits int32: past that,
+    `field * v` would silently overflow and alias gradients into other
+    tables' rows, so giant-vocab-times-many-fields schemas keep the
+    per-table unroll (which has no combined-id limit)."""
+    return (nc >= _segment_flat_min_fields()
+            and nc * v + 1 <= np.iinfo(np.int32).max)
+
+
 def _segment_grad(ids: jax.Array, table_shape, g: jax.Array) -> jax.Array:
     """The same gradient as `_scatter_grad`, lowered as 1-D segment
     reductions instead of one combined 2-D scatter — XLA:TPU turns the
@@ -284,7 +295,7 @@ def _segment_grad(ids: jax.Array, table_shape, g: jax.Array) -> jax.Array:
     ids = ids.astype(jnp.int32)
     wrapped = jnp.where(ids < 0, ids + v, ids)
     gf = g.astype(jnp.float32)
-    if nc < _segment_flat_min_fields():
+    if not _segment_use_flat(nc, v):
         return jnp.stack([
             jax.ops.segment_sum(gf[:, f, :], wrapped[:, f], num_segments=v)
             for f in range(nc)])

@@ -164,6 +164,20 @@ def test_segment_grad_matches_scatter_grad():
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
 
 
+def test_segment_flat_routing_guards_int32_overflow(monkeypatch):
+    """The flattened form's id space is field*V + id in int32: past 2^31
+    combined segments `field * v` would silently alias gradients into
+    other tables, so routing must fall back to the per-table unroll."""
+    from shifu_tpu.ops import pallas_embedding as pe
+
+    monkeypatch.setenv("SHIFU_TPU_SEGMENT_FLAT_MIN_FIELDS", "16")
+    assert pe._segment_use_flat(50, 1000) is True
+    assert pe._segment_use_flat(4, 1000) is False       # narrow: unroll
+    assert pe._segment_use_flat(50, 45_000_000) is False  # nc*v > int32
+    assert pe._segment_use_flat(16, (2**31 - 2) // 16) is True  # boundary
+    assert pe._segment_use_flat(16, 2**31 // 16) is False
+
+
 def test_segment_grad_flattened_matches_scatter_grad(monkeypatch):
     """Wide schemas take the FLATTENED single-segment_sum form (one op at
     any field count instead of an NC-long unroll): same gradient as the

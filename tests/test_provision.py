@@ -44,6 +44,9 @@ if "queued-resources delete" in cmd:
     if os.environ.get("FAKE_GCLOUD_DELETE_NOT_FOUND"):
         sys.stderr.write("ERROR: NOT_FOUND: no such queued resource" + chr(10))
         sys.exit(1)
+    if os.environ.get("FAKE_GCLOUD_FAIL_DELETE_MSG"):
+        sys.stderr.write(os.environ["FAKE_GCLOUD_FAIL_DELETE_MSG"] + chr(10))
+        sys.exit(1)
     sys.exit(1 if os.environ.get("FAKE_GCLOUD_FAIL_DELETE") else 0)
 sys.exit(64)
 """
@@ -380,6 +383,122 @@ def test_kill_refuses_cross_host_marker(fake_gcloud, tmp_path):
     assert not [c for c in _calls(log) if "delete" in c]
     detach.kill(str(out), echo=msgs.append, force=True)
     assert [c for c in _calls(log) if "delete" in c]
+
+
+def test_kill_guard_covers_stale_jobjson_branch(fake_gcloud, tmp_path):
+    """A stale job.json (dead detached job) in the SAME dir as a LIVE
+    foreground --provision run's marker: `kill` takes the dead-pid branch
+    but the marker-liveness guard (now inside _release_slice) must still
+    refuse to delete the live run's slice."""
+    import json as _json
+
+    from shifu_tpu.launcher import detach, provision as prov
+
+    _, log = fake_gcloud
+    out = tmp_path / "mixed"
+    out.mkdir()
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait()
+    (out / detach.JOB_FILE).write_text(_json.dumps(
+        {"pid": dead.pid, "host": os.uname().nodename}))
+    live = subprocess.Popen(
+        [sys.executable, "-c", "import shifu_tpu, time; time.sleep(600)"],
+        env={**os.environ, "PYTHONPATH":
+             REPO + os.pathsep + os.environ.get("PYTHONPATH", "")})
+    try:
+        spec = prov.ProvisionSpec(name="mixed-slice",
+                                  accelerator_type="v5litepod-8",
+                                  zone="us-west4-a")
+        prov.write_marker(spec, str(out))
+        marker = prov.read_marker(str(out))
+        marker["pid"] = live.pid
+        (out / prov.MARKER_FILE).write_text(_json.dumps(marker))
+        msgs = []
+        rc = detach.kill(str(out), echo=msgs.append)
+        assert rc == 1  # refused release surfaces in the exit code
+        assert any("LIVE dispatcher" in m for m in msgs), msgs
+        assert prov.read_marker(str(out)) is not None
+        assert not [c for c in _calls(log) if "delete" in c]
+        assert detach.kill(str(out), echo=msgs.append, force=True) == 0
+        assert prov.read_marker(str(out)) is None
+    finally:
+        live.kill()
+        live.wait()
+
+
+def test_is_our_job_matches_console_script_cmdline(tmp_path):
+    """The installed `shifu-tpu` console script's cmdline carries only the
+    HYPHENATED form — the identity guard must match it, or a stray kill
+    would fail open and delete a live run's slice."""
+    from shifu_tpu.launcher import detach
+
+    (tmp_path / "shifu-tpu").write_text("import sys, time\n"
+                                        "print('up', flush=True)\n"
+                                        "time.sleep(float(sys.argv[1]))\n")
+    live = subprocess.Popen(
+        [sys.executable, str(tmp_path / "shifu-tpu"), "60"],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        live.stdout.readline()  # child has exec'd: cmdline is final
+        assert detach._is_our_job(live.pid, None) is True
+    finally:
+        live.kill()
+        live.wait()
+
+
+def test_marker_clobber_refused_for_kept_or_foreign_slice(fake_gcloud,
+                                                          tmp_path):
+    """provision_and_run must not overwrite a marker that is the only
+    release trail of a KEPT slice or of a DIFFERENT slice; re-running the
+    same (unkept) name refreshes its own trail normally."""
+    from shifu_tpu.launcher import provision as prov
+
+    out = tmp_path / "trail"
+    kept = prov.ProvisionSpec(name="kept-x", accelerator_type="v5litepod-8",
+                              zone="us-west4-a")
+    prov.write_marker(kept, str(out), keep=True)
+    with pytest.raises(prov.ProvisionError, match="kept-x"):
+        prov.provision_and_run(kept, lambda h: 0, echo=lambda s: None,
+                               marker_dir=str(out))
+    assert prov.read_marker(str(out))["name"] == "kept-x"  # trail intact
+
+    out2 = tmp_path / "trail2"
+    other = prov.ProvisionSpec(name="other-y",
+                               accelerator_type="v5litepod-8",
+                               zone="us-west4-a")
+    prov.write_marker(other, str(out2))
+    new = prov.ProvisionSpec(name="new-z", accelerator_type="v5litepod-8",
+                             zone="us-west4-a")
+    with pytest.raises(prov.ProvisionError, match="other-y"):
+        prov.provision_and_run(new, lambda h: 0, echo=lambda s: None,
+                               marker_dir=str(out2))
+    # same unkept name: overwrite allowed, normal lifecycle completes
+    rc = prov.provision_and_run(other, lambda h: 0, echo=lambda s: None,
+                                marker_dir=str(out2))
+    assert rc == 0
+    assert prov.read_marker(str(out2)) is None  # released + cleared
+
+
+def test_delete_not_found_is_anchored_to_the_resource(fake_gcloud, tmp_path,
+                                                      monkeypatch):
+    """'project/zone ... not found' environment errors at release time must
+    stay FAILURES (trail preserved); only the resource's own NOT_FOUND
+    counts as released."""
+    from shifu_tpu.launcher import provision as prov
+
+    out = tmp_path / "env"
+    spec = prov.ProvisionSpec(name="envslice",
+                              accelerator_type="v5litepod-8",
+                              zone="us-west4-a")
+    prov.write_marker(spec, str(out))
+    monkeypatch.setenv("FAKE_GCLOUD_FAIL_DELETE_MSG",
+                       "ERROR: project my-proj not found")
+    assert prov.release_from_marker(str(out), echo=lambda s: None) is False
+    assert prov.read_marker(str(out)) is not None  # trail preserved
+    monkeypatch.setenv("FAKE_GCLOUD_FAIL_DELETE_MSG",
+                       "ERROR: queued resource envslice not found")
+    assert prov.release_from_marker(str(out), echo=lambda s: None) is True
+    assert prov.read_marker(str(out)) is None
 
 
 def test_kill_refuses_live_foreground_provision(fake_gcloud, tmp_path):
