@@ -213,7 +213,8 @@ def attach(out_dir: str, echo=print, poll_seconds: float = 0.5,
         return 0  # stop following; the job keeps running
 
 
-def _release_slice(out_dir: str, echo, force: bool = False) -> bool:
+def _release_slice(out_dir: str, echo, force: bool = False,
+                   killed_pid: Optional[int] = None) -> bool:
     """Best-effort release of a provisioned slice the job dir records —
     killing the application frees its compute (YARN-RM parity), and an
     unclean dispatcher death must not leave a billing TPU behind.
@@ -235,8 +236,12 @@ def _release_slice(out_dir: str, echo, force: bool = False) -> bool:
                      "there (its pid table can check dispatcher liveness) "
                      "or re-run with --force")
                 return False
-            if (isinstance(mpid, int) and _alive(mpid)
-                    and _is_our_job(mpid, marker)):
+            # A detached --provision job's marker pid IS the job pid; when
+            # kill() just signalled that exact pid, _alive can still answer
+            # True for a just-SIGKILLed (or zombie) process — that is not a
+            # live foreground dispatcher, so the guard must not fire.
+            if (isinstance(mpid, int) and mpid != killed_pid
+                    and _alive(mpid) and _is_our_job(mpid, marker)):
                 echo(f"provision marker records a LIVE dispatcher (pid "
                      f"{mpid}) — a foreground --provision run is still "
                      "using the slice; SIGTERM that process (or re-run "
@@ -301,5 +306,12 @@ def kill(out_dir: str, echo=print, grace_seconds: float = 10.0,
         os.killpg(pid, signal.SIGKILL)
     except (ProcessLookupError, PermissionError, OSError):
         pass
+    # give the kernel a beat to reap: _alive() answers True for a
+    # just-SIGKILLed or zombie process, which would trip the live-
+    # dispatcher guard on the marker we are about to release
+    reap_deadline = time.monotonic() + 2.0
+    while time.monotonic() < reap_deadline and _alive(pid):
+        time.sleep(0.1)
     echo(f"job pid {pid} killed")
-    return 0 if _release_slice(out_dir, echo, force=force) else 1
+    return 0 if _release_slice(out_dir, echo, force=force,
+                               killed_pid=pid) else 1

@@ -301,6 +301,13 @@ def provision_and_run(spec: ProvisionSpec,
     death mid-create still leaves the trail (a marker for a slice that
     never materialized is harmless: delete answers NOT_FOUND, which counts
     as released, so the marker drains instead of orphaning)."""
+    # Whether the marker dir already trailed THIS slice name before we
+    # (re)wrote it: a same-name, unkept marker survives the clobber guard
+    # precisely because it is the trail of a previous unclean death — and
+    # that is also the case where create() answers ALREADY_EXISTS (the
+    # dead run's slice still exists and bills).  In that case the marker
+    # is the ONLY release path (`kill --force`), so it must be kept.
+    prior_same_name_trail = False
     if marker_dir:
         # a marker dir holds ONE release trail: clobbering a previous
         # run's marker for a DIFFERENT slice — or for a deliberately KEPT
@@ -318,7 +325,14 @@ def provision_and_run(spec: ProvisionSpec,
                 + " — release it first (`shifu-tpu kill --force "
                 f"{marker_dir}` or gcloud delete) or use a different "
                 "--output")
-        write_marker(spec, marker_dir, keep=keep, echo=echo)
+        prior_same_name_trail = bool(existing and existing.get("name"))
+        # written UNKEPT even under --keep-slice: the keep flag makes
+        # release_from_marker refuse unconditionally, and until create()
+        # succeeds this marker may be trailing a PREVIOUS unclean death's
+        # still-billing slice (the ALREADY_EXISTS branch below), whose only
+        # kill path it is.  The keep flag is recorded once create() proves
+        # the slice is this run's own.
+        write_marker(spec, marker_dir, keep=False, echo=echo)
     release = True
     try:
         # create() inside the release scope: a failed create still runs
@@ -326,16 +340,23 @@ def provision_and_run(spec: ProvisionSpec,
         # EXCEPT name collisions: ALREADY_EXISTS means a slice this run
         # did NOT create (e.g. an earlier --keep-slice run) — releasing
         # it would tear down a live slice we don't own, so drop only our
-        # marker and leave the resource alone.
+        # marker and leave the resource alone.  UNLESS the marker dir
+        # already trailed this same name before this run: then the
+        # colliding slice is a previous unclean death's still-billing
+        # resource and the marker is its only kill path — keep it.
         try:
             create(spec, echo=echo)
         except ProvisionError as e:
             if ("ALREADY_EXISTS" in str(e)
                     or "already exists" in str(e).lower()):
                 release = False
-                if marker_dir:
+                if marker_dir and not prior_same_name_trail:
                     clear_marker(marker_dir)
             raise
+        if marker_dir and keep:
+            # create succeeded: the slice is ours — NOW record the keep
+            # flag so the clobber guard protects it from later runs
+            write_marker(spec, marker_dir, keep=True, echo=echo)
         await_ready(spec, echo=echo)
         hosts = worker_hosts(spec)
         echo(f"provision: {len(hosts)} worker hosts: {', '.join(hosts)}")

@@ -363,6 +363,37 @@ def test_already_exists_create_failure_releases_nothing(fake_gcloud,
     assert not [c for c in _calls(log) if "delete" in c]  # slice untouched
 
 
+def test_already_exists_keeps_prior_unclean_death_trail(fake_gcloud,
+                                                        tmp_path,
+                                                        monkeypatch):
+    """A retry after an UNCLEAN death of the same-named run: the dead run's
+    slice still exists (create answers ALREADY_EXISTS) and still bills —
+    the marker is its ONLY release trail, so it must be KEPT (and kept
+    UNKEPT even when the retry passed --keep-slice: the keep flag is
+    recorded only once create() proves the slice is this run's own), so
+    `kill`/`release_from_marker` can still drain the orphan."""
+    from shifu_tpu.launcher import provision as prov
+
+    _, log = fake_gcloud
+    out = tmp_path / "retry"
+    spec = prov.ProvisionSpec(name="orphaned", accelerator_type="v5litepod-8",
+                              zone="us-west4-a")
+    prov.write_marker(spec, str(out))  # the dead run's trail
+    monkeypatch.setenv("FAKE_GCLOUD_FAIL_CREATE", "ALREADY_EXISTS")
+    for keep in (False, True):
+        with pytest.raises(prov.ProvisionError, match="ALREADY_EXISTS"):
+            prov.provision_and_run(spec, lambda hosts: 0,
+                                   echo=lambda s: None, keep=keep,
+                                   marker_dir=str(out))
+        marker = prov.read_marker(str(out))
+        assert marker and marker["name"] == "orphaned"  # trail preserved
+        assert not marker.get("keep")  # and still releasable
+    monkeypatch.delenv("FAKE_GCLOUD_FAIL_CREATE")
+    assert prov.release_from_marker(str(out), echo=lambda s: None) is True
+    assert prov.read_marker(str(out)) is None
+    assert [c for c in _calls(log) if "delete" in c]
+
+
 def test_kill_refuses_cross_host_marker(fake_gcloud, tmp_path):
     """A marker written on ANOTHER host (shared-filesystem job dir) must
     not be released from here — this host's pid table says nothing about
