@@ -148,6 +148,19 @@ class DataConfig:
     # so anything beyond +-clip saturates.  Shifu ZSCALE clamps at 4-6
     # sigma, so the default 8.0 never clips in-contract data.
     wire_int8_clip: float = 8.0
+    # compact wire for the TARGET column: "auto" sends uint8 (1 B instead of
+    # 4) exactly when every value in the block is an integer in [0, 255] —
+    # always true for Shifu's binary labels — decoded back to f32 on device
+    # (train/step.py); lossless by construction, falls back to f32 per block
+    # otherwise.  "uint8" forces (non-representable targets raise);
+    # "float32" disables.
+    wire_label_dtype: str = "auto"
+    # compact wire for the WEIGHT column: "auto" elides the column entirely
+    # (0 B on the wire) when every weight in the block is exactly 1.0 — the
+    # common case for Shifu jobs without a weightColumnName — with the
+    # device step synthesizing ones (bit-identical losses).  "elide" forces
+    # (non-unit weights raise); "float32" disables.
+    wire_weight_mode: str = "auto"
 
     def validate(self) -> None:
         if not (0.0 <= self.valid_ratio < 1.0):
@@ -161,6 +174,14 @@ class DataConfig:
         if self.wire_int8_clip <= 0:
             raise ConfigError(
                 f"wire_int8_clip must be positive: {self.wire_int8_clip}")
+        if self.wire_label_dtype not in ("auto", "uint8", "float32"):
+            raise ConfigError(
+                f"wire_label_dtype must be auto/uint8/float32: "
+                f"{self.wire_label_dtype!r}")
+        if self.wire_weight_mode not in ("auto", "elide", "float32"):
+            raise ConfigError(
+                f"wire_weight_mode must be auto/elide/float32: "
+                f"{self.wire_weight_mode!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -340,10 +361,22 @@ class TrainConfig:
     # 158-159 — GradientDescent is commented out); this tier is plain SGD
     # (see validate() below and PARITY.md "Local SGD").
     local_sgd_window: int = 0
+    # rows-touched-only optimizer updates for gather-path embedding tables
+    # (train/sparse_embed.py — the SPMD successor of TF's IndexedSlices
+    # sparse applies the reference relied on, ssgd_monitor.py:203-206).
+    # "auto": engage when the optimizer has a sparse rule (adadelta/sgd),
+    # the table is not model-axis sharded, and the vocab is large enough
+    # that dense optimizer traffic dominates; "on": require it (raise with
+    # the specific blocker otherwise); "off": always dense.
+    sparse_embedding_update: str = "auto"
 
     def validate(self) -> None:
         if self.epochs <= 0:
             raise ConfigError("epochs must be positive")
+        if self.sparse_embedding_update not in ("auto", "on", "off"):
+            raise ConfigError(
+                f"sparse_embedding_update must be auto/on/off: "
+                f"{self.sparse_embedding_update!r}")
         if self.early_stop_patience < 0 or self.early_stop_min_delta < 0:
             raise ConfigError("early_stop_patience and early_stop_min_delta "
                               "must be >= 0")

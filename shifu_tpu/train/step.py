@@ -79,12 +79,42 @@ def make_loss_fn(job: JobConfig):
                               train=True, rngs={"dropout": rng})
         else:
             logits = apply_fn({"params": params}, feats)
-        loss = base(logits, batch["target"], batch["weight"])
+        target, weight = decode_target_weight(batch)
+        loss = base(logits, target, weight)
         if l2 > 0:
             loss = loss + losses_lib.l2_penalty(params, l2)
         return loss
 
     return loss_fn
+
+
+def decode_target_weight(batch: Batch) -> tuple[jax.Array, jax.Array]:
+    """On-device inverse of the compact target/weight wire
+    (data/pipeline.wire_cast_fn compact mode): integer-dtype targets (u8 on
+    the wire — exact for Shifu's 0/1 labels) cast back to f32, and an
+    elided all-ones weight column is synthesized.  Both branches are static
+    per jit signature (dtype / pytree structure), so a job whose blocks all
+    compact compiles exactly one program."""
+    target = batch["target"]
+    if jnp.issubdtype(target.dtype, jnp.integer):
+        target = target.astype(jnp.float32)
+    weight = batch.get("weight")
+    if weight is None:
+        weight = jnp.ones((target.shape[0], 1), jnp.float32)
+    return target, weight
+
+
+def make_apply_gradients(job: JobConfig, mesh: Optional[Mesh] = None):
+    """(state, grads, batch) -> new state: the dense optax apply, or the
+    sparse rows-touched-only table apply when the job's plan engages
+    (train/sparse_embed.py — tables masked out of optax, moments on
+    TrainState.table_slots, touched rows gathered/updated/scattered)."""
+    from .sparse_embed import make_sparse_apply
+
+    sparse = make_sparse_apply(job, mesh)
+    if sparse is None:
+        return lambda st, grads, batch: st.apply_gradients(grads)
+    return lambda st, grads, batch: sparse(st, grads, batch["features"])
 
 
 def make_train_step(job: JobConfig, mesh: Optional[Mesh] = None,
@@ -96,19 +126,18 @@ def make_train_step(job: JobConfig, mesh: Optional[Mesh] = None,
     mesh: plain single-device jit.
     """
     loss_fn = make_loss_fn(job)
+    apply_grads = make_apply_gradients(job, mesh)
 
     def step(state: TrainState, batch: Batch):
         loss, grads = jax.value_and_grad(loss_fn)(
             state.params, state.apply_fn, batch, state.step)
-        new_state = state.apply_gradients(grads)
+        new_state = apply_grads(state, grads, batch)
         return new_state, {"loss": loss}
 
     # Shardings ride on the input arrays themselves (state placed by
     # init_state, batches device_put by the loop with data-axis sharding);
-    # XLA propagates them and inserts the grad all-reduce. `mesh` is accepted
-    # for API symmetry/future in_shardings overrides but jit needs only
-    # donation hints here.
-    del mesh
+    # XLA propagates them and inserts the grad all-reduce; `mesh` feeds
+    # only the sparse apply's replication constraint and donation hints.
     donate_argnums = (0,) if donate else ()
     return jax.jit(step, donate_argnums=donate_argnums)
 
@@ -126,20 +155,20 @@ def make_epoch_scan_step(job: JobConfig, mesh: Optional[Mesh] = None,
     throughput on a v5e chip.
     """
     loss_fn = make_loss_fn(job)
+    apply_grads = make_apply_gradients(job, mesh)
 
     def epoch_step(state: TrainState, blocks: Batch):
         def body(carry, xs):
             st, acc = carry
             loss, grads = jax.value_and_grad(loss_fn)(
                 st.params, st.apply_fn, xs, st.step)
-            st = st.apply_gradients(grads)
+            st = apply_grads(st, grads, xs)
             return (st, acc + loss), None
 
         (state2, acc), _ = jax.lax.scan(
             body, (state, jnp.float32(0.0)), blocks)
         return state2, acc
 
-    del mesh  # shardings ride on the arrays (see make_train_step)
     donate_argnums = (0,) if donate else ()
     return jax.jit(epoch_step, donate_argnums=donate_argnums)
 
@@ -156,6 +185,7 @@ def make_device_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
     ceiling, vs ~100x slower when every batch crosses the host link.
     """
     loss_fn = make_loss_fn(job)
+    apply_grads = make_apply_gradients(job, mesh)
 
     def epoch_step(state: TrainState, blocks: Batch, order: jax.Array):
         def body(carry, idx):
@@ -168,13 +198,12 @@ def make_device_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
                 blocks)
             loss, grads = jax.value_and_grad(loss_fn)(
                 st.params, st.apply_fn, xs, st.step)
-            st = st.apply_gradients(grads)
+            st = apply_grads(st, grads, xs)
             return (st, acc + loss), None
 
         (state2, acc), _ = jax.lax.scan(body, (state, jnp.float32(0.0)), order)
         return state2, acc
 
-    del mesh
     donate_argnums = (0,) if donate else ()
     return jax.jit(epoch_step, donate_argnums=donate_argnums)
 
@@ -278,10 +307,13 @@ def make_local_sgd_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
             # matches the data-axis layout, so this is a local reshape
             resh = {k: v.reshape(n_shards, local_bs, *v.shape[1:])
                     for k, v in xs.items()}
+            wgt = resh.get("weight")
+            if wgt is None:  # elided all-ones weight wire
+                wgt = jnp.ones((n_shards, local_bs, 1), jnp.float32)
             shard_steps = ((state.step + i) * n_shards
                            + jnp.arange(n_shards, dtype=jnp.int32))
             losses, grads = vgrad(params_p, resh["features"], resh["target"],
-                                  resh["weight"], shard_steps)
+                                  wgt, shard_steps)
             params_p = constrain(
                 jax.tree_util.tree_map(lambda p, g: p - lr * g,
                                        params_p, grads),

@@ -73,6 +73,13 @@ KEY_DATA_RESIDENT_BYTES = "shifu.data.device-resident-bytes"
 # quantized wire, data/pipeline.wire_params; clip in normalized units)
 KEY_DATA_WIRE_DTYPE = "shifu.data.wire-dtype"
 KEY_DATA_WIRE_INT8_CLIP = "shifu.data.wire-int8-clip"
+# compact target/weight wire: label auto/uint8/float32, weight
+# auto/elide/float32 (DataConfig.wire_label_dtype / wire_weight_mode)
+KEY_DATA_WIRE_LABEL_DTYPE = "shifu.data.wire-label-dtype"
+KEY_DATA_WIRE_WEIGHT_MODE = "shifu.data.wire-weight-mode"
+# rows-touched-only embedding optimizer updates: auto / on / off
+# (TrainConfig.sparse_embedding_update, train/sparse_embed.py)
+KEY_TRAIN_SPARSE_EMBED = "shifu.train.sparse-embedding-update"
 
 
 def parse_sharding_rules(value: str) -> tuple:
@@ -202,6 +209,21 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         import dataclasses
         data = dataclasses.replace(
             data, wire_int8_clip=float(conf[KEY_DATA_WIRE_INT8_CLIP]))
+    if KEY_DATA_WIRE_LABEL_DTYPE in conf:
+        import dataclasses
+        data = dataclasses.replace(
+            data,
+            wire_label_dtype=conf[KEY_DATA_WIRE_LABEL_DTYPE].strip().lower())
+    if KEY_DATA_WIRE_WEIGHT_MODE in conf:
+        import dataclasses
+        data = dataclasses.replace(
+            data,
+            wire_weight_mode=conf[KEY_DATA_WIRE_WEIGHT_MODE].strip().lower())
+    if KEY_TRAIN_SPARSE_EMBED in conf:
+        import dataclasses
+        train = dataclasses.replace(
+            train, sparse_embedding_update=(
+                conf[KEY_TRAIN_SPARSE_EMBED].strip().lower()))
 
     import dataclasses
     rt_kw: dict[str, Any] = {}

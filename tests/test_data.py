@@ -304,3 +304,25 @@ def test_parquet_duplicate_column_names_read_positionally(tmp_path):
     path = str(tmp_path / "dup.parquet")
     pq.write_table(table, path)
     np.testing.assert_array_equal(reader.read_file(path), m)
+
+
+def test_fast_take_bitwise_identical_bf16():
+    """fast_take gathers ml_dtypes.bfloat16 through a native uint16 view:
+    bit-identical to plain fancy indexing, same dtype out, and exact for
+    f32/int8 passthrough."""
+    import ml_dtypes
+
+    from shifu_tpu.data import pipeline as pipe
+
+    rng = np.random.default_rng(11)
+    a = rng.standard_normal((64, 5)).astype(ml_dtypes.bfloat16)
+    idx = rng.permutation(64)[:17]
+    got = pipe.fast_take(a, idx)
+    assert got.dtype == a.dtype
+    np.testing.assert_array_equal(got.view(np.uint16),
+                                  a[idx].view(np.uint16))
+    small = rng.permutation(8)[:4]
+    f = rng.standard_normal((8, 3)).astype(np.float32)
+    np.testing.assert_array_equal(pipe.fast_take(f, small), f[small])
+    q = (rng.integers(-127, 127, (8, 3))).astype(np.int8)
+    np.testing.assert_array_equal(pipe.fast_take(q, small), q[small])

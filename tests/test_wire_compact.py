@@ -1,0 +1,361 @@
+"""Compact target/weight wire: u8 labels + elided all-ones weight columns
+(data/pipeline.wire_cast_fn compact mode + train/step.decode_target_weight).
+
+The north-star constraint is H2D bandwidth (BASELINE.md: 625k samples/s/chip
+end-to-end); on a 30-feature int8 job the compact wire trims the row from
+38 B (30 + f32 target + f32 weight) to 31 B (30 + u8 target + elided
+weight).  Unlike the int8 feature grid this wire is LOSSLESS by
+construction — u8 casts apply only to exactly-representable targets and
+elision only to all-ones weights — so the tests pin bit-identical training,
+per-block fallback, forced-mode validation, and the same hardening matrix
+the int8 wire rode (resident/staged/disk/local-SGD/eval, cache interplay,
+multihost agreement is exercised by tests/test_multiprocess_distributed.py).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from shifu_tpu.config import (ConfigError, DataConfig, JobConfig, ModelSpec,
+                              OptimizerConfig, TrainConfig)
+from shifu_tpu.data import pipeline as pipe
+from shifu_tpu.data import synthetic
+
+
+def _job(num_features=12, wire="auto", **data_kw):
+    schema = synthetic.make_schema(num_features=num_features)
+    return JobConfig(
+        schema=schema,
+        data=DataConfig(batch_size=100, wire_dtype=wire, **data_kw),
+        model=ModelSpec(model_type="mlp", hidden_nodes=(16, 16),
+                        activations=("relu", "relu"),
+                        compute_dtype="bfloat16"),
+        train=TrainConfig(epochs=5, loss="weighted_mse",
+                          optimizer=OptimizerConfig(name="adam",
+                                                    learning_rate=0.01)),
+    ).validate()
+
+
+def _block(n=64, f=12, target=None, weight=None):
+    rng = np.random.default_rng(0)
+    return {
+        "features": rng.standard_normal((n, f)).astype(np.float32),
+        "target": (target if target is not None
+                   else (rng.random((n, 1)) < 0.5).astype(np.float32)),
+        "weight": (weight if weight is not None
+                   else np.ones((n, 1), np.float32)),
+    }
+
+
+def test_detection_predicates():
+    assert pipe.target_u8_exact(np.array([[0.0], [1.0], [255.0]]))
+    assert pipe.target_u8_exact(np.zeros((0, 1), np.float32))  # empty: ok
+    assert pipe.target_u8_exact(np.array([[3]], np.uint8))
+    assert not pipe.target_u8_exact(np.array([[0.5]]))
+    assert not pipe.target_u8_exact(np.array([[-1.0]]))
+    assert not pipe.target_u8_exact(np.array([[256.0]]))
+    assert pipe.weight_all_ones(np.ones((5, 1), np.float32))
+    assert not pipe.weight_all_ones(np.array([[1.0], [0.999]]))
+
+
+def test_compact_cast_per_block_detection():
+    """compact=True detects per block: qualifying blocks ride u8/elided,
+    non-qualifying blocks keep the f32 wire — never corrupting values."""
+    job = _job()
+    cast = pipe.wire_cast_fn(job.schema, job.data, "bfloat16", compact=True)
+    out = cast(_block())
+    assert out["target"].dtype == np.uint8
+    assert "weight" not in out
+    # regression target: not u8-representable -> stays f32
+    reg = cast(_block(target=np.full((64, 1), 0.25, np.float32)))
+    assert reg["target"].dtype == np.float32
+    # one non-unit weight -> the column stays
+    w = np.ones((64, 1), np.float32)
+    w[3, 0] = 2.0
+    kept = cast(_block(weight=w))
+    assert kept["weight"].dtype == np.float32
+    np.testing.assert_array_equal(kept["weight"], w)
+
+
+def test_compact_default_off_and_float32_modes():
+    """The default (compact=False) keeps the r4 wire — eval paths and
+    external callers see f32 target/weight; float32 modes disable even
+    under compact=True."""
+    job = _job()
+    cast = pipe.wire_cast_fn(job.schema, job.data, "bfloat16")
+    out = cast(_block())
+    assert out["target"].dtype == np.float32
+    assert out["weight"].dtype == np.float32
+    off = _job(wire_label_dtype="float32", wire_weight_mode="float32")
+    cast_off = pipe.wire_cast_fn(off.schema, off.data, "bfloat16",
+                                 compact=True)
+    out2 = cast_off(_block())
+    assert out2["target"].dtype == np.float32
+    assert out2["weight"].dtype == np.float32
+
+
+def test_forced_modes_raise_dataset_wide():
+    """Forced modes ("uint8"/"elide") are enforced DATASET-wide by the
+    train loop (per-block casts never raise: a streamed tail block's
+    zero-weight padding must not false-positive)."""
+    from shifu_tpu.train import train
+
+    rng = np.random.default_rng(5)
+    n = 400
+    feats = rng.standard_normal((n, 12)).astype(np.float32)
+    bad_target = rng.random((n, 1)).astype(np.float32)  # not u8-exact
+    ones = np.ones((n, 1), np.float32)
+    job_l = _job(wire_label_dtype="uint8")
+    with pytest.raises(ValueError, match="wire_label_dtype"):
+        train(job_l, train_ds=pipe.TabularDataset(feats, bad_target, ones),
+              valid_ds=pipe.TabularDataset(feats[:50], bad_target[:50],
+                                           ones[:50]),
+              console=lambda s: None)
+    bad_w = ones.copy()
+    bad_w[7] = 2.0
+    tgt = (rng.random((n, 1)) < 0.5).astype(np.float32)
+    job_w = _job(wire_weight_mode="elide")
+    with pytest.raises(ValueError, match="wire_weight_mode"):
+        train(job_w, train_ds=pipe.TabularDataset(feats, tgt, bad_w),
+              valid_ds=pipe.TabularDataset(feats[:50], tgt[:50], ones[:50]),
+              console=lambda s: None)
+    # per-block cast under forced modes falls back instead of raising
+    cast = pipe.wire_cast_fn(job_w.schema, _job(
+        wire_label_dtype="uint8", wire_weight_mode="elide").data,
+        "bfloat16", compact=True)
+    out = cast(_block(target=np.full((8, 1), 0.5, np.float32),
+                      weight=np.full((8, 1), 2.0, np.float32)))
+    assert out["target"].dtype == np.float32
+    assert out["weight"].dtype == np.float32
+
+
+def test_config_validation():
+    with pytest.raises(ConfigError, match="wire_label_dtype"):
+        DataConfig(wire_label_dtype="u8").validate()
+    with pytest.raises(ConfigError, match="wire_weight_mode"):
+        DataConfig(wire_weight_mode="drop").validate()
+
+
+def test_wire_row_bytes():
+    job = _job(num_features=30, wire="int8")
+    assert pipe.wire_row_bytes(job.schema, job.data, "bfloat16") == 31
+    assert pipe.wire_row_bytes(job.schema, job.data, "bfloat16",
+                               compact=False) == 38
+    auto = _job(num_features=30)  # auto -> bf16 wire under bf16 compute
+    assert pipe.wire_row_bytes(auto.schema, auto.data, "bfloat16") == 61
+    off = _job(num_features=30, wire_label_dtype="float32",
+               wire_weight_mode="float32")
+    assert pipe.wire_row_bytes(off.schema, off.data, "float32") == 128
+
+
+def test_decode_target_weight_device_inverse():
+    import jax.numpy as jnp
+
+    from shifu_tpu.train.step import decode_target_weight
+
+    t = (np.arange(6) % 2).astype(np.uint8).reshape(6, 1)
+    target, weight = decode_target_weight({"target": jnp.asarray(t)})
+    assert target.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(target), t.astype(np.float32))
+    assert weight.shape == (6, 1)
+    np.testing.assert_array_equal(np.asarray(weight), np.ones((6, 1)))
+    # f32 target + explicit weight pass through untouched
+    tf = np.random.default_rng(1).random((4, 1)).astype(np.float32)
+    wf = np.full((4, 1), 0.5, np.float32)
+    target2, weight2 = decode_target_weight(
+        {"target": jnp.asarray(tf), "weight": jnp.asarray(wf)})
+    np.testing.assert_array_equal(np.asarray(target2), tf)
+    np.testing.assert_array_equal(np.asarray(weight2), wf)
+
+
+def _split(rows, job):
+    feats = rows[:, 1:].astype(np.float32)
+    target = rows[:, :1].astype(np.float32)
+    weight = np.ones_like(target)
+    n_valid = len(rows) // 5
+    tds = pipe.TabularDataset(feats[n_valid:], target[n_valid:],
+                              weight[n_valid:])
+    vds = pipe.TabularDataset(feats[:n_valid], target[:n_valid],
+                              weight[:n_valid])
+    return tds, vds
+
+
+@pytest.fixture(scope="module")
+def learnable_rows():
+    schema = synthetic.make_schema(num_features=12)
+    return synthetic.make_rows(2000, schema, seed=9, noise=0.25)
+
+
+def _train(rows, job):
+    from shifu_tpu.train import train
+
+    tds, vds = _split(rows, job)
+    return train(job, train_ds=tds, valid_ds=vds, console=lambda s: None)
+
+
+def test_compact_wire_is_bit_identical_resident(learnable_rows):
+    """The acceptance A/B: the compact wire is LOSSLESS — training on the
+    resident tier with u8 labels + elided weights reproduces the f32-wire
+    run's metrics exactly (u8 casts round-trip, synthesized ones equal the
+    explicit ones column)."""
+    base = _train(learnable_rows, _job(
+        wire="float32", wire_label_dtype="float32",
+        wire_weight_mode="float32"))
+    compact = _train(learnable_rows, _job(wire="float32"))
+    assert base.history[-1].valid_auc > 0.6
+    assert compact.history[-1].valid_auc == pytest.approx(
+        base.history[-1].valid_auc, abs=1e-6)
+    assert compact.history[-1].train_error == pytest.approx(
+        base.history[-1].train_error, rel=1e-6)
+
+
+def test_compact_wire_staged_tier(learnable_rows):
+    """Same A/B through the STAGED tier (device_resident_bytes=0 forces the
+    chunked H2D path the north star actually measures)."""
+    base = _train(learnable_rows, _job(
+        wire="float32", wire_label_dtype="float32",
+        wire_weight_mode="float32", device_resident_bytes=0,
+        block_batches=4))
+    compact = _train(learnable_rows, _job(
+        wire="float32", device_resident_bytes=0, block_batches=4))
+    assert compact.history[-1].valid_auc == pytest.approx(
+        base.history[-1].valid_auc, abs=1e-6)
+
+
+def test_compact_rides_int8_wire(learnable_rows):
+    """int8 features + u8 label + elided weight together (the 31 B/row
+    configuration the bench ships): AUC parity vs the all-f32 wire."""
+    f32 = _train(learnable_rows, _job(
+        wire="float32", wire_label_dtype="float32",
+        wire_weight_mode="float32", device_resident_bytes=0,
+        block_batches=4))
+    q = _train(learnable_rows, _job(wire="int8", device_resident_bytes=0,
+                                    block_batches=4))
+    assert q.history[-1].valid_auc > 0.6
+    assert abs(q.history[-1].valid_auc - f32.history[-1].valid_auc) < 0.02
+
+
+def test_nonunit_weights_still_respected(learnable_rows):
+    """A dataset with real weights keeps its weight column under auto mode
+    and the weighted loss still sees them (no silent elision)."""
+    job = _job(wire="float32")
+    tds, vds = _split(learnable_rows, job)
+    w = tds.weight.copy()
+    w[::2] = 3.0
+    tds_w = pipe.TabularDataset(tds.features, tds.target, w)
+    from shifu_tpu.train import train
+    r_w = train(job, train_ds=tds_w, valid_ds=vds, console=lambda s: None)
+    r_1 = train(job, train_ds=tds, valid_ds=vds, console=lambda s: None)
+    # weighted run must differ from the unit run: weights were not dropped
+    assert r_w.history[-1].train_error != pytest.approx(
+        r_1.history[-1].train_error, rel=1e-9)
+    assert np.isfinite(r_w.history[-1].valid_auc)
+
+
+def test_local_sgd_with_elided_weight(learnable_rows):
+    """SAGN local-SGD reshapes batches per shard; the synthesized ones
+    weight composes with the vmapped per-shard loss."""
+    from shifu_tpu.train import train
+
+    job = _job(wire="float32")
+    job = job.replace(
+        data=dataclasses.replace(job.data, device_resident_bytes=0,
+                                 block_batches=4),
+        train=dataclasses.replace(job.train, local_sgd_window=2, epochs=2,
+                                  optimizer=dataclasses.replace(
+                                      job.train.optimizer, name="sgd",
+                                      learning_rate=0.05)))
+    tds, vds = _split(learnable_rows, job)
+    r = train(job, train_ds=tds, valid_ds=vds, console=lambda s: None)
+    assert np.isfinite(r.history[-1].train_error)
+    assert np.isfinite(r.history[-1].valid_auc)
+
+
+def test_disk_path_compact_and_cache_skips_stream(tmp_path, learnable_rows):
+    """The full product path: cold train() from gzip files streams the
+    first epoch (per-block compact wire), the SECOND run finds every
+    projected cache entry hot, skips the streamed epoch (loaded tiers),
+    and lands at the same AUC."""
+    from shifu_tpu.train import train
+
+    synthetic.write_files(learnable_rows, str(tmp_path / "d"), num_files=2)
+    base = _job(wire="int8")
+    job = base.replace(data=dataclasses.replace(
+        base.data, paths=(str(tmp_path / "d"),), valid_ratio=0.2,
+        cache_dir=str(tmp_path / "cache")))
+    assert not pipe.projected_cache_complete(
+        job.schema, job.data, feature_dtype="int8c8")
+    lines1: list[str] = []
+    r1 = train(job, console=lines1.append)
+    assert pipe.projected_cache_complete(
+        job.schema, job.data, feature_dtype="int8c8")
+    lines2: list[str] = []
+    r2 = train(job, console=lines2.append)
+    assert any("skipping the streamed first epoch" in s for s in lines2)
+    assert not any("skipping the streamed first epoch" in s for s in lines1)
+    assert r2.history[-1].valid_auc > 0.6
+    # different epoch-0 train order (file order vs global shuffle) is
+    # expected; the learned signal must agree
+    assert abs(r1.history[-1].valid_auc - r2.history[-1].valid_auc) < 0.02
+
+
+def test_streamed_pad_tail_with_compact_wire(tmp_path):
+    """Single-host streamed first epoch whose tail block pads with
+    zero-weight rows: the pad block keeps its weight column (zeros are not
+    all-ones) while full blocks elide — two signatures, one correct run."""
+    from shifu_tpu.train import train
+
+    schema = synthetic.make_schema(num_features=12)
+    rows = synthetic.make_rows(1050, schema, seed=3, noise=0.25)
+    synthetic.write_files(rows, str(tmp_path / "d"), num_files=2)
+    job = _job(wire="float32")
+    job = job.replace(
+        data=dataclasses.replace(job.data, paths=(str(tmp_path / "d"),),
+                                 valid_ratio=0.2, batch_size=100),
+        train=dataclasses.replace(job.train, epochs=1))
+    r = train(job, console=lambda s: None)
+    assert np.isfinite(r.history[0].train_error)
+    assert np.isfinite(r.history[0].valid_auc)
+
+
+def test_resume_replays_compact_wire(tmp_path, learnable_rows):
+    """Kill/resume guard: a run checkpointed mid-job resumes onto the same
+    compact wire (content-driven detection is deterministic) and finishes
+    with the SAME metrics as an uninterrupted run."""
+    from shifu_tpu.train import train
+
+    def make_job(ckpt_dir):
+        job = _job(wire="float32")
+        return job.replace(
+            data=dataclasses.replace(job.data, device_resident_bytes=0,
+                                     block_batches=4),
+            runtime=dataclasses.replace(
+                job.runtime,
+                checkpoint=dataclasses.replace(
+                    job.runtime.checkpoint, directory=ckpt_dir,
+                    save_every_epochs=1, async_save=False)))
+
+    tds, vds = _split(learnable_rows, _job())
+    full = train(make_job(str(tmp_path / "full")), train_ds=tds,
+                 valid_ds=vds, console=lambda s: None)
+    # interrupted run: 2 epochs, then resume for the remaining 3
+    part_job = make_job(str(tmp_path / "part"))
+    short = part_job.replace(train=dataclasses.replace(part_job.train,
+                                                       epochs=2))
+    train(short, train_ds=tds, valid_ds=vds, console=lambda s: None)
+    resumed = train(part_job, train_ds=tds, valid_ds=vds,
+                    console=lambda s: None)
+    assert resumed.resumed_from_epoch == 2
+    assert resumed.history[-1].valid_auc == pytest.approx(
+        full.history[-1].valid_auc, abs=1e-4)
+
+
+def test_xml_keys_reach_compact_config():
+    from shifu_tpu.utils.xmlconfig import apply_to_job
+
+    job = _job()
+    out = apply_to_job(job, {"shifu.data.wire-label-dtype": "FLOAT32",
+                             "shifu.data.wire-weight-mode": "Elide"})
+    assert out.data.wire_label_dtype == "float32"
+    assert out.data.wire_weight_mode == "elide"
