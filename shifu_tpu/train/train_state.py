@@ -22,6 +22,10 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
     apply_fn: Callable = struct.field(pytree_node=False)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    # moment slots for sparse-updated embedding tables
+    # (train/sparse_embed.py); None for dense jobs, so their state pytree
+    # (and checkpoints) are unchanged
+    table_slots: Any = None
 
     def apply_gradients(self, grads: Any) -> "TrainState":
         updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
@@ -30,11 +34,13 @@ class TrainState(struct.PyTreeNode):
 
     @classmethod
     def create(cls, apply_fn: Callable, params: Any,
-               tx: optax.GradientTransformation) -> "TrainState":
+               tx: optax.GradientTransformation,
+               table_slots: Any = None) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=tx.init(params),
             apply_fn=apply_fn,
             tx=tx,
+            table_slots=table_slots,
         )
