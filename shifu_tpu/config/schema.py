@@ -496,6 +496,17 @@ class RuntimeConfig:
     # EPOCH, so a sane window must exceed the job's epoch time — a fixed
     # 25s default would false-kill any long epoch.
     liveness_seconds: float = 0.0
+    # Elastic reshape floor (`shifu.pod.min-hosts`): when a pod gang
+    # exhausts its restart budget and the SAME host keeps failing, the
+    # dispatcher drops that host and restarts the gang at the reduced
+    # world size (file shards rebalance through the env contract, the
+    # global batch re-rounds to the new mesh, training resumes from
+    # checkpoint) — as long as at least this many hosts remain.  The SPMD
+    # successor of the reference's degraded start, which launched with
+    # >= 95% of requested workers and re-packed task indices
+    # (TensorflowApplicationMaster.java:230-338, thresholds
+    # Constants.java:91-94).  0 = off (same-shape restarts only).
+    min_hosts: int = 0
     final_model_path: str = ""      # FINAL_MODEL_PATH env in the reference
     tmp_model_path: str = ""        # TMP_MODEL_PATH env in the reference
     # Kerberos for secured HDFS access — successor of the reference client's

@@ -370,8 +370,9 @@ def _spawn_processes(args, out_dir: str) -> int:
     os.makedirs(out_dir, exist_ok=True)
     spec = pod_lib.PodSpec(hosts=("local",) * args.num_processes,
                            transport="local")
-    return pod_lib.launch_gang(spec, _child_train_args(args, out_dir),
-                               out_dir, attempt=1)
+    rc, _failed = pod_lib.launch_gang(spec, _child_train_args(args, out_dir),
+                                      out_dir, attempt=1)
+    return rc
 
 
 def run_train(args) -> int:
@@ -507,7 +508,8 @@ def run_train(args) -> int:
             max_restarts=max_restarts,
             liveness_seconds=sup_job.runtime.liveness_seconds,
             checkpoint_dir=sup_job.runtime.checkpoint.directory,
-            timeout_seconds=sup_job.runtime.timeout_seconds)
+            timeout_seconds=sup_job.runtime.timeout_seconds,
+            min_hosts=sup_job.runtime.min_hosts)
 
     if args.supervise:
         from ..data import fsio as fsio_mod
@@ -529,6 +531,19 @@ def run_train(args) -> int:
 
     if getattr(args, "num_processes", 0) > 1:
         return _spawn_processes(args, _resolve_out_dir(args))
+
+    # permanent-host-loss injection (elastic reshape tests): the rank whose
+    # gang process id matches dies at startup on EVERY attempt — unlike
+    # SHIFU_TPU_FAULT_EPOCH's one-shot crash, this models a host that never
+    # comes back, which the pod supervisor must eventually drop and
+    # reshape around.  Checked BEFORE the rendezvous so the dead host
+    # never joins (its peers are torn down by the gang dispatcher).
+    down = os.environ.get("SHIFU_TPU_FAULT_HOST_DOWN")
+    if down is not None and os.environ.get(
+            "SHIFU_TPU_PROCESS_ID", "0") == down:
+        print(f"FAULT INJECTION: host (rank {down}) is permanently down",
+              flush=True)
+        return EXIT_FAIL
 
     # multi-host rendezvous (no-op without the env contract / pod runtime);
     # must run before any jax device use so every process joins the global

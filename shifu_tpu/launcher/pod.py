@@ -150,10 +150,17 @@ def _host_command(spec: PodSpec, rank: int, child_args: Sequence[str],
 
 def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
                 attempt: int, liveness_seconds: float = 0.0,
-                echo=print, deadline=None) -> int:
+                echo=print, deadline=None) -> tuple[int, tuple[int, ...]]:
     """Run one gang attempt: dispatch every rank, stream rank 0 to the
     console, capture all ranks to per-host logs, tear everyone down on the
-    first failure (or on a liveness stall), return the gang's exit code.
+    first failure (or on a liveness stall), return (gang exit code,
+    culprit ranks).
+
+    Culprit ranks are the ranks observed failing BEFORE the teardown began
+    (failures after it are collateral SIGTERMs) — the signal the elastic
+    reshape in supervise_pod uses to identify a permanently lost host.
+    Empty on success, timeout, and liveness kills (a stall has no
+    attributable culprit).
 
     `deadline` is a supervisor.JobDeadline for the JOB-level timeout: past
     it the gang is torn down and EXIT_TIMEOUT returned (the supervisor
@@ -229,6 +236,17 @@ def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
          f"logs {log_dir}/host-*.attempt-{attempt}.log")
 
     status = 0
+    failed_ranks: list[int] = []
+    # teardown is deferred one short grace window after the FIRST failure
+    # so every rank that fails on its own in that window is recorded as a
+    # culprit too: blaming only the first-polled exit would let a
+    # fast-dying collateral victim (a peer aborting on the dead host's
+    # collective error inside the same poll interval) absorb the blame —
+    # and the elastic reshape would then evict a healthy host.  Collateral
+    # victims caught in the window make the culprit set ambiguous (size >
+    # 1), which the reshape treats as "not one lost host" — conservative
+    # by design.
+    teardown_at: Optional[float] = None
     try:
         remaining = set(range(n))
         while remaining:
@@ -255,12 +273,23 @@ def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
                     continue
                 remaining.discard(rank)
                 if rc != 0:
-                    echo(f"pod: host {rank} ({spec.hosts[rank]}) exited "
-                         f"rc={rc} — tearing down the gang "
-                         f"(see {log_paths[rank]})")
+                    if teardown_at is None:
+                        failed_ranks.append(rank)
+                        echo(f"pod: host {rank} ({spec.hosts[rank]}) "
+                             f"exited rc={rc} — tearing down the gang "
+                             f"(see {log_paths[rank]})")
+                        teardown_at = time.monotonic() + 1.0
+                    elif time.monotonic() < teardown_at:
+                        # failed on its own inside the grace window:
+                        # also a culprit (ambiguity blocks the reshape)
+                        failed_ranks.append(rank)
                     status = status or rc
-                    for other in sorted(remaining):
-                        procs[other].terminate()
+            if (teardown_at is not None and remaining
+                    and time.monotonic() >= teardown_at):
+                # culprit grace over: stop the survivors (idempotent —
+                # repeat sweeps just re-signal already-terminating procs)
+                for other in sorted(remaining):
+                    procs[other].terminate()
             # deadline AFTER the poll drain: a gang that finished during the
             # last sleep must report its real status, not a phantom timeout
             if deadline is not None and remaining and deadline.expired():
@@ -272,7 +301,7 @@ def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
                 echo("pod: job timeout exceeded — tearing down the gang")
                 for other in sorted(remaining):
                     procs[other].terminate()
-                return EXIT_TIMEOUT
+                return EXIT_TIMEOUT, ()
             if liveness_seconds > 0 and remaining:
                 with lock:
                     newest = max(progress)
@@ -290,13 +319,13 @@ def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
                 proc.kill()
         for t in threads:
             t.join(timeout=5)
-    return status
+    return status, tuple(failed_ranks)
 
 
 def supervise_pod(spec: PodSpec, child_args: Sequence[str], out_dir: str,
                   max_restarts: int = 2, liveness_seconds: float = 0.0,
                   echo=print, checkpoint_dir: Optional[str] = None,
-                  timeout_seconds: float = 0.0) -> int:
+                  timeout_seconds: float = 0.0, min_hosts: int = 0) -> int:
     """Whole-gang restart supervision: any host failure restarts the ENTIRE
     gang (checkpoint auto-resume continues the job), bounded by max_restarts
     CONSECUTIVE failures without durable progress — the cross-host successor
@@ -312,14 +341,53 @@ def supervise_pod(spec: PodSpec, child_args: Sequence[str], out_dir: str,
     supervisor.JobDeadline from the first attempt's start); a timeout —
     whether hit by the gang's own children (exit 3) or by the dispatcher's
     deadline — is TERMINAL, never restarted (TensorflowClient.java:625-658
-    kills the app once)."""
+    kills the app once).
+
+    min_hosts > 0 enables ELASTIC RESHAPE (RuntimeConfig.min_hosts): when
+    the restart budget is exhausted and the attempts' culprit is one
+    identifiable host, that host is presumed permanently lost — the gang
+    restarts WITHOUT it (file shards rebalance through the env contract's
+    new NUM_PROCESSES/PROCESS_ID, the train loop re-rounds the global
+    batch to the new mesh, checkpoint auto-resume continues) with a fresh
+    budget, as long as at least min_hosts remain.  The SPMD answer to the
+    reference's >=95%-of-workers degraded start with task-index re-packing
+    (TensorflowApplicationMaster.java:230-338).  Reshape assumes the job's
+    state survives a world-size change — true for data-parallel jobs
+    (replicated params; the default); model/pipe-sharded topologies should
+    keep min_hosts=0."""
+    import dataclasses as _dc
+
     from .supervisor import (EXIT_TIMEOUT, JobDeadline, ProgressProbe,
                              charge_restart_budget)
 
     attempts = 0
     failures_since_progress = 0
     transport_failures = 0
+    # culprit accounting across the no-progress window: reshape drops a
+    # host only when EVERY budgeted failure blames the same host (mixed
+    # culprits look like a cluster-wide problem, not one lost host)
+    window_culprits: set[int] = set()
     deadline = JobDeadline(timeout_seconds)
+
+    def _reshape(reason: str) -> bool:
+        nonlocal spec, failures_since_progress, transport_failures
+        if min_hosts <= 0 or len(spec.hosts) <= max(min_hosts, 1):
+            return False
+        if len(window_culprits) != 1:
+            return False
+        drop = next(iter(window_culprits))
+        gone = spec.hosts[drop]
+        new_hosts = tuple(h for i, h in enumerate(spec.hosts) if i != drop)
+        echo(f"pod: host {drop} ({gone}) {reason} — presumed permanently "
+             f"lost; reshaping the gang to {len(new_hosts)} hosts "
+             f"(floor {max(min_hosts, 1)}), rebalancing file shards, and "
+             "resuming from checkpoint")
+        spec = _dc.replace(spec, hosts=new_hosts)
+        failures_since_progress = 0
+        transport_failures = 0
+        window_culprits.clear()
+        return True
+
     while True:
         if deadline.expired():
             # don't dispatch a doomed gang just to kill it one poll later
@@ -328,9 +396,9 @@ def supervise_pod(spec: PodSpec, child_args: Sequence[str], out_dir: str,
         attempts += 1
         start = time.monotonic()
         probe = ProgressProbe(checkpoint_dir)
-        rc = launch_gang(spec, child_args, out_dir, attempts,
-                         liveness_seconds=liveness_seconds, echo=echo,
-                         deadline=deadline)
+        rc, failed = launch_gang(spec, child_args, out_dir, attempts,
+                                 liveness_seconds=liveness_seconds, echo=echo,
+                                 deadline=deadline)
         if rc == 0:
             if attempts > 1:
                 echo(f"pod: succeeded after {attempts} attempts")
@@ -349,19 +417,32 @@ def supervise_pod(spec: PodSpec, child_args: Sequence[str], out_dir: str,
             # further, must not accumulate to a terminal failure
             if probe.advanced():
                 transport_failures = 0
+                window_culprits.clear()
             transport_failures += 1
+            window_culprits.update(failed)
             if transport_failures <= SSH_CONNECT_RETRIES:
                 echo(f"pod: ssh transport failure — restarting the gang "
                      f"without charging the restart budget "
                      f"({transport_failures}/{SSH_CONNECT_RETRIES})")
                 continue
+            # an unreachable-forever host is the clearest permanent loss
+            if _reshape("is unreachable over ssh after "
+                        f"{transport_failures} consecutive attempts"):
+                continue
             echo("pod: ssh transport failure budget exhausted")
             return 1
+        progressed = probe.advanced()
+        if progressed:
+            window_culprits.clear()
+        window_culprits.update(failed)
         failures_since_progress = charge_restart_budget(
-            failures_since_progress, probe.advanced(), echo=echo, what="pod")
+            failures_since_progress, progressed, echo=echo, what="pod")
         echo(f"pod: attempt {attempts} failed rc={rc} after "
              f"{time.monotonic() - start:.1f}s")
         if failures_since_progress > max_restarts:
+            if _reshape(f"failed {failures_since_progress} consecutive "
+                        "attempts without progress"):
+                continue
             echo(f"pod: restart budget exhausted ({max_restarts} restarts "
                  "without progress)")
             return rc if isinstance(rc, int) and rc > 0 else 1

@@ -47,6 +47,11 @@ KEY_MAX_MISSED_HEARTBEATS = "shifu.task.max-missed-heartbeats"
 # board heartbeat — a migrated config carrying the reference defaults
 # (1000ms x 25) would false-kill any epoch longer than 25s
 KEY_LIVENESS_SECONDS = "shifu.liveness.seconds"
+# elastic reshape floor: drop a permanently failing pod host and restart
+# the gang smaller, down to this many hosts (RuntimeConfig.min_hosts;
+# successor of the reference's >=95%-of-workers degraded start,
+# TensorflowApplicationMaster.java:230-338)
+KEY_MIN_HOSTS = "shifu.pod.min-hosts"
 # device mesh topology (successor of shifu.{ps,worker}.instances container
 # counts: the logical axes the one SPMD program shards over)
 KEY_MESH_DATA = "shifu.mesh.data"
@@ -242,6 +247,8 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         rt_kw["checkpoint"] = ck
     if KEY_MAX_RESTARTS in conf:
         rt_kw["max_restarts"] = int(conf[KEY_MAX_RESTARTS])
+    if KEY_MIN_HOSTS in conf:
+        rt_kw["min_hosts"] = int(conf[KEY_MIN_HOSTS])
     if KEY_LIVENESS_SECONDS in conf:
         rt_kw["liveness_seconds"] = float(conf[KEY_LIVENESS_SECONDS])
     if KEY_CKPT_SAVE_SECONDS in conf:
