@@ -144,17 +144,23 @@ class _SkipTier(Exception):
     """Deliberate tier skip (time budget) — not a failure."""
 
 
-def _past_deadline() -> bool:
+def _past_deadline(frac: float = 1.0) -> bool:
     """Soft overall budget (SHIFU_TPU_BENCH_DEADLINE seconds, default 20
     min): the JSON line only prints at the END, so a driver-side timeout on
     a congested-tunnel day would record NOTHING for the round — optional
     tiers skip (with a recorded reason) once the budget is spent, keeping
-    the headline capture safe."""
+    the headline capture safe.
+
+    `frac` gives each tier its own slice of the budget in PRIORITY order:
+    tiers that run before the e2e-from-disk tier (the north-star number,
+    which runs last in the source) check a smaller fraction, so a
+    congested day skips the mid-priority tiers and still leaves budget for
+    the one the BASELINE target is judged on."""
     try:
         budget = float(os.environ.get("SHIFU_TPU_BENCH_DEADLINE", 1200))
     except ValueError:
         budget = 1200.0
-    return time.monotonic() - _BENCH_START > budget
+    return time.monotonic() - _BENCH_START > budget * frac
 
 
 def _h2d_bandwidth_bytes_per_sec(trials: int = 3) -> float:
@@ -168,8 +174,28 @@ def _h2d_bandwidth_bytes_per_sec(trials: int = 3) -> float:
     plain large-transfer average."""
     import jax
 
+    # REPRESENTATIVE payload, not zeros: the tunnel compresses its stream
+    # a little (measured ~30% between zeros and uniform-random int8), so
+    # an all-zeros probe would overstate the bandwidth the real wire —
+    # quantized z-scored features — actually gets.  The probe buffer
+    # mimics the int8 wire's value distribution.
+    rng = np.random.default_rng(12345)
+
+    def payload(nbytes: int) -> np.ndarray:
+        # chunked generation: a single standard_normal(512M) would build
+        # multi-GB float64 temporaries; 64MB chunks keep the transient
+        # footprint ~0.5GB regardless of probe size
+        out = np.empty(nbytes, np.int8)
+        step = 64 << 20
+        for lo in range(0, nbytes, step):
+            n = min(step, nbytes - lo)
+            x = rng.standard_normal(n, dtype=np.float32)
+            np.clip(np.rint(x * 15.875, out=x), -127, 127, out=x)
+            out[lo:lo + n] = x.astype(np.int8)
+        return out
+
     small_b = 8 << 20
-    small = np.zeros(small_b // 4, np.float32)
+    small = payload(small_b)
     jax.device_put(small)  # warm any allocation path
 
     def t_of(buf) -> float:
@@ -185,7 +211,7 @@ def _h2d_bandwidth_bytes_per_sec(trials: int = 3) -> float:
     t_small = t_of(small)
     large_b = 32 << 20
     while True:
-        t_large = t_of(np.zeros(large_b // 4, np.float32))
+        t_large = t_of(payload(large_b))
         if t_large >= 2.0 * t_small or large_b >= (512 << 20):
             break
         large_b *= 4
@@ -319,7 +345,7 @@ def _sparse_embed_ab(mesh, n_chips: int) -> dict:
     from shifu_tpu.train import init_state, make_device_epoch_step
 
     out: dict = {}
-    if _past_deadline():
+    if _past_deadline(0.55):
         return {"ladder_deepfm_4mvocab_skipped": "soft deadline"}
     bs, nb, n_feat, n_cat, vocab = 4096, 8, 30, 6, 4_000_000
     try:
@@ -616,7 +642,7 @@ def main() -> None:
     # scan (train/step.make_wire_decode); measured at the sweep winner's
     # batch so the delta vs the bf16 headline is attributable to the wire
     try:
-        if _past_deadline():
+        if _past_deadline(0.3):
             extras["resident_int8_skipped"] = \
                 "soft deadline (SHIFU_TPU_BENCH_DEADLINE)"
             raise _SkipTier()
@@ -671,7 +697,7 @@ def main() -> None:
     # fraction of the epoch (the old 8-batch sizing = 2 chunks made fill
     # HALF the measurement)
     try:
-        if _past_deadline():
+        if _past_deadline(0.45):
             extras["staged_skipped"] = \
                 "soft deadline (SHIFU_TPU_BENCH_DEADLINE)"
             raise _SkipTier()
@@ -851,7 +877,7 @@ def main() -> None:
     # ladder runs by default but can be skipped with SHIFU_TPU_BENCH_FAST
     if os.environ.get("SHIFU_TPU_BENCH_FAST"):
         extras["ladder_skipped"] = "SHIFU_TPU_BENCH_FAST"
-    elif _past_deadline():
+    elif _past_deadline(0.55):
         extras["ladder_skipped"] = "soft deadline (SHIFU_TPU_BENCH_DEADLINE)"
     else:
         try:

@@ -268,6 +268,14 @@ def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
     if not multihost and ds.num_rows == 0:
         return float("nan"), float("nan")
     bs = batch_size or max(job.data.batch_size, 4096)
+    if not multihost and ds.num_rows < bs:
+        # a huge train batch must not size the eval batch: padding a small
+        # valid set up to a 100k-row batch wastes H2D bytes and device work
+        # on zero-weight rows every epoch.  Cap at the dataset rounded up
+        # to a 4096 quantum (static shapes; single-host only — multihost
+        # derives collective step counts from the shared bs, and a
+        # host-local row count there would diverge the program)
+        bs = max(-(-ds.num_rows // 4096) * 4096, 4096)
     if mesh is not None:
         # keep the per-device shard static
         bs = -(-bs // mesh.size) * mesh.size
