@@ -1095,6 +1095,19 @@ def main() -> None:
                 extras["e2e_cached_disk_samples_per_sec_per_chip"] = round(
                     best_cached, 1)
                 extras["e2e_auc_int8"] = round(r.history[0].valid_auc, 4)
+            if best_cached > 0:
+                # fraction of the link ceiling at the tier's wire: the
+                # normalization that makes a congested-day capture read
+                # correctly (the absolute number tracks the tunnel; this
+                # tracks the pipeline).  Probed BEFORE and AFTER the timed
+                # reps (the staged tier's pattern) — a single stale probe
+                # would track the drift this key exists to remove.
+                h2d_e2e_post = _h2d_bandwidth_bytes_per_sec()
+                extras["e2e_h2d_post_mb_per_sec"] = round(
+                    h2d_e2e_post / 1e6, 1)
+                extras["e2e_cached_disk_fraction_of_ceiling"] = round(
+                    best_cached * n_chips * wire_row_int8c
+                    / ((h2d + h2d_e2e_post) / 2.0), 3)
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
             shutil.rmtree(cdir, ignore_errors=True)
@@ -1135,6 +1148,7 @@ _HEADLINE_REQUIRED = ("metric", "value", "unit", "vs_baseline", "n_chips",
 _HEADLINE_OPTIONAL = (
     "mfu",
     "e2e_cached_disk_samples_per_sec_per_chip",
+    "e2e_cached_disk_fraction_of_ceiling",
     "e2e_cold_disk_samples_per_sec_per_chip",
     "e2e_h2d_ceiling_int8_samples_per_sec_per_chip",
     "e2e_h2d_ceiling_samples_per_sec_per_chip",
