@@ -996,7 +996,7 @@ def main() -> None:
         from shifu_tpu.data.cache import read_file_cached
         from shifu_tpu.train import train as train_fn
 
-        rows_e2e = 16 * batch_size  # ~1.6-2M rows: amortize fixed costs
+        rows_e2e = 24 * batch_size  # ~2.4-3M rows: amortize fixed costs
         tmp = tempfile.mkdtemp(prefix="bench_e2e_")
         cdir = tempfile.mkdtemp(prefix="bench_e2e_cache_")
         try:

@@ -129,7 +129,14 @@ def projected_entry_name(path: str, delimiter: str, file_idx: int,
     everything that shapes the result: source file state, schema column
     selection, split parameters, the file's position in the path list (row
     ids derive from it), and the feature dtype.  One load then replaces
-    parse + project + split + cast on every later ingest."""
+    parse + project + split + cast on every later ingest.
+
+    The entry is a DIRECTORY of raw per-column `.npy` files (r5): raw npy
+    loads mmap (np.load(mmap_mode='r')), so a warm-page-cache ingest
+    streams the big features column straight into the concat/device copy
+    instead of paying the npz zip-member copy first — measured ~3x faster
+    aggregate load on the bench host.  Legacy `.npz` entries from earlier
+    rounds still load (read fallback below)."""
     base = cache_entry_name(path, delimiter)
     if base is None:
         return None
@@ -138,40 +145,79 @@ def projected_entry_name(path: str, delimiter: str, file_idx: int,
                      schema.weight_index, file_idx,
                      round(valid_ratio, 9), split_seed, feature_dtype,
                      CACHE_FORMAT_VERSION)))[:16]
-    return base[:-4] + f"-p{sel}.npz"
+    return base[:-4] + f"-p{sel}.npd"
+
+
+_PROJECTED_KEYS = ("features", "target", "weight", "valid_mask")
+
+
+def legacy_projected_path(entry_path: str) -> str:
+    """The r4-format `.npz` path for a `.npd` directory entry path — the
+    read fallback (and the hot-cache probe) accept either form."""
+    return entry_path[:-4] + ".npz" if entry_path.endswith(".npd") \
+        else entry_path
+
+
+def _decode_projected(has, get) -> Optional[dict]:
+    """Shared decode for both entry forms (directory-of-npy and legacy
+    npz), given membership/load accessors: bf16 features round-trip as a
+    tagged uint16 member (neither container has bf16), and a 2-D features
+    matrix gates validity."""
+    out = {}
+    if has("features_bf16"):
+        import ml_dtypes
+        out["features"] = get("features_bf16").view(ml_dtypes.bfloat16)
+    else:
+        out["features"] = get("features")
+    for k in _PROJECTED_KEYS[1:]:
+        out[k] = get(k)
+    return out if out["features"].ndim == 2 else None
 
 
 def load_projected_entry(cache_dir: str, name: str) -> Optional[dict]:
     """Load a projected entry ({'features','target','weight','valid_mask'})
-    or None on miss/corruption (corrupt entries are removed).  bfloat16
-    features round-trip as a tagged uint16 view (npz has no bf16)."""
+    or None on miss/corruption (corrupt entries are removed).  The big
+    features column comes back memory-mapped read-only — consumers
+    concatenate or device_put it, which streams pages without an extra
+    materializing copy."""
     entry = os.path.join(cache_dir, name)
-    if not os.path.exists(entry):
+    if os.path.isdir(entry):
+        try:
+            out = _decode_projected(
+                lambda k: os.path.exists(os.path.join(entry, k + ".npy")),
+                lambda k: np.load(os.path.join(entry, k + ".npy"),
+                                  mmap_mode=("r" if "features" in k
+                                             else None)))
+            if out is not None:
+                return out
+        except Exception:
+            pass
+        import shutil
+        shutil.rmtree(entry, ignore_errors=True)  # corrupt: rebuildable
         return None
-    try:
-        with np.load(entry) as z:
-            out = {}
-            if "features_bf16" in z:
-                import ml_dtypes
-                out["features"] = z["features_bf16"].view(ml_dtypes.bfloat16)
-            else:
-                out["features"] = z["features"]
-            for k in ("target", "weight", "valid_mask"):
-                out[k] = z[k]
-        if out["features"].ndim == 2:
-            return out
-    except Exception:
-        pass
-    try:
-        os.remove(entry)
-    except OSError:
-        pass
+    legacy = legacy_projected_path(entry)
+    if legacy != entry and os.path.exists(legacy):
+        # r4-format npz entry: still serve it (no forced re-parse on
+        # upgrade); new writes use the directory form
+        try:
+            with np.load(legacy) as z:
+                out = _decode_projected(lambda k: k in z, lambda k: z[k])
+            if out is not None:
+                return out
+        except Exception:
+            pass
+        try:
+            os.remove(legacy)
+        except OSError:
+            pass
     return None
 
 
 def write_projected_entry(cache_dir: str, name: str, arrays: dict) -> None:
-    """Atomic npz write + prune of stale-source entries; never raises
-    (cache is an accelerator only)."""
+    """Atomic directory-of-npy write + prune of stale-source entries; never
+    raises (cache is an accelerator only).  Atomicity: columns write into
+    a tmp dir, then one rename publishes the entry — a concurrent writer
+    losing the rename race just discards its tmp."""
     try:
         payload = dict(arrays)
         f = payload.get("features")
@@ -179,19 +225,18 @@ def write_projected_entry(cache_dir: str, name: str, arrays: dict) -> None:
             payload["features_bf16"] = f.view(np.uint16)
             del payload["features"]
         os.makedirs(cache_dir, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=cache_dir, suffix=".tmp")
+        tmp = tempfile.mkdtemp(dir=cache_dir, suffix=".tmp")
         try:
-            with os.fdopen(fd, "wb") as f2:
-                np.savez(f2, **payload)
-            os.replace(tmp, os.path.join(cache_dir, name))
+            for k, v in payload.items():
+                np.save(os.path.join(tmp, k + ".npy"),
+                        np.ascontiguousarray(v))
+            os.rename(tmp, os.path.join(cache_dir, name))
         finally:
-            if os.path.exists(tmp):
-                try:
-                    os.remove(tmp)
-                except OSError:
-                    pass
+            if os.path.exists(tmp):  # lost the rename race, or any error
+                import shutil
+                shutil.rmtree(tmp, ignore_errors=True)
         _prune_superseded(cache_dir, name)
-    except OSError:
+    except Exception:  # never fail ingest for the accelerator
         pass
 
 
@@ -230,7 +275,7 @@ def _prune_superseded(cache_dir: str, fresh_name: str) -> None:
     path_part, meta_part = parts[0], parts[1]
     try:
         for existing in os.listdir(cache_dir):
-            if not (existing.endswith(".npy") or existing.endswith(".npz")):
+            if not existing.endswith((".npy", ".npz", ".npd")):
                 continue
             if existing == fresh_name:
                 continue
@@ -239,8 +284,13 @@ def _prune_superseded(cache_dir: str, fresh_name: str) -> None:
                 continue
             if eparts[1] == meta_part:
                 continue  # same source state: raw + projections coexist
+            target = os.path.join(cache_dir, existing)
             try:
-                os.remove(os.path.join(cache_dir, existing))
+                if os.path.isdir(target):
+                    import shutil
+                    shutil.rmtree(target, ignore_errors=True)
+                else:
+                    os.remove(target)
             except OSError:
                 pass
     except OSError:

@@ -169,15 +169,19 @@ def load_datasets(
         valid_mask = np.zeros((0,), bool)
 
     full = TabularDataset(features, target, weight)
-    train = full.take(~valid_mask)
-    valid = full.take(valid_mask)
     # one-time global row shuffle of the training partition: staged epochs
     # then only permute batch order per epoch (staged_epoch_blocks), which
-    # together approximates row-level shuffling at a fraction of the host cost
-    if train.num_rows > 1:
-        perm = np.random.default_rng(
-            np.random.PCG64(data.split_seed ^ 0xC0FFEE)).permutation(train.num_rows)
-        train = train.take(perm)
+    # together approximates row-level shuffling at a fraction of the host
+    # cost.  The split-select and the shuffle COMPOSE into one gather
+    # (train_idx[perm]) — a separate take(~mask) then take(perm) would
+    # copy the whole training partition twice
+    train_idx = np.nonzero(~valid_mask)[0]
+    if len(train_idx) > 1:
+        perm = np.random.default_rng(np.random.PCG64(
+            data.split_seed ^ 0xC0FFEE)).permutation(len(train_idx))
+        train_idx = train_idx[perm]
+    train = full.take(train_idx)
+    valid = full.take(np.nonzero(valid_mask)[0])
     return train, valid
 
 
@@ -203,8 +207,15 @@ def projected_cache_complete(schema: DataSchema, data: DataConfig,
             name = cache_lib.projected_entry_name(
                 path, data.delimiter, file_idx, schema, data.valid_ratio,
                 data.split_seed, feature_dtype)
-            if name is None or not os.path.exists(
-                    os.path.join(cache_dir, name)):
+            if name is None:
+                return False
+            entry = os.path.join(cache_dir, name)
+            # a legacy r4-format .npz entry is just as hot (the loader's
+            # fallback serves it) — counting only the directory form would
+            # permanently disable the fast path for upgraded caches
+            if not (os.path.exists(entry)
+                    or os.path.exists(cache_lib.legacy_projected_path(
+                        entry))):
                 return False
         return True
     except OSError:

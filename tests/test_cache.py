@@ -137,3 +137,81 @@ def test_load_datasets_with_cache_matches_uncached(tmp_path):
         np.testing.assert_array_equal(a.weight, b.weight)
     np.testing.assert_array_equal(v0.features, v1.features)
     np.testing.assert_array_equal(v0.features, v2.features)
+
+
+def test_projected_entry_roundtrip_and_legacy_npz(tmp_path):
+    """Projected entries write as directories of raw .npy (r5: mmap-able
+    loads) and a legacy r4-format .npz under the same key still serves —
+    both through load_projected_entry and the hot-cache probe."""
+    import numpy as np
+
+    from shifu_tpu.data import cache as cache_lib
+
+    cdir = str(tmp_path / "c")
+    arrays = {
+        "features": np.arange(12, dtype=np.int8).reshape(4, 3),
+        "target": np.ones((4, 1), np.float32),
+        "weight": np.ones((4, 1), np.float32),
+        "valid_mask": np.array([True, False, False, True]),
+    }
+    name = "abcd1234abcd1234-ffff0000ffff0000-p0123456789abcdef.npd"
+    cache_lib.write_projected_entry(cdir, name, dict(arrays))
+    import os
+    assert os.path.isdir(os.path.join(cdir, name))
+    out = cache_lib.load_projected_entry(cdir, name)
+    assert out is not None
+    np.testing.assert_array_equal(out["features"], arrays["features"])
+    assert not out["features"].flags.writeable  # mmap'd read-only
+    np.testing.assert_array_equal(out["valid_mask"], arrays["valid_mask"])
+
+    # legacy r4 npz fallback under the same logical name
+    name2 = "abcd1234abcd1234-ffff0000ffff0000-pfedcba9876543210.npd"
+    legacy = cache_lib.legacy_projected_path(os.path.join(cdir, name2))
+    np.savez(legacy, **arrays)
+    out2 = cache_lib.load_projected_entry(cdir, name2)
+    assert out2 is not None
+    np.testing.assert_array_equal(out2["features"], arrays["features"])
+
+    # bf16 features round-trip through the tagged uint16 member
+    import ml_dtypes
+    bf = dict(arrays)
+    bf["features"] = arrays["features"].astype(ml_dtypes.bfloat16)
+    name3 = "abcd1234abcd1234-ffff0000ffff0000-paaaabbbbccccdddd.npd"
+    cache_lib.write_projected_entry(cdir, name3, dict(bf))
+    out3 = cache_lib.load_projected_entry(cdir, name3)
+    assert out3["features"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        out3["features"].view(np.uint16), bf["features"].view(np.uint16))
+
+
+def test_hot_cache_probe_accepts_legacy_npz(tmp_path):
+    """projected_cache_complete counts a legacy .npz entry as hot — an
+    upgraded cache must not permanently lose the skip-stream fast path."""
+    import dataclasses
+
+    import numpy as np
+
+    from shifu_tpu.config import DataConfig
+    from shifu_tpu.data import cache as cache_lib, pipeline as pipe, synthetic
+
+    schema = synthetic.make_schema(num_features=6)
+    rows = synthetic.make_rows(100, schema, seed=1)
+    ddir = str(tmp_path / "d")
+    paths = synthetic.write_files(rows, ddir, num_files=2)
+    cdir = str(tmp_path / "cache")
+    data = DataConfig(paths=(ddir,), cache_dir=cdir)
+    assert not pipe.projected_cache_complete(schema, data)
+    import os
+    os.makedirs(cdir, exist_ok=True)
+    for i, p in enumerate(paths):
+        name = cache_lib.projected_entry_name(
+            p, data.delimiter, i, schema, data.valid_ratio,
+            data.split_seed, "float32")
+        assert name.endswith(".npd")
+        # write the r4 form only
+        np.savez(cache_lib.legacy_projected_path(os.path.join(cdir, name)),
+                 features=np.zeros((5, 6), np.float32),
+                 target=np.zeros((5, 1), np.float32),
+                 weight=np.ones((5, 1), np.float32),
+                 valid_mask=np.zeros(5, bool))
+    assert pipe.projected_cache_complete(schema, data)
