@@ -1082,14 +1082,19 @@ def main() -> None:
             train_fn(e2e_job(cache=cdir), console=lambda s: None)
             train_fn(e2e_job(cache=cdir, wire="int8"), console=lambda s: None)
             best_bf16 = best_cached = 0.0
-            for _ in range(3):
+            for rep in range(3):
                 # record INCREMENTALLY: a failing rep (transient tunnel
-                # error) must not discard the reps already measured
-                rate, r = timed_run(e2e_job(cache=cdir))
-                best_bf16 = max(best_bf16, rate)
-                extras["e2e_cached_disk_bf16_samples_per_sec_per_chip"] = \
-                    round(best_bf16, 1)
-                extras["e2e_auc_bf16"] = round(r.history[0].valid_auc, 4)
+                # error) must not discard the reps already measured.  The
+                # bf16 continuity tier runs ONCE (its 68 B rows move ~2.2x
+                # the headline tier's bytes — three reps of it at low
+                # bandwidth would dominate the tier's wall and widen the
+                # probe-to-measurement drift window)
+                if rep == 0:
+                    rate, r = timed_run(e2e_job(cache=cdir))
+                    best_bf16 = max(best_bf16, rate)
+                    extras["e2e_cached_disk_bf16_samples_per_sec_per_chip"] \
+                        = round(best_bf16, 1)
+                    extras["e2e_auc_bf16"] = round(r.history[0].valid_auc, 4)
                 rate, r = timed_run(e2e_job(cache=cdir, wire="int8"))
                 best_cached = max(best_cached, rate)
                 extras["e2e_cached_disk_samples_per_sec_per_chip"] = round(
