@@ -117,3 +117,25 @@ def test_rung_hbm_model_dominated_by_table_at_high_vocab():
     table = 6 * 100_000 * 16 * 4
     assert b >= 8 * table
     assert 8 * table / b > 0.5
+
+
+def test_per_tier_deadline_fractions(monkeypatch):
+    """The soft budget is allocated by tier priority: a congested run
+    skips the mid-priority tiers (small fractions) while the north-star
+    e2e tier (frac 1.0) still has budget — the capture-protection the
+    fractions exist for."""
+    monkeypatch.setenv("SHIFU_TPU_BENCH_DEADLINE", "100")
+    # 60s elapsed: ladder slice (0.55) is spent, the e2e slice is not
+    monkeypatch.setattr(bench, "_BENCH_START",
+                        bench.time.monotonic() - 60.0)
+    assert bench._past_deadline(0.55) is True
+    assert bench._past_deadline(0.45) is True
+    assert bench._past_deadline() is False
+    # 101s elapsed: even the full budget is spent
+    monkeypatch.setattr(bench, "_BENCH_START",
+                        bench.time.monotonic() - 101.0)
+    assert bench._past_deadline() is True
+    # a bad env value falls back to the default budget instead of raising
+    monkeypatch.setenv("SHIFU_TPU_BENCH_DEADLINE", "not-a-number")
+    monkeypatch.setattr(bench, "_BENCH_START", bench.time.monotonic())
+    assert bench._past_deadline() is False
