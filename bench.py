@@ -140,6 +140,31 @@ def _sustained_rate(call, sync, samples_per_call: float, *,
 _BENCH_START = time.monotonic()  # reset at main() entry
 
 
+class _PhaseTrack:
+    """Bench tier boundaries -> the run journal (obs span events) + a local
+    totals dict for the BENCH artifact's `phases` key.  mark(name) closes
+    the previous phase and opens `name`; mark(None) closes the last one.
+    Boundary markers (no re-indentation of the tier bodies) rather than
+    `with` spans, so the diff against the measured code stays inert."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = {}
+        self._name = None
+        self._t0 = 0.0
+
+    def mark(self, name=None) -> None:
+        now = time.perf_counter()
+        if self._name is not None:
+            dur = now - self._t0
+            self.totals[self._name] = self.totals.get(self._name, 0.0) + dur
+            try:
+                from shifu_tpu.obs import spans as obs_spans
+                obs_spans.emit(f"bench/{self._name}", dur)
+            except Exception:
+                pass
+        self._name, self._t0 = name, now
+
+
 class _SkipTier(Exception):
     """Deliberate tier skip (time budget) — not a failure."""
 
@@ -537,6 +562,19 @@ def main() -> None:
 
     enable_persistent_cache()  # repeat bench runs skip the multi-sec compiles
 
+    # bench timings route through the run journal (obs/): with
+    # SHIFU_TPU_METRICS_DIR set the journal + scrape file land on disk like
+    # a training job's; otherwise an in-memory journal still feeds the
+    # per-phase breakdown recorded below as `phases`
+    from shifu_tpu import obs
+    metrics_dir = obs.resolve_metrics_dir()
+    if metrics_dir:
+        obs.configure(metrics_dir)
+    else:
+        obs.set_journal(obs.RunJournal(None))
+    phases = _PhaseTrack()
+    phases.mark("resident_sweep")
+
     num_features = 30
     schema = synthetic.make_schema(num_features=num_features)
 
@@ -606,6 +644,7 @@ def main() -> None:
     job = make_job(batch_size)
 
     # -- per-batch jit dispatch path (reference-style step granularity) -----
+    phases.mark("per_batch_dispatch")
     state2 = init_state(job, num_features, mesh)
     train_step = make_train_step(job, mesh, donate=True)
     host_batch = {
@@ -641,6 +680,7 @@ def main() -> None:
     # rows fit DataConfig.device_resident_bytes) and dequantize inside the
     # scan (train/step.make_wire_decode); measured at the sweep winner's
     # batch so the delta vs the bf16 headline is attributable to the wire
+    phases.mark("resident_int8")
     try:
         if _past_deadline(0.3):
             extras["resident_int8_skipped"] = \
@@ -696,6 +736,7 @@ def main() -> None:
     # any sweep winner, so the un-overlapped pipeline-fill chunk is a small
     # fraction of the epoch (the old 8-batch sizing = 2 chunks made fill
     # HALF the measurement)
+    phases.mark("staged")
     try:
         if _past_deadline(0.45):
             extras["staged_skipped"] = \
@@ -880,6 +921,7 @@ def main() -> None:
     # device-resident training throughput for the rest of the BASELINE
     # model ladder (configs 2-5); each rung pays a compile, so the whole
     # ladder runs by default but can be skipped with SHIFU_TPU_BENCH_FAST
+    phases.mark("ladder")
     if os.environ.get("SHIFU_TPU_BENCH_FAST"):
         extras["ladder_skipped"] = "SHIFU_TPU_BENCH_FAST"
     elif _past_deadline(0.55):
@@ -892,6 +934,7 @@ def main() -> None:
             extras.update(_ladder_extras(mesh, n_chips, peak, peak_hbm))
         except Exception as e:
             extras["ladder_error"] = str(e)[:200]
+    phases.mark("score")
     try:  # eval-side throughput: numpy op-list scorer on the same model
         import tempfile
 
@@ -922,6 +965,7 @@ def main() -> None:
     except Exception:
         pass
 
+    phases.mark("parse")
     try:  # input-side throughput: gzip|psv parse (native tier when available)
         import shutil
         import tempfile
@@ -980,6 +1024,7 @@ def main() -> None:
     except Exception:
         pass
 
+    phases.mark("e2e")
     try:
         # -- end-to-end from disk: the REAL product path ---------------------
         # `train()` on gzip|psv files — the streamed first epoch (parse ||
@@ -1126,6 +1171,7 @@ def main() -> None:
     except Exception as e:
         extras["e2e_error"] = str(e)[:200]
 
+    phases.mark(None)
     full = {
         "metric": "tabular_train_samples_per_sec_per_chip",
         "value": round(resident_per_chip, 1),
@@ -1135,6 +1181,8 @@ def main() -> None:
         "n_chips": n_chips,
         "model": "mlp_3x100_bf16_weighted_mse_adadelta",
         "global_batch": batch_size,
+        # per-phase wall breakdown, also journaled as bench/* span events
+        "phases": {k: round(v, 2) for k, v in phases.totals.items()},
         **extras,
     }
     # full record -> file; stdout gets ONE compact line the driver's
@@ -1147,6 +1195,11 @@ def main() -> None:
             json.dump(full, f, indent=1, sort_keys=True)
         full["full_results"] = os.path.basename(full_path)
     except OSError:
+        pass
+    try:
+        obs.event("bench_done", value=full["value"], phases=full["phases"])
+        obs.flush()  # journal + scrape land on SHIFU_TPU_METRICS_DIR runs
+    except Exception:
         pass
     print(json.dumps(_headline(full)))
 
@@ -1184,6 +1237,7 @@ _HEADLINE_OPTIONAL = (
     "score_single_row_per_sec_native_median",
     "parse_rows_per_sec",
     "per_batch_dispatch_samples_per_sec_per_chip",
+    "phases",
     "e2e_error", "staged_error", "ladder_error",
     "e2e_skipped", "staged_skipped", "ladder_skipped",
     "full_results",

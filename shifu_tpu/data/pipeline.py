@@ -12,10 +12,12 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import Iterator, Optional, Sequence
 
 import numpy as np
 
+from .. import obs
 from ..config.schema import DataConfig, DataSchema
 from . import reader, split
 
@@ -75,10 +77,29 @@ def _load_one_projected(item: tuple[int, str], schema: DataSchema,
             hit = cache_lib.load_projected_entry(cache_dir, name)
             if hit is not None:
                 mask = hit.pop("valid_mask")
+                obs.counter("data_cache_hits_total",
+                            "projected-cache hits (one npz/npd load "
+                            "replaced parse+project+split+cast)").inc()
+                obs.counter("data_rows_read_total",
+                            "rows ingested into datasets").inc(
+                    int(hit["features"].shape[0]), source="cache")
                 return hit, mask
+        obs.counter("data_cache_misses_total",
+                    "projected-cache misses (full parse path taken)").inc()
+    t_parse = time.perf_counter()
     rows = cache_lib.read_file_cached(
         path, data.delimiter, cache_dir=data.cache_dir,
         parser_threads=1 if threaded else None)
+    obs.histogram("data_file_parse_seconds",
+                  "per-file parse (or raw-cache load) latency").observe(
+        time.perf_counter() - t_parse)
+    obs.counter("data_files_read_total", "data files parsed").inc()
+    obs.counter("data_rows_read_total",
+                "rows ingested into datasets").inc(
+        int(rows.shape[0]), source="parse")
+    obs.counter("data_bytes_read_total",
+                "parsed matrix bytes produced by ingest").inc(
+        int(rows.nbytes))
     cols = reader.project_columns(rows, schema)
     if feature_dtype == "bfloat16":
         import ml_dtypes
@@ -692,9 +713,21 @@ def prefetch_to_device(batches: Iterator[dict[str, np.ndarray]],
                 return shard_lib.shard_batch(b, mesh)
             return {k: jax.device_put(v) for k, v in b.items()}
 
+    # per-batch host latency (produce + wire-cast + device placement),
+    # observed in the producer so the histogram sees the true host cost
+    # rather than the consumer's (usually zero) queue wait
+    lat = obs.histogram("data_batch_latency_seconds",
+                        "host batch production + device placement latency")
+
+    def timed_put(b):
+        t0 = time.perf_counter()
+        out = put_fn(b)
+        lat.observe(time.perf_counter() - t0)
+        return out
+
     if size <= 0:
         for b in batches:
-            yield put_fn(b)
+            yield timed_put(b)
         return
 
     q: "queue.Queue" = queue.Queue(maxsize=size)
@@ -703,7 +736,7 @@ def prefetch_to_device(batches: Iterator[dict[str, np.ndarray]],
     def producer() -> None:
         try:
             for b in batches:
-                q.put(put_fn(b))
+                q.put(timed_put(b))
         except BaseException as e:  # surface errors to the consumer
             q.put(e)
             return

@@ -13,11 +13,25 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Sequence
 
 import numpy as np
 
 from .artifact import SIDE_CAR, TOPOLOGY, WEIGHTS
+
+
+def observe_scoring(engine: str, n_rows: int, seconds: float) -> None:
+    """One telemetry write per scored batch, shared by every engine tier
+    (numpy / stablehlo / jax here, native in runtime/native_scorer.py):
+    score latency histogram + rows counter, labeled by engine."""
+    from .. import obs
+
+    obs.counter("score_rows_total", "rows scored").inc(
+        max(int(n_rows), 0), engine=engine)
+    obs.histogram("score_batch_seconds",
+                  "batch scoring latency by engine").observe(
+        seconds, engine=engine)
 
 _LEAKY_ALPHA = 0.2  # keep in sync with ops/activations.py
 _LN_EPS = 1e-6      # flax nn.LayerNorm default
@@ -238,8 +252,11 @@ class Scorer:
         if x.shape[1] != self.num_features:
             raise ValueError(
                 f"expected {self.num_features} features, got {x.shape[1]}")
-        return run_program(self.program, self.weights, x,
-                           extra_inputs=self.extra_inputs)
+        t0 = time.perf_counter()
+        out = run_program(self.program, self.weights, x,
+                          extra_inputs=self.extra_inputs)
+        observe_scoring("numpy", out.shape[0], time.perf_counter() - t0)
+        return out
 
     def compute(self, row: Sequence[float]) -> float:
         """Single-row double score in [0,1] — the reference's exact call shape
@@ -288,7 +305,10 @@ class JaxScorer:
         if x.shape[1] != self.num_features:
             raise ValueError(
                 f"expected {self.num_features} features, got {x.shape[1]}")
-        return np.asarray(self._fwd(self._jnp.asarray(x)))
+        t0 = time.perf_counter()
+        out = np.asarray(self._fwd(self._jnp.asarray(x)))
+        observe_scoring("jax", out.shape[0], time.perf_counter() - t0)
+        return out
 
     def compute(self, row: Sequence[float]) -> float:
         return float(self.compute_batch(np.asarray(row, dtype=np.float64))[0, 0])
@@ -331,7 +351,10 @@ class StableHloScorer:
         if x.shape[1] != self.num_features:
             raise ValueError(
                 f"expected {self.num_features} features, got {x.shape[1]}")
-        return np.asarray(self._exported.call(x))
+        t0 = time.perf_counter()
+        out = np.asarray(self._exported.call(x))
+        observe_scoring("stablehlo", out.shape[0], time.perf_counter() - t0)
+        return out
 
     def compute(self, row: Sequence[float]) -> float:
         return float(self.compute_batch(np.asarray(row, dtype=np.float64))[0, 0])

@@ -98,8 +98,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     st = sub.add_parser("status", help="report a detached job's state "
                                        "(RUNNING/FINISHED/FAILED + last "
-                                       "progress line) from its job dir")
+                                       "progress line + telemetry summary) "
+                                       "from its job dir")
     st.add_argument("job_dir")
+    mt = sub.add_parser(
+        "metrics", help="render a job's telemetry — run journal + "
+                        "Prometheus scrape file — for a running or "
+                        "finished job (see docs/OBSERVABILITY.md)")
+    mt.add_argument("job_dir",
+                    help="job dir, telemetry dir, or journal.jsonl path "
+                         "(local or gs:// hdfs:// URI)")
+    mt.add_argument("--json", action="store_true",
+                    help="machine-readable summary dict instead of text")
+    mt.add_argument("--follow", action="store_true",
+                    help="stream journal events as JSONL until ^C "
+                         "(tail_board for the structured stream)")
     at = sub.add_parser("attach", help="follow a detached job's console "
                                        "board until it ends (TailThread "
                                        "parity); exits with the job's code")
@@ -580,7 +593,20 @@ def run_train(args) -> int:
     from ..train import train
     from .console import ConsoleBoard
 
+    from .. import obs
     from ..data import fsio as fsio_lib
+    t_run = time.monotonic()
+    if chief:
+        # telemetry sinks: SHIFU_TPU_METRICS_DIR wins, else the job dir —
+        # `shifu-tpu metrics <job_dir>` then finds journal + scrape file
+        # under <job_dir>/telemetry without any env setup
+        metrics_dir = obs.resolve_metrics_dir() \
+            or fsio_lib.join(out_dir, "telemetry")
+        try:
+            obs.configure(metrics_dir)
+        except Exception:
+            pass  # telemetry must never block the job
+    obs.counter("launcher_runs_total", "train runs started").inc()
     if chief:
         board = ConsoleBoard(fsio_lib.join(out_dir, "console.board"))
     else:  # non-chief processes train silently (reference: only the AM's
@@ -634,6 +660,23 @@ def run_train(args) -> int:
           f"mesh={dict(mesh.shape) if mesh is not None else None} "
           f"model={job.model.model_type} epochs={job.train.epochs} "
           f"batch={job.data.batch_size}")
+    obs.gauge("launcher_devices_in_use",
+              "devices this run trains on").set(devices_in_use)
+    obs.event("run_start", command="train", app_name=job.runtime.app_name,
+              devices=devices_in_use,
+              mesh=dict(mesh.shape) if mesh is not None else None,
+              model=job.model.model_type, epochs=job.train.epochs,
+              batch_size=job.data.batch_size,
+              processes=jax.process_count())
+
+    def _finish(rc: int) -> int:
+        # terminal journal record + scrape-file write on EVERY exit path,
+        # so `shifu-tpu metrics` reads a complete story for failed and
+        # timed-out runs too
+        obs.event("run_end", exit=rc,
+                  wall_s=round(time.monotonic() - t_run, 2))
+        obs.flush()
+        return rc
 
     from .supervisor import JobDeadline
     deadline = JobDeadline(job.runtime.timeout_seconds)
@@ -660,11 +703,12 @@ def run_train(args) -> int:
         result = train(job, mesh=mesh, console=board, epoch_callback=check_timeout)
     except TimeoutError:
         board.close()
-        return EXIT_TIMEOUT
+        return _finish(EXIT_TIMEOUT)
     except Exception as e:  # noqa: BLE001 - job boundary
         board(f"training failed: {type(e).__name__}: {e}")
+        obs.event("run_error", error=f"{type(e).__name__}: {e}"[:500])
         board.close()
-        return EXIT_FAIL
+        return _finish(EXIT_FAIL)
 
     params = result.state.params
     if jax.process_count() > 1 and mesh is not None:
@@ -686,7 +730,7 @@ def run_train(args) -> int:
         from ..parallel import distributed as dist
         dist.barrier("export_done")
     board.close()
-    return EXIT_OK
+    return _finish(EXIT_OK)
 
 
 def _write_metrics_jsonl(result, path: str) -> None:
@@ -811,9 +855,47 @@ def _project_features(rows, model_dir: str, scorer):
     return np.nan_to_num(rows, nan=0.0)
 
 
+def run_metrics(args) -> int:
+    """`shifu-tpu metrics <dir>`: render the run journal + registry scrape
+    for a running or finished job — the operator view of the unified
+    telemetry layer (obs/), succeeding the reference client's poll of the
+    AM's aggregated metrics."""
+    from .. import obs
+    from ..obs import render as obs_render
+
+    if getattr(args, "follow", False):
+        jpath = obs_render.find_journal(args.job_dir)
+        if jpath is None:
+            print(f"no telemetry journal found under {args.job_dir}",
+                  file=sys.stderr, flush=True)
+            return EXIT_FAIL
+        try:
+            for rec in obs.tail_journal(jpath):
+                print(json.dumps(rec), flush=True)
+        except KeyboardInterrupt:
+            pass
+        return EXIT_OK
+    try:
+        summary = obs_render.summarize(args.job_dir)
+    except Exception as e:
+        print(f"metrics: {e}", file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    if summary is None:
+        print(f"no telemetry journal found under {args.job_dir} (expected "
+              f"<job_dir>/telemetry/journal.jsonl — run with "
+              f"SHIFU_TPU_METRICS_DIR or a CLI train job)",
+              file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    print(json.dumps(summary) if args.json
+          else obs_render.render_text(summary))
+    return EXIT_OK
+
+
 def run_score(args) -> int:
+    from .. import obs
     from ..data import reader
 
+    obs.configure_from_env()
     rc = _kerberos_from_xml(args.globalconfig)
     if rc != EXIT_OK:
         return rc
@@ -835,6 +917,8 @@ def run_score(args) -> int:
             out.write("|".join(f"{v:.6f}" for v in s) + "\n")
     if out is not sys.stdout:
         out.close()
+    obs.event("score_run", rows=int(feats.shape[0]), model=args.model)
+    obs.flush()
     return EXIT_OK
 
 
@@ -852,7 +936,17 @@ def _apply_platform_env() -> None:
         jax.config.update("jax_platforms", plat)
         n = os.environ.get("SHIFU_TPU_CPU_DEVICES")
         if n and plat == "cpu":
-            jax.config.update("jax_num_cpu_devices", int(n))
+            try:
+                jax.config.update("jax_num_cpu_devices", int(n))
+            except AttributeError:
+                # older jax: no such option — fall back to XLA_FLAGS so a
+                # cold CLI path (status/attach/kill) never tracebacks
+                flags = os.environ.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in flags:
+                    os.environ["XLA_FLAGS"] = (
+                        flags
+                        + f" --xla_force_host_platform_device_count={int(n)}"
+                    ).strip()
     except RuntimeError:
         pass  # backends already initialized
 
@@ -865,9 +959,11 @@ def run_eval(args) -> int:
     scoring and in-process metrics the reference's row-at-a-time JNI path
     could not offer."""
 
+    from .. import obs
     from ..config.shifu_compat import load_json, parse_column_config
     from ..data import reader
 
+    obs.configure_from_env()
     rc = _kerberos_from_xml(args.globalconfig)
     if rc != EXIT_OK:
         return rc
@@ -968,6 +1064,9 @@ def run_eval(args) -> int:
     if n_heads > 1:
         summary["heads"] = heads
     print(json.dumps(summary))
+    obs.event("eval_run", rows=int(rows), auc=summary["auc"],
+              weighted_error=summary["weighted_error"], model=args.model)
+    obs.flush()
     return EXIT_OK
 
 
@@ -980,27 +1079,30 @@ def _export_and_pack(params, job, out_dir, console) -> str:
     temp dir (the exporters and the native pack write real files) and
     uploads it through fsio — the reference likewise exported to
     FINAL_MODEL_PATH on HDFS (ssgd_monitor.py:302-345)."""
+    from .. import obs
     from ..data import fsio
     from ..export import save_artifact
     from ..train import make_forward_fn
 
-    remote = fsio.is_remote(out_dir)
-    local_dir = out_dir
-    if remote:
-        import tempfile
-        local_dir = tempfile.mkdtemp(prefix="shifu_tpu_export_")
-    export_dir = save_artifact(params, job, local_dir,
-                               forward_fn=make_forward_fn(job))
-    try:
-        from ..runtime import pack_native
-        pack_native(export_dir)
-    except Exception as e:  # native pack is best-effort
-        console(f"native pack skipped: {e}")
-    if remote:
-        import shutil
-        fsio.upload_dir(export_dir, out_dir)
-        shutil.rmtree(local_dir, ignore_errors=True)
-        export_dir = out_dir
+    with obs.span("export", journal=False):
+        remote = fsio.is_remote(out_dir)
+        local_dir = out_dir
+        if remote:
+            import tempfile
+            local_dir = tempfile.mkdtemp(prefix="shifu_tpu_export_")
+        export_dir = save_artifact(params, job, local_dir,
+                                   forward_fn=make_forward_fn(job))
+        try:
+            from ..runtime import pack_native
+            pack_native(export_dir)
+        except Exception as e:  # native pack is best-effort
+            console(f"native pack skipped: {e}")
+        if remote:
+            import shutil
+            fsio.upload_dir(export_dir, out_dir)
+            shutil.rmtree(local_dir, ignore_errors=True)
+            export_dir = out_dir
+    obs.event("export", dest=export_dir)
     console(f"model exported to {export_dir}")
     return export_dir
 
@@ -1138,6 +1240,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_export(args)
     if args.command == "provision":
         return run_provision(args)
+    if args.command == "metrics":
+        # pure file reads — must not pay the jax import or compile cache
+        return run_metrics(args)
     from . import detach as detach_lib
     if args.command == "status":
         return detach_lib.run_status(args.job_dir)

@@ -154,7 +154,46 @@ def job_state(out_dir: str) -> dict:
             out["last_progress"] = lines[-1]
     except OSError:
         pass
+    try:  # telemetry summary when the job dir carries a run journal (obs/)
+        tele = _telemetry_quick_summary(
+            os.path.join(out_dir, "telemetry", "journal.jsonl"))
+        if tele:
+            out["telemetry"] = tele
+    except Exception:
+        pass
     return out
+
+
+def _telemetry_quick_summary(jpath: str) -> Optional[dict]:
+    """Bounded journal probe for `status` polls: count newlines in one
+    chunked pass and json-decode ONLY the last complete line — a long run
+    journals tens of thousands of events, and a status poll must not pay
+    an O(run-length) decode each call (`shifu-tpu metrics` does the full
+    parse on demand)."""
+    if not os.path.exists(jpath):
+        return None
+    n = 0
+    tail = b""
+    with open(jpath, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            n += chunk.count(b"\n")
+            # 64 KiB window: a host_skew event on a large pod can exceed
+            # 4 KiB in ONE line, and a tail that holds only a mid-line
+            # fragment would report last_event=null on a healthy journal
+            tail = (tail + chunk)[-65536:]
+    last_kind = None
+    for line in reversed(tail.splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            last_kind = rec.get("kind")
+            break
+    return {"events": n, "last_event": last_kind}
 
 
 def run_status(out_dir: str, echo=print) -> int:

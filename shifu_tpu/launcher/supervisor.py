@@ -192,14 +192,44 @@ def charge_restart_budget(failures_since_progress: int, progressed: bool,
     return failures_since_progress + 1
 
 
+def _telemetry_dir(board_path: Optional[str]) -> Optional[str]:
+    """Where the supervisor's journal lives: SHIFU_TPU_METRICS_DIR when
+    set, else `<job dir>/telemetry` derived from the board path — the same
+    dir the train child writes, so restarts and epochs interleave in ONE
+    journal (append-only JSONL tolerates two writers)."""
+    from .. import obs
+
+    d = obs.resolve_metrics_dir()
+    if d:
+        return d
+    if not board_path:
+        return None
+    try:
+        from ..data import fsio
+        if fsio.is_remote(board_path):
+            return fsio.join(board_path.rsplit("/", 1)[0], "telemetry")
+        return os.path.join(os.path.dirname(os.path.abspath(board_path)),
+                            "telemetry")
+    except Exception:
+        return None
+
+
 def _board_size(path: str) -> int:
-    """Board file size for the liveness monitor, -1 when missing — fsio for
-    remote (gs:// hdfs://) job dirs, os.stat locally."""
+    """Board progress signature for the liveness monitor, -1 when missing —
+    fsio for remote (gs:// hdfs://) job dirs, os.stat locally.
+
+    Remote boards fold the object's mtime into the signature: once the
+    board's retained-line cap engages (console.py), every rewrite drops one
+    line and appends one of similar length, so SIZE alone plateaus and a
+    size-only probe would false-kill a healthy long job as 'no progress'.
+    The store's mtime advances on every rewrite regardless."""
     try:
         from ..data import fsio
         if fsio.is_remote(path):
-            size, _ = fsio.file_info(path)
-            return -1 if size is None else int(size)
+            size, mtime_ns = fsio.file_info(path)
+            if size is None and mtime_ns is None:
+                return -1
+            return int(size or 0) + int(mtime_ns or 0)
     except Exception:
         return -1
     try:
@@ -295,6 +325,33 @@ def supervise(child_argv: Sequence[str],
     """
     import signal as signal_lib
 
+    from .. import obs
+
+    # journal-only sinks (scrape=False): the train CHILD owns the scrape
+    # file; the parent journals the restart/liveness story beside it so
+    # `shifu-tpu metrics` shows one merged timeline.  Local dirs share the
+    # child's journal (O_APPEND tolerates two writers); REMOTE dirs get a
+    # sidecar object — remote journals are whole-object rewrites of the
+    # writer's own lines, so sharing one object would erase the child's
+    # events on every parent flush (render merges the sidecar back in)
+    tele_dir = _telemetry_dir(board_path)
+    if tele_dir:
+        remote_tele = False
+        try:
+            from ..data import fsio
+            remote_tele = fsio.is_remote(tele_dir)
+        except Exception:
+            pass
+        obs.configure(tele_dir, scrape=False, flush_every=1,
+                      journal_name=("journal-supervisor.jsonl" if remote_tele
+                                    else "journal.jsonl"))
+    # journal events only, no parent-side counters: the parent never
+    # exports a scrape file (scrape=False), so registry counters here
+    # would be write-only — the supervisor_restart/liveness_kill events
+    # carry the same data into the merged timeline
+    obs.event("supervisor_start", max_restarts=max_restarts,
+              liveness_seconds=liveness_seconds,
+              timeout_seconds=timeout_seconds)
     python = python or sys.executable
     cmd = [python, "-m", "shifu_tpu.launcher.cli", *child_argv]
     attempts = 0
@@ -319,6 +376,7 @@ def supervise(child_argv: Sequence[str],
                 # don't spawn a doomed attempt just to kill it one poll later
                 print("supervisor: job timeout exceeded — terminal, "
                       "no restart", flush=True)
+                obs.event("supervisor_timeout", attempts=attempts)
                 return EXIT_TIMEOUT
             attempts += 1
             start = time.monotonic()
@@ -344,6 +402,7 @@ def supervise(child_argv: Sequence[str],
                         print(f"supervisor: job timeout "
                               f"({timeout_seconds:.0f}s) exceeded — killing "
                               f"attempt {attempts}", flush=True)
+                        obs.event("supervisor_timeout", attempt=attempts)
                         # graceful first: the child is healthy (not hung) and
                         # its SIGTERM drain can finalize the checkpoint
                         _kill_tree(proc, signal_lib.SIGTERM)
@@ -363,6 +422,9 @@ def supervise(child_argv: Sequence[str],
                             print(f"supervisor: no progress for "
                                   f"{liveness_seconds}s — killing attempt "
                                   f"{attempts}", flush=True)
+                            obs.event("supervisor_liveness_kill",
+                                      attempt=attempts,
+                                      window_s=liveness_seconds)
                             # hung tree: no grace, hard-kill immediately
                             _kill_tree(proc)
                             rc = -9
@@ -379,6 +441,7 @@ def supervise(child_argv: Sequence[str],
                 if attempts > 1:
                     print(f"supervisor: succeeded after {attempts} attempts",
                           flush=True)
+                obs.event("supervisor_done", attempts=attempts)
                 return 0
             if rc == EXIT_TIMEOUT:
                 # terminal: a timed-out job must not restart (each attempt
@@ -389,14 +452,20 @@ def supervise(child_argv: Sequence[str],
                 return EXIT_TIMEOUT
             elapsed = time.monotonic() - start
             # durable progress only: the checkpoint epoch advanced this attempt
+            progressed = probe.advanced()
             failures_since_progress = charge_restart_budget(
-                failures_since_progress, probe.advanced())
+                failures_since_progress, progressed)
             print(f"supervisor: attempt {attempts} exited rc={rc} "
                   f"after {elapsed:.1f}s"
                   + (" (liveness kill)" if killed_for_hang else ""), flush=True)
+            obs.event("supervisor_restart", attempt=attempts, rc=rc,
+                      progressed=progressed,
+                      liveness_kill=killed_for_hang,
+                      elapsed_s=round(elapsed, 2))
             if failures_since_progress > max_restarts:
                 print(f"supervisor: restart budget exhausted "
                       f"({max_restarts} restarts without progress)", flush=True)
+                obs.event("supervisor_exhausted", attempts=attempts, rc=rc)
                 return rc if isinstance(rc, int) and rc > 0 else 1
     except _Terminated:
         # catches the signal wherever it lands — inside the poll loop,

@@ -1,0 +1,116 @@
+"""Cross-host telemetry aggregation: the per-host skew table.
+
+The SPMD successor of the reference AM's slowest-first worker sort
+(appmaster/TensorflowSession.java:515-549, every worker's
+TrainingIntermediateResult collected and sorted into one log line): each
+host encodes a small JSON summary of its local telemetry, ONE
+`multihost_utils.process_allgather` moves all of them, and every host
+decodes the full set — the chief renders/journals the skew table.
+
+COLLECTIVE: every process must call `gather_host_summaries` together
+(the train loop does, once per epoch under multihost).  Single-process
+callers get their own summary back without touching jax collectives, so
+the same code path serves tests and real pods.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+# one fixed-size row per host: JSON padded with NULs so the allgathered
+# array is rectangular.  4 KiB holds a generous summary; oversized
+# payloads degrade to a marker rather than desyncing the gather.
+DEFAULT_MAX_BYTES = 4096
+
+
+def host_summary(input_seconds: float = 0.0, epoch_seconds: float = 0.0,
+                 valid_seconds: float = 0.0, **extra) -> dict:
+    """This host's skew-table row: identity + the per-host-attributable
+    timings (host-side input production is what a degraded disk/NIC shows
+    up in first — SURVEY section 5.1), plus any caller extras."""
+    import jax
+
+    row = {
+        "host": os.uname().nodename,
+        "rank": jax.process_index(),
+        "input_s": round(float(input_seconds), 4),
+        "epoch_s": round(float(epoch_seconds), 4),
+        "valid_s": round(float(valid_seconds), 4),
+    }
+    row.update(extra)
+    return row
+
+
+def gather_host_summaries(summary: dict,
+                          max_bytes: int = DEFAULT_MAX_BYTES
+                          ) -> list[dict]:
+    """All-gather one small dict per host; returns every host's decoded
+    dict (rank order).  Single-process: [summary], no collectives."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return [dict(summary)]
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    payload = json.dumps(summary).encode()
+    if len(payload) > max_bytes:
+        payload = json.dumps({"host": summary.get("host", "?"),
+                              "rank": summary.get("rank", -1),
+                              "_truncated": True}).encode()[:max_bytes]
+    buf = np.zeros((max_bytes,), np.uint8)
+    buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    rows = []
+    for r in range(gathered.shape[0]):
+        raw = gathered[r].tobytes().rstrip(b"\0")
+        try:
+            rec = json.loads(raw)
+        except ValueError:
+            rec = {"rank": r, "_undecodable": True}
+        if isinstance(rec, dict):
+            rec.setdefault("rank", r)
+            rows.append(rec)
+    return rows
+
+
+def skew_line(epoch: int, rows: list[dict],
+              sort_key: str = "input_s") -> str:
+    """One console line, hosts slowest-first by `sort_key` — the same
+    operator read the reference AM printed, under SPMD semantics (input
+    seconds are the per-host-attributable cost; epoch wall converges)."""
+    ordered = sorted(rows, key=lambda r: -float(r.get(sort_key, 0.0)))
+    parts = [
+        (f"{r.get('host', '?')}[{r.get('rank', '?')}] "
+         f"input {float(r.get('input_s', 0.0)):.2f}s "
+         f"(epoch {float(r.get('epoch_s', 0.0)):.2f}s, "
+         f"valid {float(r.get('valid_s', 0.0)):.2f}s)")
+        for r in ordered]
+    return (f"Epoch {epoch} hosts by input time (slowest first): "
+            + " | ".join(parts))
+
+
+def epoch_skew(epoch: int, input_seconds: float, epoch_seconds: float,
+               valid_seconds: float, console=None,
+               journal: bool = True) -> Optional[list[dict]]:
+    """The per-epoch cross-host skew: gather every host's summary, print
+    the slowest-first line on the chief, journal a `host_skew` event.
+    COLLECTIVE under multihost (every rank must call); returns the rows on
+    the chief, None elsewhere."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return None
+    rows = gather_host_summaries(host_summary(
+        input_seconds, epoch_seconds, valid_seconds))
+    if jax.process_index() != 0:
+        return None
+    if console is not None:
+        console(skew_line(epoch, rows))
+    if journal:
+        from . import _sinks
+        _sinks.event("host_skew", epoch=epoch, hosts=rows)
+    return rows

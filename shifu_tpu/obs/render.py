@@ -1,0 +1,191 @@
+"""Render a job's telemetry (journal + scrape file) for the CLI.
+
+`shifu-tpu metrics <dir>` lands here: `<dir>` may be a job dir (telemetry
+lives under `<dir>/telemetry/`), the telemetry dir itself, or a direct
+journal path — local or remote through data/fsio.  Output is a compact
+human summary (run metadata, epoch table, event counts, key counters);
+`--json` mode emits one machine-readable dict instead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional
+
+from . import _sinks, journal as journal_mod
+
+TELEMETRY_DIRNAME = "telemetry"
+
+
+def _exists(path: str) -> bool:
+    try:
+        from ..data import fsio
+        if fsio.is_remote(path):
+            try:
+                fsio.file_info(path)
+                return True
+            except FileNotFoundError:
+                return False
+        return os.path.exists(path)
+    except Exception:
+        return os.path.exists(path)
+
+
+def find_journal(path: str) -> Optional[str]:
+    """Resolve a journal path from a job dir / telemetry dir / file path."""
+    from ..data import fsio
+
+    if path.endswith(".jsonl"):
+        return path if _exists(path) else None
+    candidates = (
+        fsio.join(path, TELEMETRY_DIRNAME, journal_mod.JOURNAL_FILE),
+        fsio.join(path, journal_mod.JOURNAL_FILE),
+    )
+    for c in candidates:
+        if _exists(c):
+            return c
+    return None
+
+
+def _read_scrape(journal_path: str) -> Optional[str]:
+    # a bare relative journal filename (cwd = the telemetry dir) must
+    # resolve to ITS directory, not to "/metrics.prom"
+    if "/" in journal_path:
+        prom = journal_path.rsplit("/", 1)[0] + "/" + _sinks.SCRAPE_FILE
+    else:
+        prom = _sinks.SCRAPE_FILE
+    if not _exists(prom):
+        return None
+    try:
+        from ..data import fsio
+        if fsio.is_remote(prom):
+            return fsio.read_bytes(prom).decode("utf-8", "replace")
+        with open(prom) as f:
+            return f.read()
+    except Exception:
+        return None
+
+
+def parse_scrape_totals(text: str) -> dict[str, float]:
+    """Per-metric totals from Prometheus text: counters/gauges sum across
+    label sets; histograms report their `_count` total.  Enough for the
+    summary view without a real Prometheus parser."""
+    totals: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{.*\})?\s+(\S+)$", line)
+        if not m:
+            continue
+        name, _labels, value = m.groups()
+        if name.endswith("_bucket") or name.endswith("_sum"):
+            continue
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        key = name[:-6] if name.endswith("_count") else name
+        totals[key] = totals.get(key, 0.0) + v
+    return totals
+
+
+def summarize(path: str) -> Optional[dict]:
+    """The telemetry summary dict for a job/telemetry dir, or None when no
+    journal is found."""
+    jpath = find_journal(path)
+    if jpath is None:
+        return None
+    events = journal_mod.read_journal(jpath)
+    # merge the supervisor's remote-dir sidecar journal, if present (two
+    # writers on one remote object would erase each other — see
+    # obs/_sinks.configure); sort restores one timeline
+    sidecar = (jpath.rsplit("/", 1)[0] + "/journal-supervisor.jsonl"
+               if "/" in jpath
+               else os.path.join(os.path.dirname(jpath),
+                                 "journal-supervisor.jsonl"))
+    if sidecar != jpath and _exists(sidecar):
+        try:
+            events = sorted(events + journal_mod.read_journal(sidecar),
+                            key=lambda r: (r.get("ts") or 0,
+                                           r.get("seq") or 0))
+        except Exception:
+            pass
+    kinds: dict[str, int] = {}
+    epochs: list[dict] = []
+    run: dict = {}
+    spans: dict[str, float] = {}
+    for rec in events:
+        kind = str(rec.get("kind", "?"))
+        kinds[kind] = kinds.get(kind, 0) + 1
+        if kind == "epoch":
+            epochs.append(rec)
+        elif kind in ("run_start", "train_start") and not run:
+            run = {k: v for k, v in rec.items()
+                   if k not in ("seq", "kind")}
+        elif kind == "span":
+            name = str(rec.get("span", "?"))
+            spans[name] = spans.get(name, 0.0) + float(rec.get("dur_s") or 0)
+    out = {
+        "journal": jpath,
+        "events": len(events),
+        "event_kinds": dict(sorted(kinds.items())),
+        "run": run,
+        "epochs": [
+            {k: e.get(k) for k in ("epoch", "train_error", "valid_error",
+                                   "valid_auc", "epoch_time", "valid_time")}
+            for e in epochs],
+        "span_totals_s": {k: round(v, 4)
+                          for k, v in sorted(spans.items())},
+    }
+    if events:
+        last = events[-1]
+        out["last_event"] = {"kind": last.get("kind"), "ts": last.get("ts")}
+    scrape = _read_scrape(jpath)
+    if scrape is not None:
+        out["metrics"] = {k: v for k, v in
+                          sorted(parse_scrape_totals(scrape).items())}
+    return out
+
+
+def render_text(summary: dict) -> str:
+    """Human-readable rendering of `summarize`'s dict."""
+    lines = [f"journal: {summary['journal']} ({summary['events']} events)"]
+    run = summary.get("run") or {}
+    if run:
+        desc = " ".join(f"{k}={v}" for k, v in run.items()
+                        if k not in ("ts",) and v is not None)
+        lines.append(f"run: {desc}")
+    kinds = summary.get("event_kinds") or {}
+    if kinds:
+        lines.append("events: " + " ".join(f"{k}={v}"
+                                           for k, v in kinds.items()))
+    epochs = summary.get("epochs") or []
+    if epochs:
+        lines.append(f"{'epoch':>5} {'train_err':>10} {'valid_err':>10} "
+                     f"{'auc':>7} {'time_s':>8} {'valid_s':>8}")
+        for e in epochs:
+            def f(v, spec):
+                return format(v, spec) if isinstance(v, (int, float)) \
+                    else "-"
+            lines.append(f"{f(e.get('epoch'), 'd'):>5} "
+                         f"{f(e.get('train_error'), '.6f'):>10} "
+                         f"{f(e.get('valid_error'), '.6f'):>10} "
+                         f"{f(e.get('valid_auc'), '.4f'):>7} "
+                         f"{f(e.get('epoch_time'), '.2f'):>8} "
+                         f"{f(e.get('valid_time'), '.2f'):>8}")
+    spans = summary.get("span_totals_s") or {}
+    if spans:
+        lines.append("span totals (s): " + " ".join(
+            f"{k}={v:g}" for k, v in spans.items()))
+    metrics = summary.get("metrics")
+    if metrics:
+        lines.append(f"metrics ({len(metrics)} series totals):")
+        for k, v in metrics.items():
+            lines.append(f"  {k} {v:g}")
+    last = summary.get("last_event")
+    if last:
+        lines.append(f"last event: {last.get('kind')} at ts "
+                     f"{last.get('ts')}")
+    return "\n".join(lines)

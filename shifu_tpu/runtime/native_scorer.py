@@ -273,12 +273,16 @@ class NativeScorer:
             raise ValueError(f"expected {self.num_features} features, got {x.shape[1]}")
         n = x.shape[0]
         out = np.empty((n, self.num_heads), dtype=np.float32)
+        import time
+        t0 = time.perf_counter()
         rc = self._lib.shifu_scorer_compute_batch(
             self._handle,
             x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         if rc != 0:
             raise RuntimeError(f"native scorer error code {rc}")
+        from ..export.scorer import observe_scoring
+        observe_scoring("native", n, time.perf_counter() - t0)
         return out
 
     def compute(self, row: Sequence[float]) -> float:

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from .. import obs
 from ..config.schema import ConfigError, JobConfig
 from ..data import pipeline as pipe
 from ..models.registry import build_model
@@ -253,6 +254,29 @@ def _restore_across_trunk_layout(manager, state: TrainState, job: JobConfig,
     return (state.replace(params=placed, step=step_val), extra, step)
 
 
+def _accumulate_streaming(triples) -> tuple[float, float]:
+    """THE eval accumulation: one StreamingMetrics over (scores, labels,
+    weights) chunks, shared by the single-host and multihost branches of
+    `evaluate` — the two used to carry their own copies, so eval
+    instrumentation (and any accumulator fix) had to land twice.  Binned
+    AUC matches the exact statistic to < 1e-6 at the default 2^20 bins."""
+    sm = metrics_lib.StreamingMetrics()
+    lat = obs.histogram("eval_batch_seconds",
+                        "eval batch score+gather latency")
+    # nonzero-weight rows: the one definition that reads the same on every
+    # topology (the multihost branch's gathered global batches keep their
+    # zero-weight padding; the single-host branch pre-trims real rows —
+    # counting raw lengths would make the counter topology-dependent)
+    rows = obs.counter("eval_rows_total", "rows evaluated (nonzero weight)")
+    t0 = time.perf_counter()
+    for s, t, w in triples:
+        lat.observe(time.perf_counter() - t0)
+        sm.update(s, t, w)
+        rows.inc(int(np.count_nonzero(np.asarray(w))))
+        t0 = time.perf_counter()
+    return sm.weighted_error(), sm.auc()
+
+
 def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
              eval_step, mesh: Optional[Mesh] = None,
              batch_size: Optional[int] = None) -> tuple[float, float]:
@@ -288,21 +312,22 @@ def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
     # so scores are bit-identical; H2D bytes halve)
     wcast = pipe.wire_cast_fn(job.schema, job.data, job.model.compute_dtype)
     if not multihost:
-        # streaming accumulation (O(bins), not O(valid set)) — same
-        # accumulator as the multihost branch and the eval CLI; binned AUC
-        # matches the exact statistic to < 1e-6 at the default 2^20 bins
-        sm = metrics_lib.StreamingMetrics()
-        for batch in pipe.batch_iterator(ds, bs, shuffle=False,
-                                         drop_remainder=False):
-            padded, mask = pipe.pad_to_batch(batch, bs)
-            if wcast is not None:
-                padded = wcast(padded)
-            if mesh is not None:
-                padded = shard_lib.shard_batch(padded, mesh)
-            s = np.asarray(jax.device_get(eval_step(state, padded)))
-            n = int(mask.sum())
-            sm.update(s[:n, 0], batch["target"][:, 0], batch["weight"][:, 0])
-        return sm.weighted_error(), sm.auc()
+        # streaming accumulation (O(bins), not O(valid set)) through the
+        # shared _accumulate_streaming helper
+        def triples():
+            for batch in pipe.batch_iterator(ds, bs, shuffle=False,
+                                             drop_remainder=False):
+                padded, mask = pipe.pad_to_batch(batch, bs)
+                if wcast is not None:
+                    padded = wcast(padded)
+                if mesh is not None:
+                    padded = shard_lib.shard_batch(padded, mesh)
+                s = np.asarray(jax.device_get(eval_step(state, padded)))
+                n = int(mask.sum())
+                yield (s[:n, 0], batch["target"][:, 0],
+                       batch["weight"][:, 0])
+
+        return _accumulate_streaming(triples())
 
     from jax.experimental import multihost_utils
     from jax.sharding import NamedSharding, PartitionSpec
@@ -318,27 +343,28 @@ def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
     # same all-gather so the row pairing is identical on every host.
     # Accumulation is STREAMING (O(bins), not O(valid set)): at the 1B-row
     # scale a per-host concat of every epoch's gathered scores would cost
-    # O(valid-set) host memory per epoch (round-1 VERDICT weak #7); the
-    # binned Mann-Whitney statistic matches the exact AUC to < 1e-6 at the
-    # default 2^20 sigmoid-score bins
+    # O(valid-set) host memory per epoch (round-1 VERDICT weak #7).
     gather3 = jax.jit(lambda a, b, c: (a, b, c),
                       out_shardings=(replicated, replicated, replicated))
-    sm = metrics_lib.StreamingMetrics()
-    for i in range(n_steps):
-        lo = min(i * local_bs, ds.num_rows)
-        hi = min(lo + local_bs, ds.num_rows)
-        local = {"features": ds.features[lo:hi], "target": ds.target[lo:hi],
-                 "weight": ds.weight[lo:hi]}
-        local, _ = pipe.pad_to_batch(local, local_bs)  # zero-weight tail
-        if wcast is not None:
-            local = wcast(local)
-        gbatch = shard_lib.shard_batch_process_local(local, mesh)
-        s, t, w = gather3(eval_step(state, gbatch), gbatch["target"],
-                          gbatch["weight"])
-        sm.update(np.asarray(s.addressable_data(0))[:, 0],
-                  np.asarray(t.addressable_data(0))[:, 0],
-                  np.asarray(w.addressable_data(0))[:, 0])
-    return sm.weighted_error(), sm.auc()
+
+    def triples():
+        for i in range(n_steps):
+            lo = min(i * local_bs, ds.num_rows)
+            hi = min(lo + local_bs, ds.num_rows)
+            local = {"features": ds.features[lo:hi],
+                     "target": ds.target[lo:hi],
+                     "weight": ds.weight[lo:hi]}
+            local, _ = pipe.pad_to_batch(local, local_bs)  # zero-weight tail
+            if wcast is not None:
+                local = wcast(local)
+            gbatch = shard_lib.shard_batch_process_local(local, mesh)
+            s, t, w = gather3(eval_step(state, gbatch), gbatch["target"],
+                              gbatch["weight"])
+            yield (np.asarray(s.addressable_data(0))[:, 0],
+                   np.asarray(t.addressable_data(0))[:, 0],
+                   np.asarray(w.addressable_data(0))[:, 0])
+
+    return _accumulate_streaming(triples())
 
 
 def train(job: JobConfig,
@@ -362,6 +388,15 @@ def train(job: JobConfig,
     # per-block cast below only fires for in-memory datasets callers pass
     # in as f32
     multihost = jax.process_count() > 1 and mesh is not None
+    if jax.process_index() == 0:
+        # lazy env hook: a bare SHIFU_TPU_METRICS_DIR is enough for library
+        # callers (the CLI configures sinks explicitly before calling in);
+        # non-chief ranks keep their registry in memory and journal nothing
+        obs.configure_from_env()
+    obs.event("train_start", model=job.model.model_type,
+              epochs=job.train.epochs, batch_size=job.data.batch_size,
+              processes=jax.process_count(),
+              devices=len(jax.devices()) if mesh is None else mesh.size)
     wmode = pipe.wire_mode(job.schema, job.data, job.model.compute_dtype)
     # streamed-path cast: per-BLOCK compact target/weight detection
     # (content-driven, so a resume replays identical formats) on a single
@@ -464,6 +499,7 @@ def train(job: JobConfig,
                                       step=r_state.step)
                 start_epoch = int((extra or {}).get("epoch", 0))
                 console(f"Resumed from checkpoint step {step} (epoch {start_epoch})")
+                obs.event("train_resume", step=int(step), epoch=start_epoch)
                 if ((extra or {}).get("best_restored")
                         and start_epoch < job.train.epochs):
                     # the terminal checkpoint's params were rolled back to
@@ -811,7 +847,7 @@ def train(job: JobConfig,
         trace_ctx = (prof_lib.trace(profile_dir)
                      if profile_dir and epoch == start_epoch
                      else prof_lib.maybe_trace(None))
-        with trace_ctx:
+        with trace_ctx, obs.span("epoch/train", epoch=epoch):
             streamed_this_epoch = False
             if stream_loader is not None and epoch == start_epoch:
                 # streamed first epoch: train on stacked blocks as files
@@ -1015,7 +1051,9 @@ def train(job: JobConfig,
 
         tv0 = time.perf_counter()
         if epoch % job.train.eval_every_epochs == 0 or epoch == job.train.epochs - 1:
-            valid_error, valid_auc = evaluate(state, valid_ds, job, eval_step, mesh)
+            with obs.span("epoch/eval", epoch=epoch):
+                valid_error, valid_auc = evaluate(state, valid_ds, job,
+                                                  eval_step, mesh)
         else:
             valid_error, valid_auc = float("nan"), float("nan")
         valid_time = time.perf_counter() - tv0
@@ -1030,6 +1068,27 @@ def train(job: JobConfig,
         )
         history.append(m)
         console(m.console_line(job.train.epochs))
+        # per-epoch telemetry: the journal carries the structured epoch
+        # record (what the console line prints, machine-readable), the
+        # registry the step-level distributions and headline gauges
+        timer.emit()
+        obs.counter("train_epochs_total", "completed training epochs").inc()
+        obs.counter("train_batches_total",
+                    "train batches consumed (scan tiers count batches "
+                    "inside each chunk)").inc(loss_n)
+        obs.gauge("train_error", "last epoch's weighted train error").set(
+            m.train_error)
+        if valid_error == valid_error:  # evaluated this epoch, not NaN
+            obs.gauge("valid_error",
+                      "last evaluated weighted valid error").set(valid_error)
+        if valid_auc == valid_auc:
+            obs.gauge("valid_auc", "last evaluated valid AUC").set(valid_auc)
+        obs.event("epoch", **dataclasses.asdict(m))
+        # epoch-cadence flush: the scrape file must reflect a RUNNING job
+        # (`shifu-tpu metrics` / a textfile collector mid-run), and a later
+        # SIGKILL (liveness hard-kill) must not erase the whole run's
+        # metrics — one atomic small-file rewrite per epoch
+        obs.flush()
         if timing_on:
             console(timer.console_line())
         if multihost:
@@ -1113,5 +1172,9 @@ def train(job: JobConfig,
         # how the loop exits — a mid-loop exception must not abandon an
         # in-flight write of a completed epoch
         ckpt_lib.finalize(manager)
+      # journal + scrape file reflect the run however the loop exits (the
+      # CLI flushes again at run_end with the exit code)
+      obs.event("train_end", epochs_completed=len(history))
+      obs.flush()
     return TrainResult(state=state, history=history, job=job,
                        resumed_from_epoch=start_epoch)

@@ -47,6 +47,22 @@ class StepTimer:
             self.step_times.append(now - self._t)
         self._t = now
 
+    def emit(self, prefix: str = "train", **labels) -> None:
+        """Feed this epoch's per-step timings into the telemetry registry
+        (obs/metrics.py): `<prefix>_input_seconds` / `<prefix>_step_seconds`
+        histograms — the unified home the per-epoch console line used to be
+        the only view of.  Call once per epoch; an empty epoch is a no-op."""
+        from .. import obs
+
+        hin = obs.histogram(f"{prefix}_input_seconds",
+                            "host input wait per step/chunk")
+        hstep = obs.histogram(f"{prefix}_step_seconds",
+                              "device step/chunk dispatch-to-done time")
+        for v in self.input_times:
+            hin.observe(v, **labels)
+        for v in self.step_times:
+            hstep.observe(v, **labels)
+
     def summary(self) -> dict[str, float]:
         def stats(xs: list[float], prefix: str) -> dict[str, float]:
             if not xs:
@@ -94,32 +110,15 @@ def straggler_line(epoch: int, epoch_time: float, valid_time: float,
     §5.1: "per-host input-pipeline timing still matters").
 
     COLLECTIVE: every process must call this each epoch (the train loop
-    does, gated on multihost); only process 0 prints."""
-    import jax
+    does, gated on multihost); only process 0 prints.
 
-    if jax.process_count() <= 1:
-        return
-    from jax.experimental import multihost_utils
+    Implementation lives in obs/aggregate.py since the telemetry
+    unification: the same gather also journals a `host_skew` event, so the
+    table survives the run as structured data, not just a log line."""
+    from .. import obs
 
-    name = os.uname().nodename.encode()[:32].ljust(32, b"\0")
-    payload = {
-        "t": np.asarray([input_seconds, epoch_time, valid_time], np.float32),
-        "h": np.frombuffer(name, np.uint8),
-    }
-    gathered = multihost_utils.process_allgather(payload)
-    if jax.process_index() != 0:
-        return
-    rows = []
-    for r in range(gathered["t"].shape[0]):
-        ins, et, vt = (float(x) for x in gathered["t"][r])
-        host = bytes(gathered["h"][r]).rstrip(b"\0").decode(errors="replace")
-        rows.append((ins, et, vt, r, host))
-    rows.sort(key=lambda x: -x[0])  # slowest input first
-    parts = [f"{host}[{r}] input {ins:.2f}s (epoch {et:.2f}s, "
-             f"valid {vt:.2f}s)"
-             for ins, et, vt, r, host in rows]
-    console(f"Epoch {epoch} hosts by input time (slowest first): "
-            + " | ".join(parts))
+    obs.aggregate.epoch_skew(epoch, input_seconds, epoch_time, valid_time,
+                             console=console)
 
 
 @contextlib.contextmanager

@@ -39,4 +39,13 @@ def enable_persistent_cache(directory: str | None = None) -> str | None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:
         return None  # cache is an optimization, never a failure
+    try:
+        from .. import obs
+        # registry-only (sinks are usually configured later in run_train):
+        # the scrape file records whether repeat compiles could deserialize
+        obs.gauge("compile_cache_enabled",
+                  "1 when the persistent XLA compile cache is active").set(1)
+        obs.event("compile_cache", directory=path)
+    except Exception:
+        pass
     return path

@@ -1,0 +1,616 @@
+"""Unified telemetry subsystem (shifu_tpu/obs): registry semantics, journal
+round-trips (local + mock:// through fsio), span nesting, journal-follow,
+cross-host aggregation helpers, the console-board rewrite cap, and the
+tier-1 smoke test the ISSUE's acceptance criteria pin: a CPU train run with
+SHIFU_TPU_METRICS_DIR set emits a parseable JSONL journal + Prometheus
+scrape file carrying metrics from the data pipeline, train loop,
+checkpoint, and launcher subsystems — rendered by `shifu-tpu metrics`.
+"""
+
+import gzip
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tpu import obs
+from shifu_tpu.obs import metrics as obs_metrics
+from shifu_tpu.obs import render as obs_render
+
+
+@pytest.fixture(autouse=True)
+def _reset_obs():
+    obs.reset_for_tests()
+    yield
+    obs.reset_for_tests()
+
+
+@pytest.fixture
+def mock_fs():
+    """pyarrow's in-memory filesystem behind mock:// (see test_fsio.py):
+    remote journal/board/scrape paths without a live object store."""
+    from pyarrow import fs as pafs
+
+    from shifu_tpu.data import fsio
+
+    filesystem, _ = pafs.FileSystem.from_uri("mock://seed")
+    with fsio._fs_lock:
+        fsio._fs_cache[("mock", "")] = filesystem
+    filesystem.create_dir("bucket")
+    yield filesystem
+    with fsio._fs_lock:
+        fsio._fs_cache.pop(("mock", ""), None)
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_counter_gauge_histogram_semantics():
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("rows_total", "rows")
+    c.inc()
+    c.inc(4, source="parse")
+    c.inc(2, source="cache")
+    assert c.value() == 1
+    assert c.value(source="parse") == 4
+    assert c.total() == 7
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = reg.gauge("temp")
+    g.set(2.5)
+    g.inc(0.5)
+    assert g.value() == 3.0
+
+    h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    h.observe(0.005, stage="a")
+    h.observe(0.5, stage="a")
+    h.observe(50.0, stage="a")  # beyond the last bound -> +Inf bucket
+    assert h.count(stage="a") == 3
+    assert abs(h.sum(stage="a") - 50.505) < 1e-9
+
+    # same name -> same instrument; a type clash raises
+    assert reg.counter("rows_total") is c
+    with pytest.raises(ValueError):
+        reg.gauge("rows_total")
+    with pytest.raises(ValueError):
+        reg.counter("temp")
+
+
+def test_prometheus_text_format_and_parse():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("x_total", "help text").inc(3, k='va"l\nue')
+    reg.gauge("g").set(1.5)
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    text = reg.to_prometheus_text()
+    assert "# HELP x_total help text" in text
+    assert "# TYPE x_total counter" in text
+    assert 'x_total{k="va\\"l\\nue"} 3' in text
+    # histogram: cumulative buckets, +Inf == count, sum line present
+    assert 'h_seconds_bucket{le="0.1"} 1' in text
+    assert 'h_seconds_bucket{le="1"} 1' in text
+    assert 'h_seconds_bucket{le="+Inf"} 2' in text
+    assert "h_seconds_count 2" in text
+    totals = obs_render.parse_scrape_totals(text)
+    assert totals == {"x_total": 3.0, "g": 1.5, "h_seconds": 2.0}
+
+
+def test_registry_thread_safety():
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("n_total")
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.total() == 8000
+
+
+def test_scrape_file_write_local(tmp_path):
+    obs.counter("a_total").inc(2)
+    path = str(tmp_path / "tele" / "metrics.prom")
+    obs_metrics.write_scrape_file(path)
+    assert "a_total 2" in open(path).read()
+
+
+# ----------------------------------------------------------------- journal
+
+
+def test_journal_local_roundtrip_and_nan_cleaning(tmp_path):
+    p = str(tmp_path / "journal.jsonl")
+    j = obs.RunJournal(p)
+    j.event("epoch", epoch=0, valid_auc=float("nan"),
+            nested={"x": float("inf")})
+    j.event("epoch", epoch=1, valid_auc=0.75)
+    j.close()
+    recs = obs.read_journal(p)
+    assert [r["kind"] for r in recs] == ["epoch", "epoch"]
+    assert recs[0]["valid_auc"] is None           # NaN -> null, strict JSON
+    assert recs[0]["nested"]["x"] is None
+    assert recs[0]["seq"] == 1 and recs[1]["seq"] == 2
+    # a corrupt trailing line (crash mid-append) must not poison the read
+    with open(p, "a") as f:
+        f.write('{"kind": "trunc')
+    assert len(obs.read_journal(p)) == 2
+
+
+def test_journal_memory_mode_retains_records():
+    j = obs.RunJournal(None)
+    j.event("span", span="bench/staged", dur_s=1.5)
+    assert j.records[0]["span"] == "bench/staged"
+
+
+def test_journal_remote_roundtrip_mock_fsio(mock_fs):
+    """The journal's remote mode (ISSUE: 'written through data/fsio so
+    remote job dirs work like the board does'): batched whole-object
+    rewrites, flush on close, read_journal over the same URI."""
+    uri = "mock://bucket/tele/journal.jsonl"
+    j = obs.RunJournal(uri, flush_every=2)
+    j.event("run_start", model="mlp")
+    j.event("epoch", epoch=0)            # second event: batch flushes
+    recs = obs.read_journal(uri)
+    assert [r["kind"] for r in recs] == ["run_start", "epoch"]
+    j.event("epoch", epoch=1)            # pending (below flush_every)
+    j.close()                            # close flushes the tail
+    assert len(obs.read_journal(uri)) == 3
+
+
+def test_journal_remote_line_cap(mock_fs):
+    uri = "mock://bucket/tele/capped.jsonl"
+    j = obs.RunJournal(uri, flush_every=1, max_remote_lines=5)
+    for i in range(12):
+        j.event("tick", i=i)
+    j.close()
+    recs = obs.read_journal(uri)
+    marker = [r for r in recs if r["kind"] == "journal_truncated"]
+    assert marker and marker[0]["dropped"] == 7
+    ticks = [r["i"] for r in recs if r["kind"] == "tick"]
+    assert ticks == list(range(7, 12))   # newest retained, oldest dropped
+
+
+def test_tail_journal_follows_and_stops(tmp_path):
+    """tail_board-style journal follow: events written AFTER the tail
+    starts are yielded; removing the journal ends the generator."""
+    p = str(tmp_path / "journal.jsonl")
+    j = obs.RunJournal(p)
+    j.event("run_start")
+
+    got: list = []
+    done = threading.Event()
+
+    def reader():
+        for rec in obs.tail_journal(p, poll_seconds=0.05):
+            got.append(rec)
+        done.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert got and got[0]["kind"] == "run_start"
+    j.event("epoch", epoch=0)            # written after the tail began
+    deadline = time.monotonic() + 10
+    while len(got) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert got[1]["kind"] == "epoch"
+    j.close()
+    os.remove(p)
+    assert done.wait(10), "tail did not stop when the journal was removed"
+
+
+def test_tail_journal_remote(mock_fs):
+    uri = "mock://bucket/tele/followed.jsonl"
+    j = obs.RunJournal(uri, flush_every=1)
+    j.event("run_start")
+
+    got: list = []
+    done = threading.Event()
+
+    def reader():
+        for rec in obs.tail_journal(uri, poll_seconds=0.05):
+            got.append(rec)
+        done.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert got and got[0]["kind"] == "run_start"
+    j.event("epoch", epoch=0)
+    deadline = time.monotonic() + 10
+    while len(got) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert [r["kind"] for r in got[:2]] == ["run_start", "epoch"]
+    mock_fs.delete_file("bucket/tele/followed.jsonl")
+    assert done.wait(10)
+
+
+def test_journal_remote_reopen_preserves_history_and_seq(mock_fs):
+    """A restarted attempt reopening a remote journal must keep the prior
+    attempt's events (remote flushes rewrite the whole object from this
+    writer's lines) and continue seq monotonically, so seq-tracking tails
+    don't discard the new attempt (review finding)."""
+    uri = "mock://bucket/tele/reopen.jsonl"
+    j1 = obs.RunJournal(uri, flush_every=1)
+    j1.event("train_start")
+    j1.event("epoch", epoch=0)
+    j1.close()
+    j2 = obs.RunJournal(uri, flush_every=1)  # attempt 2, fresh process
+    j2.event("train_resume", epoch=1)
+    j2.close()
+    recs = obs.read_journal(uri)
+    assert [r["kind"] for r in recs] == ["train_start", "epoch",
+                                        "train_resume"]
+    seqs = [r["seq"] for r in recs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 3
+
+
+def test_tail_journal_remote_survives_line_cap(mock_fs):
+    """Once the retained-line cap engages, the object's line count
+    plateaus — the tail must keep yielding (it tracks `seq`, not line
+    index) instead of stalling forever (review finding)."""
+    uri = "mock://bucket/tele/capped-follow.jsonl"
+    j = obs.RunJournal(uri, flush_every=1, max_remote_lines=4)
+    for i in range(3):
+        j.event("tick", i=i)
+
+    got: list = []
+    done = threading.Event()
+
+    def reader():
+        for rec in obs.tail_journal(uri, poll_seconds=0.05):
+            got.append(rec)
+        done.set()
+
+    threading.Thread(target=reader, daemon=True).start()
+    deadline = time.monotonic() + 10
+    while len(got) < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    for i in range(3, 10):  # drives the journal well past the cap
+        j.event("tick", i=i)
+        time.sleep(0.1)  # cap retains 4 lines: poll cadence keeps up
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        ticks = [r["i"] for r in got if r.get("kind") == "tick"]
+        if ticks and ticks[-1] == 9:
+            break
+        time.sleep(0.05)
+    ticks = [r["i"] for r in got if r.get("kind") == "tick"]
+    assert ticks == list(range(10)), ticks  # nothing stalled, none skipped
+    j.close()
+    mock_fs.delete_file("bucket/tele/capped-follow.jsonl")
+    assert done.wait(10)
+
+
+def test_tail_board_remote_survives_line_cap(mock_fs):
+    """Board tail past the cap: the truncation marker shifts/drops lines,
+    so the tail tracks ABSOLUTE line position (review finding)."""
+    from shifu_tpu.launcher.console import ConsoleBoard, tail_board
+
+    uri = "mock://bucket/job/capped-tail.board"
+    board = ConsoleBoard(uri, echo=False, max_remote_lines=3,
+                         flush_seconds=0.0)
+    board("line 0")
+
+    got: list = []
+    done = threading.Event()
+
+    def reader():
+        for line in tail_board(uri, poll_seconds=0.05):
+            got.append(line)
+        done.set()
+
+    threading.Thread(target=reader, daemon=True).start()
+    deadline = time.monotonic() + 10
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.05)
+    for i in range(1, 8):  # cap=3: truncation engages at line 3
+        board(f"line {i}")
+        time.sleep(0.1)  # cap retains 3 lines: poll cadence keeps up
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if any(l.endswith("line 7") for l in got):
+            break
+        time.sleep(0.05)
+    tail_lines = [l.rsplit(" ", 2)[-2:] for l in got
+                  if "line" in l and "dropped" not in l]
+    assert [t[1] for t in tail_lines] == [str(i) for i in range(8)], got
+    board.close()
+    mock_fs.delete_file("bucket/job/capped-tail.board")
+    assert done.wait(10)
+
+
+def test_render_merges_supervisor_sidecar_journal(tmp_path):
+    """A remote supervised run keeps the parent's events in a sidecar
+    object (two writers on one remote object would erase each other);
+    summarize merges both into one ts-ordered timeline."""
+    d = tmp_path
+    j = obs.RunJournal(str(d / "journal.jsonl"))
+    j.event("train_start")
+    j.event("epoch", epoch=0)
+    j.close()
+    s = obs.RunJournal(str(d / "journal-supervisor.jsonl"))
+    s.event("supervisor_start")
+    s.event("supervisor_restart", attempt=1)
+    s.close()
+    summary = obs_render.summarize(str(d))
+    assert summary["events"] == 4
+    assert summary["event_kinds"] == {"epoch": 1, "supervisor_restart": 1,
+                                      "supervisor_start": 1,
+                                      "train_start": 1}
+
+
+# ------------------------------------------------------------------- spans
+
+
+def test_span_nesting_paths_and_journal(tmp_path):
+    obs.configure(str(tmp_path))
+    seen = {}
+    with obs.span("epoch"):
+        with obs.span("eval"):
+            seen["inner"] = obs.current_path()
+        seen["outer"] = obs.current_path()
+    assert seen == {"inner": "epoch/eval", "outer": "epoch"}
+    obs.flush()
+    recs = obs.read_journal(str(tmp_path / "journal.jsonl"))
+    spans = [r["span"] for r in recs if r["kind"] == "span"]
+    assert spans == ["epoch/eval", "epoch"]  # inner closes first
+    h = obs.histogram("span_seconds")
+    assert h.count(span="epoch/eval") == 1
+    assert h.count(span="epoch") == 1
+
+
+def test_span_nesting_is_thread_local():
+    paths = {}
+
+    def worker():
+        with obs.span("producer"):
+            paths["thread"] = obs.current_path()
+
+    with obs.span("epoch"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        paths["main"] = obs.current_path()
+    assert paths == {"thread": "producer", "main": "epoch"}
+
+
+def test_event_noop_without_journal():
+    assert obs.event("orphan", x=1) is None  # never raises, never writes
+
+
+# ----------------------------------------------------- cross-host aggregate
+
+
+def test_gather_host_summaries_single_process():
+    from shifu_tpu.obs import aggregate
+
+    rows = aggregate.gather_host_summaries({"host": "h0", "input_s": 1.25})
+    assert rows == [{"host": "h0", "input_s": 1.25}]
+
+
+def test_skew_line_sorts_slowest_first():
+    from shifu_tpu.obs import aggregate
+
+    rows = [
+        {"host": "fast", "rank": 0, "input_s": 0.5, "epoch_s": 3.0,
+         "valid_s": 0.1},
+        {"host": "slow", "rank": 1, "input_s": 2.5, "epoch_s": 3.1,
+         "valid_s": 0.2},
+    ]
+    line = aggregate.skew_line(4, rows)
+    assert line.startswith("Epoch 4 hosts by input time (slowest first): ")
+    assert line.index("slow[1]") < line.index("fast[0]")
+    assert "input 2.50s" in line and "(epoch 3.10s, valid 0.20s)" in line
+
+
+# ------------------------------------------------------------ StepTimer
+
+
+def test_step_timer_empty_epoch_stays_well_defined():
+    """Regression (ISSUE satellite): an epoch that produced no steps must
+    keep summary()/console_line()/emit() total no-ops, not KeyError/NaN."""
+    from shifu_tpu.train.profiler import StepTimer
+
+    t = StepTimer()
+    assert t.summary() == {}
+    assert t.console_line() == "timing: no steps"
+    t.emit()  # no observations -> no series created
+    assert obs.histogram("train_input_seconds").count() == 0
+
+    t.start()  # started but no marks: still empty
+    assert t.summary() == {}
+
+
+def test_step_timer_emit_feeds_registry():
+    from shifu_tpu.train.profiler import StepTimer
+
+    t = StepTimer()
+    t.start()
+    for _ in range(3):
+        t.mark_input_ready()
+        t.mark_step_done()
+    t.emit()
+    assert obs.histogram("train_input_seconds").count() == 3
+    assert obs.histogram("train_step_seconds").count() == 3
+
+
+# ------------------------------------------------- console board rewrite cap
+
+
+def test_remote_board_line_cap_and_batching(mock_fs, tmp_path, capsys):
+    from shifu_tpu.data import fsio
+    from shifu_tpu.launcher.console import ConsoleBoard
+
+    obs.configure(str(tmp_path / "tele"))  # capture the truncation warning
+    board = ConsoleBoard("mock://bucket/job/console.board", echo=False,
+                         max_remote_lines=3, flush_seconds=0.0)
+    for i in range(7):
+        board(f"Epoch {i}: x")
+    board.close()
+    text = fsio.read_bytes("mock://bucket/job/console.board").decode()
+    lines = text.splitlines()
+    assert "4 earlier lines dropped" in lines[0]
+    assert [l.rsplit(" ", 2)[1] for l in lines[1:]] == ["4:", "5:", "6:"]
+    obs.flush()
+    recs = obs.read_journal(str(tmp_path / "tele" / "journal.jsonl"))
+    trunc = [r for r in recs if r["kind"] == "board_truncated"]
+    assert trunc and trunc[0]["line_cap"] == 3
+    assert "board line cap" in capsys.readouterr().err
+
+
+def test_remote_board_batches_flushes(mock_fs):
+    """Lines inside the flush window batch into one deferred rewrite
+    instead of one PUT per line; the timer publishes them."""
+    from shifu_tpu.data import fsio
+    from shifu_tpu.launcher.console import ConsoleBoard
+
+    puts = {"n": 0}
+    orig = fsio.write_bytes
+
+    def counting_write(path, data):
+        puts["n"] += 1
+        orig(path, data)
+
+    board = ConsoleBoard("mock://bucket/job/batched.board", echo=False,
+                         flush_seconds=0.15)
+    fsio.write_bytes = counting_write  # _write_remote resolves at call time
+    try:
+        for i in range(5):
+            board(f"line {i}")  # first flushes now; the rest batch
+        assert puts["n"] == 1
+        deadline = time.monotonic() + 5
+        while puts["n"] < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert puts["n"] == 2  # ONE deferred write carried lines 1-4
+    finally:
+        fsio.write_bytes = orig
+        board.close()
+    content = fsio.read_bytes("mock://bucket/job/batched.board").decode()
+    assert content.splitlines()[-1].endswith("line 4")
+
+
+# --------------------------------------------------------- render + CLI
+
+
+def _write_job_files(tmp_path, epochs=1):
+    from shifu_tpu.data import synthetic
+
+    mc = {"dataSet": {"targetColumnName": "target"},
+          "train": {"validSetRate": 0.2, "numTrainEpochs": epochs,
+                    "algorithm": "NN",
+                    "params": {"NumHiddenLayers": 1, "NumHiddenNodes": [8],
+                               "ActivationFunc": ["relu"],
+                               "LearningRate": 0.01, "Optimizer": "adam"}}}
+    cols = [{"columnNum": 0, "columnName": "target", "columnFlag": "Target"}]
+    cols += [{"columnNum": i, "columnName": f"f{i}", "columnType": "N",
+              "finalSelect": True} for i in range(1, 9)]
+    (tmp_path / "ModelConfig.json").write_text(json.dumps(mc))
+    (tmp_path / "ColumnConfig.json").write_text(json.dumps(cols))
+    schema = synthetic.make_schema(num_features=8)
+    rows = synthetic.make_rows(600, schema, seed=6, noise=0.3)
+    synthetic.write_files(rows, str(tmp_path / "data"), num_files=2)
+
+
+def test_train_smoke_emits_journal_and_scrape(tmp_path, monkeypatch, capsys):
+    """The acceptance criterion, end to end on CPU: train with
+    SHIFU_TPU_METRICS_DIR set -> parseable JSONL journal + Prometheus text
+    file carrying metrics from >= 4 subsystems (data pipeline, train loop,
+    checkpoint, launcher), and `shifu-tpu metrics <jobdir>` renders them."""
+    from shifu_tpu.launcher import cli
+
+    _write_job_files(tmp_path)
+    out = str(tmp_path / "job")
+    tele = os.path.join(out, "telemetry")
+    monkeypatch.setenv("SHIFU_TPU_METRICS_DIR", tele)
+    rc = cli.main(["train",
+                   "--modelconfig", str(tmp_path / "ModelConfig.json"),
+                   "--columnconfig", str(tmp_path / "ColumnConfig.json"),
+                   "--data", str(tmp_path / "data"),
+                   "--output", out])
+    assert rc == 0
+
+    # journal: strict JSONL, the run's whole story in order
+    recs = obs.read_journal(os.path.join(tele, "journal.jsonl"))
+    kinds = [r["kind"] for r in recs]
+    for expected in ("run_start", "train_start", "epoch", "checkpoint_save",
+                     "span", "export", "train_end", "run_end"):
+        assert expected in kinds, (expected, kinds)
+    epoch_rec = next(r for r in recs if r["kind"] == "epoch")
+    assert {"epoch", "train_error", "valid_error", "valid_auc",
+            "epoch_time"} <= set(epoch_rec)
+    assert recs[-1]["kind"] == "run_end" and recs[-1]["exit"] == 0
+
+    # scrape file: metrics from at least four subsystems
+    prom = open(os.path.join(tele, "metrics.prom")).read()
+    totals = obs_render.parse_scrape_totals(prom)
+    assert totals["data_rows_read_total"] == 600          # data pipeline
+    assert totals["data_files_read_total"] == 2
+    assert totals["train_epochs_total"] == 1              # train loop
+    assert totals["train_batches_total"] > 0
+    assert totals["checkpoint_saves_total"] >= 1          # checkpoint
+    assert totals["launcher_runs_total"] == 1             # launcher
+    assert totals["eval_rows_total"] > 0
+    assert "span_seconds" in totals
+
+    # `shifu-tpu metrics <jobdir>` renders both (journal found via the
+    # job dir's telemetry/ subdir)
+    capsys.readouterr()
+    assert cli.main(["metrics", out]) == 0
+    rendered = capsys.readouterr().out
+    assert "journal:" in rendered
+    assert "epoch" in rendered and "valid_err" in rendered
+    assert "data_rows_read_total" in rendered
+
+    # --json mode round-trips
+    assert cli.main(["metrics", out, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["events"] == len(recs)
+    assert doc["epochs"][0]["epoch"] == 0
+    assert "epoch/train" in doc["span_totals_s"]
+    assert "epoch/eval" in doc["span_totals_s"]
+
+    # extended `status`: the telemetry summary rides the state dict
+    # (bounded probe: line count + last event only, no full decode)
+    assert cli.main(["status", out]) == 1  # not a detached job -> UNKNOWN
+    st = json.loads(capsys.readouterr().out)
+    assert st["telemetry"]["events"] == len(recs)
+    assert st["telemetry"]["last_event"] == "run_end"
+
+
+def test_metrics_cli_missing_dir(tmp_path, capsys):
+    from shifu_tpu.launcher import cli
+
+    assert cli.main(["metrics", str(tmp_path / "nope")]) == 1
+    assert "no telemetry journal" in capsys.readouterr().err
+
+
+def test_library_train_configures_from_env(tmp_path, monkeypatch,
+                                           small_job, small_data):
+    """A bare train() call (no CLI) with SHIFU_TPU_METRICS_DIR set journals
+    the run — the env var alone is the opt-in for library users."""
+    from shifu_tpu.train import train
+
+    tele = str(tmp_path / "tele")
+    monkeypatch.setenv("SHIFU_TPU_METRICS_DIR", tele)
+    train_ds, valid_ds = small_data
+    job = small_job.replace(train=small_job.train.__class__(epochs=1))
+    train(job, train_ds, valid_ds, console=lambda s: None)
+    recs = obs.read_journal(os.path.join(tele, "journal.jsonl"))
+    kinds = [r["kind"] for r in recs]
+    assert "train_start" in kinds and "epoch" in kinds \
+        and "train_end" in kinds
+    prom = open(os.path.join(tele, "metrics.prom")).read()
+    assert "train_epochs_total 1" in prom

@@ -8,6 +8,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -436,6 +437,18 @@ def test_kill_guard_covers_stale_jobjson_branch(fake_gcloud, tmp_path):
         [sys.executable, "-c", "import shifu_tpu, time; time.sleep(600)"],
         env={**os.environ, "PYTHONPATH":
              REPO + os.pathsep + os.environ.get("PYTHONPATH", "")})
+    # wait for exec to land: _is_our_job reads /proc/<pid>/cmdline, and on
+    # a loaded machine the guard could otherwise race the fork->exec window
+    # and misread the live dispatcher as not-ours (observed flake)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            with open(f"/proc/{live.pid}/cmdline", "rb") as f:
+                if b"shifu_tpu" in f.read():
+                    break
+        except OSError:
+            pass
+        time.sleep(0.05)
     try:
         spec = prov.ProvisionSpec(name="mixed-slice",
                                   accelerator_type="v5litepod-8",
