@@ -112,7 +112,8 @@ def open_input_file(path: str):
     random access, unlike the streaming read_bytes path)."""
     filesystem, fs_path = _filesystem(path)
     return _retry_transient(lambda: filesystem.open_input_file(fs_path),
-                            _classifier(filesystem, fs_path, path))
+                            _classifier(filesystem, fs_path, path),
+                            op_name="open_input_file")
 
 
 def _retry_attempts() -> int:
@@ -137,31 +138,74 @@ _TERMINAL_MARKERS = ("permission denied", "access denied", "accessdenied",
                      "kerberos", "credential", "token expired")
 
 
-def _retry_transient(op, classify=None):
-    """Run `op()` retrying transient remote errors with bounded backoff.
+def _count_terminal(op_name: str, reason: str) -> None:
+    """fsio_terminal_total: remote failures that gave up (no more retries) —
+    best-effort telemetry, never allowed to mask the real error."""
+    try:
+        from .. import obs
+        obs.counter("fsio_terminal_total",
+                    "remote fs failures not retried / exhausted").inc(
+            op=op_name or "op", reason=reason)
+    except Exception:
+        pass
+
+
+# retry backoff bounds: decorrelated jitter between _RETRY_BASE_S and 3x the
+# previous sleep, capped — a gang of hosts hitting the same flaky namenode
+# must NOT re-arrive in lockstep (synchronized exponential backoff turns one
+# hiccup into N coordinated thundering herds, re-triggering the overload)
+_RETRY_BASE_S = 0.1
+_RETRY_CAP_S = 5.0
+
+
+def _retry_transient(op, classify=None, op_name: str = ""):
+    """Run `op()` retrying transient remote errors with decorrelated-jitter
+    backoff (sleep ~ U[base, 3*prev], capped — AWS architecture blog's
+    "decorrelated jitter": retries desynchronize across a gang instead of
+    hammering the endpoint in waves).
 
     `classify(exc)` may raise a terminal error (FileNotFoundError /
     IsADirectoryError) instead of letting the retry proceed; auth-shaped
     errors (see _TERMINAL_MARKERS) never retry.  Every remote operation —
     read, streaming count, listing, parquet open — goes through here, so a
-    transient namenode/datanode hiccup can't kill job startup."""
+    transient namenode/datanode hiccup can't kill job startup.  Retries and
+    terminal failures export as `fsio_retry_total` / `fsio_terminal_total`
+    (labels: op, and reason for terminal ones)."""
+    import random
     import time
 
     attempts = _retry_attempts()
+    sleep_s = _RETRY_BASE_S
     for attempt in range(attempts):
         try:
             return op()
         except (FileNotFoundError, IsADirectoryError):
+            _count_terminal(op_name, "not_found")
             raise
         except Exception as e:
             if classify is not None:
-                classify(e)  # may raise the terminal classification
+                try:
+                    classify(e)  # may raise the terminal classification
+                except (FileNotFoundError, IsADirectoryError):
+                    _count_terminal(op_name, "not_found")
+                    raise
             msg = str(e).lower()
             if any(m in msg for m in _TERMINAL_MARKERS):
+                _count_terminal(op_name, "auth")
                 raise
             if attempt == attempts - 1:
+                _count_terminal(op_name, "exhausted")
                 raise
-            time.sleep(0.1 * (2 ** attempt))
+            try:
+                from .. import obs
+                obs.counter("fsio_retry_total",
+                            "remote fs transient-error retries").inc(
+                    op=op_name or "op")
+            except Exception:
+                pass
+            sleep_s = min(_RETRY_CAP_S,
+                          random.uniform(_RETRY_BASE_S, sleep_s * 3))
+            time.sleep(sleep_s)
     raise AssertionError("unreachable")
 
 
@@ -199,6 +243,8 @@ def write_bytes(path: str, data: bytes) -> None:
     filesystem, fs_path = _filesystem(path)
 
     def op() -> None:
+        from .. import chaos
+        chaos.maybe_fail("fsio.write_bytes", path=path)
         parent = fs_path.rsplit("/", 1)[0]
         if parent and parent != fs_path:
             try:
@@ -208,7 +254,8 @@ def write_bytes(path: str, data: bytes) -> None:
         with filesystem.open_output_stream(fs_path) as f:
             f.write(data)
 
-    _retry_transient(op, _classifier(filesystem, fs_path, path))
+    _retry_transient(op, _classifier(filesystem, fs_path, path),
+                     op_name="write_bytes")
 
 
 def upload_dir(local_dir: str, remote_dir: str,
@@ -242,7 +289,8 @@ def upload_dir(local_dir: str, remote_dir: str,
                             break
                         dst.write(chunk)
 
-            _retry_transient(op, _classifier(filesystem, fs_path, target))
+            _retry_transient(op, _classifier(filesystem, fs_path, target),
+                             op_name="upload_dir")
             out.append(target)
     return out
 
@@ -254,10 +302,13 @@ def read_bytes(path: str) -> bytes:
     filesystem, fs_path = _filesystem(path)  # guards the pyarrow import
 
     def op() -> bytes:
+        from .. import chaos
+        chaos.maybe_fail("fsio.read_bytes", path=path)
         with filesystem.open_input_stream(fs_path) as stream:
             return stream.read()
 
-    return _retry_transient(op, _classifier(filesystem, fs_path, path))
+    return _retry_transient(op, _classifier(filesystem, fs_path, path),
+                            op_name="read_bytes")
 
 
 def count_data_lines(path: str, chunk_bytes: int = 1 << 20) -> int:
@@ -316,7 +367,56 @@ def count_data_lines(path: str, chunk_bytes: int = 1 << 20) -> int:
             count += 1  # final unterminated line
         return count
 
-    return _retry_transient(op, _classifier(filesystem, fs_path, path))
+    return _retry_transient(op, _classifier(filesystem, fs_path, path),
+                            op_name="count_data_lines")
+
+
+def walk_files(root: str) -> list[tuple[str, int]]:
+    """Every FILE under `root`, recursively, as (path-or-URI, size) sorted
+    by path — ONE definition of the local-os.walk / remote-FileSelector
+    walk (and of the URI scheme/authority rebuild) shared by checkpoint
+    manifests, retention sizing, and the chaos corrupt action.  A file
+    `root` yields itself; a missing root yields []."""
+    if not is_remote(root):
+        if os.path.isfile(root):
+            try:
+                return [(root, os.path.getsize(root))]
+            except OSError:
+                return []
+        out = []
+        for dirpath, _dirs, names in os.walk(root):
+            for name in names:
+                full = os.path.join(dirpath, name)
+                try:
+                    out.append((full, os.path.getsize(full)))
+                except OSError:
+                    continue
+        return sorted(out)
+    from pyarrow import fs as pafs
+    filesystem, fs_path = _filesystem(root)
+    base = fs_path.rstrip("/")
+    scheme, rest = root.split("://", 1)
+    # hdfs-style paths start with "/" and need the authority restored;
+    # bucket-style keep the bucket as the first path segment (same rebuild
+    # as list_files)
+    authority = rest.split("/", 1)[0] if fs_path.startswith("/") else ""
+
+    def rebuild(p: str) -> str:
+        return (f"{scheme}://{authority}{p}" if p.startswith("/")
+                else f"{scheme}://{p}")
+
+    info = _retry_transient(lambda: filesystem.get_file_info(base),
+                            op_name="walk_files")
+    if info.type == pafs.FileType.File:
+        return [(root, int(info.size or 0))]
+    if info.type == pafs.FileType.NotFound:
+        return []
+    infos = _retry_transient(
+        lambda: filesystem.get_file_info(
+            pafs.FileSelector(base, recursive=True, allow_not_found=True)),
+        op_name="walk_files")
+    return sorted((rebuild(i.path), int(i.size or 0)) for i in infos
+                  if i.type == pafs.FileType.File)
 
 
 def list_files(root: str) -> list[str]:
@@ -327,7 +427,13 @@ def list_files(root: str) -> list[str]:
     through pyarrow."""
     filesystem, fs_path = _filesystem(root)  # guards the pyarrow import
     from pyarrow import fs as pafs
-    info = _retry_transient(lambda: filesystem.get_file_info(fs_path))
+    from .. import chaos
+
+    def stat_op():
+        chaos.maybe_fail("fsio.list_files", path=root)
+        return filesystem.get_file_info(fs_path)
+
+    info = _retry_transient(stat_op, op_name="list_files")
     if info.type == pafs.FileType.NotFound:
         raise FileNotFoundError(f"no such data path: {root}")
     scheme, rest = root.split("://", 1)
@@ -348,7 +454,8 @@ def list_files(root: str) -> list[str]:
         return [root]
     selector = pafs.FileSelector(fs_path, recursive=False)
     out = []
-    children = _retry_transient(lambda: filesystem.get_file_info(selector))
+    children = _retry_transient(lambda: filesystem.get_file_info(selector),
+                                op_name="list_files")
     for child in sorted(children, key=lambda i: i.path):
         if child.type != pafs.FileType.File:
             continue

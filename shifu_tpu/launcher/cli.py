@@ -75,6 +75,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "a detached session-leader dispatcher that survives "
                         "this client (status/attach/kill drive it from the "
                         "job dir afterwards)")
+    t.add_argument("--chaos-plan", default=None,
+                   help="declarative fault-injection plan: inline JSON or a "
+                        "path to a JSON file (schema in shifu_tpu/chaos/"
+                        "plan.py, site catalog in docs/ROBUSTNESS.md); "
+                        "exported to children as SHIFU_TPU_CHAOS_PLAN so a "
+                        "supervised/pod job injects the same plan on every "
+                        "attempt")
     t.add_argument("--provision", action="store_true",
                    help="acquire a TPU slice first (shifu.provision.* keys "
                         "/ --provision-* flags), dispatch the pod onto its "
@@ -113,6 +120,18 @@ def build_parser() -> argparse.ArgumentParser:
     mt.add_argument("--follow", action="store_true",
                     help="stream journal events as JSONL until ^C "
                          "(tail_board for the structured stream)")
+    cv = sub.add_parser(
+        "chaos-verify", help="audit a finished chaos drill: replay the "
+                             "recorded plan against the run journal and "
+                             "report injected-vs-recovered counts "
+                             "(docs/ROBUSTNESS.md)")
+    cv.add_argument("job_dir", help="job dir (or telemetry dir / journal "
+                                    "path) of the finished run")
+    cv.add_argument("--plan", default=None,
+                    help="chaos plan to check against (inline JSON or "
+                         "path); default: <job_dir>/chaos_plan.json")
+    cv.add_argument("--json", action="store_true",
+                    help="machine-readable report dict instead of text")
     at = sub.add_parser("attach", help="follow a detached job's console "
                                        "board until it ends (TailThread "
                                        "parity); exits with the job's code")
@@ -388,11 +407,73 @@ def _spawn_processes(args, out_dir: str) -> int:
     return rc
 
 
+def _activate_chaos(args) -> int:
+    """Export `--chaos-plan` into the environment (children inherit it on
+    every restart), validate it NOW (a typo'd plan must fail the launch,
+    not silently never inject), pin the job-scoped trigger state file into
+    the job dir, and persist the resolved plan beside the job so
+    `chaos-verify` can replay it.  Returns nonzero on a bad plan."""
+    from .. import chaos
+
+    plan_arg = getattr(args, "chaos_plan", None)
+    try:
+        if plan_arg:
+            # export the resolved plan CONTENT, never a path: ssh-dispatched
+            # pod ranks inherit the env on other machines where a local
+            # plan file does not exist (and the detach daemon may run from
+            # another cwd) — inline JSON works everywhere
+            base = chaos.load_plan(plan_arg.strip())
+            os.environ[chaos.ENV_CHAOS_PLAN] = base.to_json(indent=None)
+        plan = chaos.reload_from_env()
+    except chaos.ChaosPlanError as e:
+        print(f"chaos plan: {e}", file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    if plan is None or not plan.faults:
+        return EXIT_OK
+    if chaos.ENV_CHAOS_STATE not in os.environ:
+        out_dir = _resolve_out_dir(args)
+        args.output = out_dir  # pin: a re-resolve could timestamp anew
+        from ..data import fsio
+        if not fsio.is_remote(out_dir):
+            os.makedirs(out_dir, exist_ok=True)
+            os.environ[chaos.ENV_CHAOS_STATE] = os.path.join(
+                out_dir, "chaos_state.json")
+            try:  # the audit trail chaos-verify replays
+                with open(os.path.join(out_dir, "chaos_plan.json"),
+                          "w") as f:
+                    f.write(plan.to_json())
+            except OSError:
+                pass
+        else:
+            try:  # remote job dir: the audit trail still persists via fsio
+                fsio.write_bytes(fsio.join(out_dir, "chaos_plan.json"),
+                                 plan.to_json().encode())
+            except Exception:
+                pass
+            if any(f.scope == "job" for f in plan.faults):
+                # no local state file to pin -> job-scoped counters degrade
+                # to per-process and would re-fire each restart; say so
+                # LOUDLY instead of silently changing the drill's semantics
+                print("chaos: job dir is remote and SHIFU_TPU_CHAOS_STATE "
+                      "is unset — scope=\"job\" triggers degrade to "
+                      "per-process counters (set SHIFU_TPU_CHAOS_STATE to "
+                      "a local path to keep job-wide counting)",
+                      file=sys.stderr, flush=True)
+    return EXIT_OK
+
+
 def run_train(args) -> int:
     # Order matters: the supervisor parent must NOT join the distributed
     # rendezvous (its child re-registers the same process id), and a
     # supervised multi-process job restarts as a whole gang — supervisor
     # wraps the spawner, spawner wraps the worker processes.
+
+    # chaos plane first: the plan env must be exported before ANY child
+    # (detach daemon, supervisor attempt, pod rank) is spawned, and a
+    # malformed plan must fail here, at submit time
+    rc_chaos = _activate_chaos(args)
+    if rc_chaos != EXIT_OK:
+        return rc_chaos
 
     # --detach: re-launch this dispatcher as a session-leader daemon and
     # return (YARN parity: the job outlives the submitting client,
@@ -545,17 +626,17 @@ def run_train(args) -> int:
     if getattr(args, "num_processes", 0) > 1:
         return _spawn_processes(args, _resolve_out_dir(args))
 
-    # permanent-host-loss injection (elastic reshape tests): the rank whose
-    # gang process id matches dies at startup on EVERY attempt — unlike
-    # SHIFU_TPU_FAULT_EPOCH's one-shot crash, this models a host that never
-    # comes back, which the pod supervisor must eventually drop and
-    # reshape around.  Checked BEFORE the rendezvous so the dead host
-    # never joins (its peers are torn down by the gang dispatcher).
-    down = os.environ.get("SHIFU_TPU_FAULT_HOST_DOWN")
-    if down is not None and os.environ.get(
-            "SHIFU_TPU_PROCESS_ID", "0") == down:
-        print(f"FAULT INJECTION: host (rank {down}) is permanently down",
-              flush=True)
+    # chaos site "launcher.start": process startup, BEFORE the rendezvous —
+    # a fault here models a host that never joins (the dead rank's peers
+    # are torn down by the gang dispatcher; a permanently-down rank drives
+    # the pod supervisor's elastic reshape).  The legacy
+    # SHIFU_TPU_FAULT_HOST_DOWN env hook synthesizes exactly this fault
+    # (chaos/plan.py plan_from_legacy_env).
+    from .. import chaos as chaos_lib
+    try:
+        chaos_lib.maybe_fail("launcher.start")
+    except chaos_lib.ChaosError as e:
+        print(f"chaos: {e}", file=sys.stderr, flush=True)
         return EXIT_FAIL
 
     # multi-host rendezvous (no-op without the env contract / pod runtime);
@@ -765,39 +846,22 @@ def _write_metrics_jsonl(result, path: str) -> None:
 
 
 def _maybe_inject_fault(metrics, board) -> None:
-    """Deliberate fault injection for resilience tests — the always-on version
-    of the reference's commented-out PS-killer (yarn/util/CommonUtils.java:
-    265-274).  SHIFU_TPU_FAULT_EPOCH=k hard-kills the process after epoch k."""
-    # SHIFU_TPU_FAULT_PROCESS=i limits the injection to one rank of a gang
-    # (exercising single-host-failure -> whole-gang teardown + restart)
-    fault_proc = os.environ.get("SHIFU_TPU_FAULT_PROCESS")
-    if fault_proc is not None and os.environ.get(
-            "SHIFU_TPU_PROCESS_ID", "0") != fault_proc:
-        return
-    fault_epoch = os.environ.get("SHIFU_TPU_FAULT_EPOCH")
-    if fault_epoch is not None and metrics.epoch == int(fault_epoch):
+    """Chaos site "train.epoch": the post-epoch boundary (after the epoch's
+    conditional checkpoint save) — the successor of the reference's
+    commented-out PS-killer (yarn/util/CommonUtils.java:265-274).  The
+    legacy SHIFU_TPU_FAULT_EPOCH / _FAULT_EVERY_EPOCH / _FAULT_PROCESS /
+    SHIFU_TPU_HANG_EPOCH env hooks still work: chaos/plan.py synthesizes
+    equivalent plan faults from them (crash-after-epoch-k, die-after-every-
+    epoch-below-n, rank-limited injection, hang-for-liveness-detection)."""
+    from .. import chaos
+
+    def echo(msg: str) -> None:
         # print as well: a non-chief rank's board is silent, but its stdout
         # is captured into the per-host log by the pod launcher
-        print(f"FAULT INJECTION: killing process after epoch {metrics.epoch}",
-              flush=True)
-        board(f"FAULT INJECTION: killing process after epoch {metrics.epoch}")
-        os._exit(17)
-    # repeated-preemption injection: die after EVERY epoch below the bound,
-    # so each attempt advances exactly one epoch then fails — exercises the
-    # progress-resets-restart-budget semantics of the supervisors
-    fault_every = os.environ.get("SHIFU_TPU_FAULT_EVERY_EPOCH")
-    if fault_every is not None and metrics.epoch < int(fault_every):
-        print(f"FAULT INJECTION: killing process after epoch {metrics.epoch}",
-              flush=True)
-        board(f"FAULT INJECTION: killing process after epoch {metrics.epoch}")
-        os._exit(17)
-    # hang (vs crash) injection: stall forever after epoch k so the
-    # supervisor's board-progress liveness monitor has something to detect
-    hang_epoch = os.environ.get("SHIFU_TPU_HANG_EPOCH")
-    if hang_epoch is not None and metrics.epoch == int(hang_epoch):
-        board(f"HANG INJECTION: stalling after epoch {metrics.epoch}")
-        while True:
-            time.sleep(3600)
+        print(msg, flush=True)
+        board(msg)
+
+    chaos.maybe_fail("train.epoch", echo=echo, epoch=metrics.epoch)
 
 
 def _load_scorer(model_dir: str, native: bool, engine: str = "auto"):
@@ -889,6 +953,102 @@ def run_metrics(args) -> int:
     print(json.dumps(summary) if args.json
           else obs_render.render_text(summary))
     return EXIT_OK
+
+
+def run_chaos_verify(args) -> int:
+    """`shifu-tpu chaos-verify <job_dir>`: audit a finished chaos drill.
+
+    Replays the recorded plan (default: the `chaos_plan.json` the launcher
+    persisted beside the job) against the run journal: which sites actually
+    injected, how often, and what the recovery machinery did about it
+    (restarts, checkpoint fallbacks, preemption-grace saves, resumes).
+    Exit 0 = the run completed (a `run_end exit=0` / `supervisor_done` is
+    present) AND every planned fault site injected at least once — i.e. the
+    drill both FIRED and was SURVIVED; anything else is exit 1."""
+    from .. import chaos
+    from ..data import fsio
+    from ..obs import journal as journal_mod
+    from ..obs import render as obs_render
+
+    jpath = obs_render.find_journal(args.job_dir)
+    if jpath is None:
+        print(f"no telemetry journal found under {args.job_dir}",
+              file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    events = journal_mod.read_journal(jpath)
+
+    plan = None
+    plan_src = getattr(args, "plan", None)
+    if not plan_src:
+        cand = fsio.join(args.job_dir, "chaos_plan.json")
+        if os.path.exists(cand) or (fsio.is_remote(cand)
+                                    and obs_render._exists(cand)):
+            plan_src = cand
+    if plan_src:
+        try:
+            plan = chaos.load_plan(plan_src)
+        except chaos.ChaosPlanError as e:
+            print(f"chaos plan: {e}", file=sys.stderr, flush=True)
+            return EXIT_FAIL
+
+    injected: dict[str, int] = {}
+    recovered: dict[str, int] = {}
+    run_exits: list[int] = []
+    recovery_kinds = ("supervisor_restart", "supervisor_done",
+                      "checkpoint_fallback", "checkpoint_fallback_resolved",
+                      "train_resume", "preemption_grace",
+                      "supervisor_liveness_kill", "chaos_corrupt")
+    for rec in events:
+        kind = rec.get("kind")
+        if kind == "chaos_inject":
+            site = str(rec.get("site", "?"))
+            injected[site] = injected.get(site, 0) + 1
+        elif kind in recovery_kinds:
+            recovered[kind] = recovered.get(kind, 0) + 1
+        elif kind == "run_end":
+            try:
+                run_exits.append(int(rec.get("exit")))
+            except (TypeError, ValueError):
+                pass
+
+    planned_sites = sorted({f.site for f in plan.faults}) if plan else []
+    # a glob site ("fsio.*") counts as fired when ANY injected site matches
+    import fnmatch as fnmatch_mod
+    silent = [s for s in planned_sites
+              if not any(i == s or fnmatch_mod.fnmatchcase(i, s)
+                         for i in injected)]
+    completed = (recovered.get("supervisor_done", 0) > 0
+                 or (run_exits and run_exits[-1] == 0))
+    report = {
+        "journal": jpath,
+        "plan": plan_src,
+        "planned_sites": planned_sites,
+        "injected": dict(sorted(injected.items())),
+        "injected_total": sum(injected.values()),
+        "silent_sites": silent,
+        "recovered": dict(sorted(recovered.items())),
+        "final_run_exit": run_exits[-1] if run_exits else None,
+        "completed": bool(completed),
+        "verdict": ("PASS" if completed and not silent
+                    else "INCOMPLETE" if not completed else "SILENT_SITES"),
+    }
+    if getattr(args, "json", False):
+        print(json.dumps(report))
+    else:
+        print(f"chaos-verify: {report['verdict']} — "
+              f"{report['injected_total']} injection(s) across "
+              f"{len(injected)} site(s), final exit "
+              f"{report['final_run_exit']}")
+        if planned_sites:
+            print(f"  planned sites: {', '.join(planned_sites)}")
+        for site, n in sorted(injected.items()):
+            print(f"  injected  {site}: {n}")
+        for kind, n in sorted(recovered.items()):
+            print(f"  recovered {kind}: {n}")
+        if silent:
+            print(f"  NEVER FIRED: {', '.join(silent)} (trigger never "
+                  "matched — check at_call/at_epoch/rank against the run)")
+    return EXIT_OK if report["verdict"] == "PASS" else EXIT_FAIL
 
 
 def run_score(args) -> int:
@@ -1243,6 +1403,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.command == "metrics":
         # pure file reads — must not pay the jax import or compile cache
         return run_metrics(args)
+    if args.command == "chaos-verify":
+        # likewise journal/plan reads only — no jax import
+        return run_chaos_verify(args)
     from . import detach as detach_lib
     if args.command == "status":
         return detach_lib.run_status(args.job_dir)

@@ -90,8 +90,16 @@ class ConsoleBoard:
         if self.echo:
             print(stamped, flush=True)
         if self._fh is not None:
-            self._fh.write(stamped + "\n")
-            self._fh.flush()
+            try:
+                # chaos site "board.flush": a failing board disk/volume must
+                # degrade to stdout-only, never kill the training it narrates
+                from .. import chaos
+                chaos.maybe_fail("board.flush", path=self.board_path)
+                self._fh.write(stamped + "\n")
+                self._fh.flush()
+            except Exception as e:  # noqa: BLE001 - board is observability
+                print(f"board write failed ({e}); continuing",
+                      file=sys.stderr, flush=True)
         elif self._remote:
             with self._lock:
                 self._lines.append(stamped)
@@ -161,6 +169,8 @@ class ConsoleBoard:
             if gen <= self._written_gen:
                 return  # a newer snapshot already reached the store
             try:
+                from .. import chaos
+                chaos.maybe_fail("board.flush", path=self.board_path)
                 from ..data import fsio
                 fsio.write_bytes(self.board_path,
                                  ("\n".join(lines) + "\n").encode())

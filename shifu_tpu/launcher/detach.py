@@ -161,7 +161,41 @@ def job_state(out_dir: str) -> dict:
             out["telemetry"] = tele
     except Exception:
         pass
+    try:  # checkpoint retention: kept steps + GC'd totals (recovery ladder)
+        ckpt = _checkpoint_summary(out_dir)
+        if ckpt:
+            out["checkpoints"] = ckpt
+    except Exception:
+        pass
     return out
+
+
+def _checkpoint_summary(out_dir: str) -> Optional[dict]:
+    """Kept checkpoint steps (the recovery ladder's rungs) from the job's
+    default tmp_model dir, plus GC'd-step totals from the scrape file —
+    bounded work (one listing + one small file), fit for status polls."""
+    ckpt_dir = os.path.join(out_dir, "tmp_model")
+    if not os.path.isdir(ckpt_dir):
+        return None
+    kept = sorted(int(n) for n in os.listdir(ckpt_dir)
+                  if n.isdigit() and os.path.isdir(os.path.join(ckpt_dir, n)))
+    verified = sum(
+        1 for s in kept
+        if os.path.exists(os.path.join(ckpt_dir, f"manifest-{s}.json")))
+    summary = {"kept_steps": kept, "manifests": verified}
+    prom = os.path.join(out_dir, "telemetry", "metrics.prom")
+    try:
+        from ..obs.render import parse_scrape_totals
+        with open(prom) as f:
+            totals = parse_scrape_totals(f.read())
+        if "checkpoint_gc_total" in totals:
+            summary["gc_steps"] = int(totals["checkpoint_gc_total"])
+        if "checkpoint_gc_bytes_total" in totals:
+            summary["gc_freed_bytes"] = int(
+                totals["checkpoint_gc_bytes_total"])
+    except OSError:
+        pass
+    return summary
 
 
 def _telemetry_quick_summary(jpath: str) -> Optional[dict]:

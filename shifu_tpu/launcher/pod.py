@@ -197,11 +197,21 @@ def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
     lock = threading.Lock()
 
     def _contract(rank: int) -> dict[str, str]:
-        return {
+        contract = {
             "SHIFU_TPU_COORDINATOR": coordinator,
             "SHIFU_TPU_NUM_PROCESSES": str(n),
             "SHIFU_TPU_PROCESS_ID": str(rank),
         }
+        # an active chaos plan must reach every rank — local transport
+        # inherits the dispatcher env, but ssh carries ONLY the contract
+        # (the state path is only meaningful on shared storage; rank-scoped
+        # process triggers need no state at all)
+        from ..chaos import ENV_CHAOS_PLAN, ENV_CHAOS_STATE
+        for key in (ENV_CHAOS_PLAN, ENV_CHAOS_STATE):
+            val = os.environ.get(key)
+            if val:
+                contract[key] = val
+        return contract
 
     def pump(rank: int, proc: subprocess.Popen, log_path: str,
              mode: str = "w") -> None:
@@ -216,6 +226,20 @@ def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
 
     def dispatch(rank: int, mode: str = "w") -> None:
         argv, env = _host_command(spec, rank, child_args, _contract(rank))
+        try:
+            # chaos site "pod.dispatch": the transport to one host fails
+            # (ssh refused, container runtime down) — modeled as a stub
+            # child exiting with the fault's code so the gang teardown /
+            # ssh-retry / reshape machinery sees a real dead rank.  255
+            # exercises the ssh transport budget specifically.
+            from .. import chaos
+            chaos.maybe_fail("pod.dispatch", rank=rank, attempt=attempt,
+                             host=spec.hosts[rank])
+        except chaos.ChaosError as e:
+            echo(f"pod: chaos: host {rank} dispatch fails ({e})")
+            argv = [sys.executable, "-c",
+                    f"import sys; sys.exit({int(e.exit_code)})"]
+            env = None
         proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
                                 stderr=subprocess.STDOUT, text=True)
         procs[rank] = proc

@@ -388,7 +388,20 @@ def supervise(child_argv: Sequence[str],
             # detached daemon was SIGKILLed out from under its attempt
             child_env = dict(os.environ)
             child_env[ENV_PDEATHSIG] = str(os.getpid())
-            proc = subprocess.Popen(cmd, start_new_session=True,
+            spawn_cmd = cmd
+            try:
+                # chaos site "supervisor.spawn": a child that cannot even
+                # start (bad node, OOM-killed at exec) — modeled as a stub
+                # that exits with the fault's code, so the restart budget
+                # and progress accounting see a real failed attempt
+                from .. import chaos
+                chaos.maybe_fail("supervisor.spawn", attempt=attempts)
+            except chaos.ChaosError as e:
+                print(f"supervisor: chaos: attempt {attempts} spawn fails "
+                      f"({e})", flush=True)
+                spawn_cmd = [python, "-c",
+                             f"import sys; sys.exit({int(e.exit_code)})"]
+            proc = subprocess.Popen(spawn_cmd, start_new_session=True,
                                     env=child_env)
             last_size = -1
             last_progress = time.monotonic()
@@ -478,7 +491,12 @@ def supervise(child_argv: Sequence[str],
         for s, _h in old_handlers:
             signal_lib.signal(s, signal_lib.SIG_IGN)
         if proc is not None and proc.poll() is None:
-            _kill_tree(proc, signal_lib.SIGTERM)
+            # preemption grace: the child's SIGTERM drain saves one final
+            # in-band checkpoint at the current step before exiting — give
+            # that save a wider window than the hung-tree default before
+            # the SIGKILL escalation (the wait returns as soon as the
+            # child exits, so a fast drain pays nothing extra)
+            _kill_tree(proc, signal_lib.SIGTERM, grace_seconds=15.0)
         return 143
     finally:
         for s, h in old_handlers:

@@ -135,7 +135,15 @@ class RunJournal:
     def flush(self) -> None:
         with self._lock:
             if self._fh is not None:
-                self._fh.flush()
+                try:
+                    # chaos site "journal.flush": models the sink's disk /
+                    # object store failing — the journal is observability,
+                    # so the failure is absorbed, never the job's
+                    from .. import chaos
+                    chaos.maybe_fail("journal.flush", path=self.path)
+                    self._fh.flush()
+                except Exception:
+                    pass
             elif self._remote and self._pending:
                 self._flush_remote_locked()
 
@@ -143,6 +151,8 @@ class RunJournal:
         # best-effort whole-object rewrite (the board's contract): a sink
         # failure must never fail the job the journal describes
         try:
+            from .. import chaos
+            chaos.maybe_fail("journal.flush", path=self.path)
             from ..data import fsio
             lines = self._lines
             if self._truncated:

@@ -1,4 +1,4 @@
-"""Checkpoint save / auto-resume via Orbax.
+"""Checkpoint save / auto-resume via Orbax — with self-healing restore.
 
 Successor of the reference's `MonitoredTrainingSession(checkpoint_dir=
 TMP_MODEL_PATH)` auto-save/restore (resources/ssgd_monitor.py:251-257) and the
@@ -6,19 +6,32 @@ recovery path where a promoted backup worker resumes from the newest
 checkpoint (SURVEY.md section 3.6).  Under SPMD, checkpoint-restart IS the
 fault-tolerance story: orbax writes sharded arrays (each host its shards) and
 restore re-places them onto the current mesh.
+
+Integrity (docs/ROBUSTNESS.md): every durable save writes a digest manifest
+(`manifest-<step>.json`, blake2b over every file of the step tree) beside
+the orbax step; restore verifies the manifest and, on mismatch — or any
+restore error — falls back to the newest EARLIER verified step instead of
+crashing the restart loop (journaled as `checkpoint_fallback`).  That turns
+`max_to_keep` from a disk-space policy into a recovery ladder: N retained
+steps = N-1 spare rungs under silent corruption.  Retention itself is
+journaled too: a step the orbax manager garbage-collects emits a
+`checkpoint_gc` event with the freed byte count.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import time
 import weakref
-from typing import Any, Optional
+from typing import Any, Iterable, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
-from .. import obs
+from .. import chaos, obs
+from ..data import fsio
 
 
 def make_manager(directory: str, max_to_keep: int = 3) -> ocp.CheckpointManager:
@@ -60,6 +73,196 @@ def _write_progress_marker(directory: str, step: int,
         pass
 
 
+# --- checkpoint integrity: digest manifests + retention journal -----------
+
+MANIFEST_PREFIX = "manifest-"
+_DIGEST_ALGO = "blake2b-128"
+
+
+def manifest_path(directory: str, step: int) -> str:
+    return fsio.join(str(directory), f"{MANIFEST_PREFIX}{int(step)}.json")
+
+
+def _tree_files(root: str) -> Iterable[tuple[str, int]]:
+    """(relative path, size) for every file under `root` — the shared
+    fsio.walk_files walk with paths made root-relative."""
+    prefix = root.rstrip("/")
+    for full, size in fsio.walk_files(root):
+        if fsio.is_remote(root):
+            rel = full[len(prefix):].lstrip("/")
+        else:
+            rel = os.path.relpath(full, root)
+        yield rel, size
+
+
+def _digest_file(root: str, rel: str) -> str:
+    """Streaming blake2b of one tree file — chunked reads, never the whole
+    file in memory (a multi-GB orbax shard at save time must not double
+    the host's footprint just to be hashed).  The remote loop retries
+    transient mid-stream errors whole-file (fresh hash per attempt, like
+    fsio.count_data_lines): a network blip during a restore-time verify
+    must read as "retry", never as "corrupt checkpoint" — misclassifying
+    it would make the ladder discard a good newest step."""
+    chunk_bytes = 1 << 20
+    if fsio.is_remote(root):
+        def op() -> str:
+            h = hashlib.blake2b(digest_size=16)
+            f = fsio.open_input_file(fsio.join(root, rel))
+            try:
+                while True:
+                    chunk = f.read(chunk_bytes)
+                    if not chunk:
+                        break
+                    h.update(bytes(chunk))
+            finally:
+                f.close()
+            return h.hexdigest()
+
+        return fsio._retry_transient(op, op_name="digest_file")
+    h = hashlib.blake2b(digest_size=16)
+    with open(os.path.join(root, rel), "rb") as f:
+        for chunk in iter(lambda: f.read(chunk_bytes), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _tree_spec(state: Any) -> Optional[list]:
+    """[[leaf path, shape, dtype], ...] for a (possibly abstract) state
+    pytree — recorded in the manifest so restore can reject an
+    INCOMPATIBLE checkpoint explicitly (this orbax version silently
+    'restores' a tree of different shapes instead of raising, which would
+    hand training garbage weights)."""
+    try:
+        from jax.tree_util import keystr, tree_flatten_with_path
+        leaves, _ = tree_flatten_with_path(state)
+        return [[keystr(path),
+                 [int(d) for d in getattr(x, "shape", ()) or ()],
+                 str(getattr(x, "dtype", type(x).__name__))]
+                for path, x in leaves]
+    except Exception:
+        return None
+
+
+def write_manifest(directory: str, step: int,
+                   tree_spec: Optional[list] = None) -> Optional[dict]:
+    """Hash every file of the committed step tree into
+    `<dir>/manifest-<step>.json`.  Called only once the save is KNOWN
+    durable (blocking save, or the async drain) so the digests describe
+    final bytes.  Best-effort: a manifest failure must never fail the
+    checkpoint — restore treats a missing manifest as 'legacy, unverified'."""
+    directory = str(directory)
+    step_dir = fsio.join(directory, str(int(step)))
+    try:
+        files = {rel: [_digest_file(step_dir, rel), size]
+                 for rel, size in _tree_files(step_dir)}
+        manifest = {"step": int(step), "algo": _DIGEST_ALGO, "files": files}
+        if tree_spec:
+            manifest["state_tree"] = tree_spec
+        payload = json.dumps(manifest).encode()
+        if fsio.is_remote(directory):
+            fsio.write_bytes(manifest_path(directory, step), payload)
+        else:
+            path = manifest_path(directory, step)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+        return manifest
+    except Exception:
+        return None
+
+
+def read_manifest(directory: str, step: int) -> Optional[dict]:
+    try:
+        path = manifest_path(str(directory), step)
+        if fsio.is_remote(str(directory)):
+            raw = fsio.read_bytes(path)
+        else:
+            with open(path, "rb") as f:
+                raw = f.read()
+        m = json.loads(raw)
+        return m if isinstance(m, dict) else None
+    except Exception:
+        return None
+
+
+def verify_manifest(directory: str, step: int) -> Optional[bool]:
+    """Re-hash the step tree against its manifest.  True = verified;
+    False = mismatch / missing / unreadable files (corrupt checkpoint);
+    None = no manifest (pre-integrity checkpoint — restore proceeds on
+    trust, exactly the old behavior)."""
+    directory = str(directory)
+    manifest = read_manifest(directory, step)
+    if manifest is None or not isinstance(manifest.get("files"), dict):
+        return None
+    step_dir = fsio.join(directory, str(int(step)))
+    want: dict = manifest["files"]
+    try:
+        have = dict(_tree_files(step_dir))
+    except Exception:
+        return False
+    for rel, entry in want.items():
+        digest, size = (entry[0], entry[1]) if isinstance(entry, list) \
+            else (entry, None)
+        if rel not in have:
+            return False
+        if size is not None and have[rel] != size:
+            return False
+        try:
+            if _digest_file(step_dir, rel) != digest:
+                return False
+        except Exception:
+            return False
+    return True
+
+
+def _delete_manifest(directory: str, step: int) -> None:
+    try:
+        path = manifest_path(str(directory), step)
+        if fsio.is_remote(str(directory)):
+            filesystem, fs_path = fsio._filesystem(path)
+            filesystem.delete_file(fs_path)
+        else:
+            os.unlink(path)
+    except Exception:
+        pass
+
+
+def _step_sizes(directory: str) -> dict[int, int]:
+    """{step: total bytes} for every digit-named step dir — the before-save
+    snapshot the retention journal diffs against.  Best-effort stat walk
+    (no reads); {} when the listing fails."""
+    out: dict[int, int] = {}
+    try:
+        # one recursive walk, grouped by the top-level digit dir — shared
+        # local/remote mechanics via fsio.walk_files
+        for rel, size in _tree_files(str(directory)):
+            top = rel.split("/", 1)[0]
+            if "/" in rel and top.isdigit():
+                out[int(top)] = out.get(int(top), 0) + size
+    except Exception:
+        return {}
+    return out
+
+
+def _journal_gc(directory: str, before: dict[int, int],
+                kept: Iterable[int]) -> None:
+    """Emit `checkpoint_gc` for every step the orbax manager dropped during
+    a save — retention becomes an auditable event stream (and `shifu-tpu
+    status` surfaces the counters), not a silent disk policy."""
+    kept_set = set(int(s) for s in kept)
+    for step, size in sorted(before.items()):
+        if step in kept_set:
+            continue
+        obs.counter("checkpoint_gc_total",
+                    "checkpoint steps garbage-collected").inc()
+        obs.counter("checkpoint_gc_bytes_total",
+                    "bytes freed by checkpoint retention").inc(int(size))
+        obs.event("checkpoint_gc", step=int(step), freed_bytes=int(size),
+                  kept=sorted(kept_set))
+        _delete_manifest(directory, step)
+
+
 # Async saves defer their PROGRESS marker until the save is KNOWN durable
 # (the next wait_until_finished) — a marker recording an epoch whose
 # checkpoint is still in flight could let the supervisors' durable-progress
@@ -71,7 +274,24 @@ _PENDING_MARKERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 def _flush_pending_marker(manager: ocp.CheckpointManager) -> None:
     pending = _PENDING_MARKERS.pop(manager, None)
     if pending is not None:
-        _write_progress_marker(str(manager.directory), *pending)
+        step, extra, tree_spec = pending
+        _finalize_durable(str(manager.directory), step, extra, tree_spec)
+
+
+def _finalize_durable(directory: str, step: int, extra: Optional[dict],
+                      tree_spec: Optional[list] = None) -> None:
+    """Post-durability bookkeeping, one order for sync and async saves:
+    digest manifest FIRST (the marker must never advertise progress whose
+    integrity record is missing), then the PROGRESS marker, then the
+    "checkpoint.post_save" chaos probe — the injection point that models
+    silent storage corruption of an already-committed checkpoint."""
+    write_manifest(directory, step, tree_spec)
+    _write_progress_marker(directory, step, extra)
+    try:
+        chaos.maybe_fail("checkpoint.post_save", step=int(step),
+                         path=fsio.join(directory, str(int(step))))
+    except chaos.ChaosError:
+        pass  # post-save actions model data damage, not process failure
 
 
 def save(manager: ocp.CheckpointManager, step: int, state: Any,
@@ -104,12 +324,25 @@ def save(manager: ocp.CheckpointManager, step: int, state: Any,
     existing = set(manager.all_steps())
     while step in existing:
         step += 1
+    # retention snapshot BEFORE the save: the manager GCs past-max_to_keep
+    # steps inside save(), and the freed bytes must be measured while the
+    # step tree still exists
+    directory = str(manager.directory)
+    sizes_before = _step_sizes(directory) if existing else {}
+    # leaf spec captured BEFORE the save dispatch: an async save's state
+    # buffers may be donated by later train steps, but shape/dtype metadata
+    # is all the manifest records
+    tree_spec = _tree_spec(state)
+    chaos.maybe_fail("checkpoint.save", step=int(step))
     manager.save(step, args=ocp.args.Composite(**composite), force=True)
     if block:
         manager.wait_until_finished()
-        _write_progress_marker(str(manager.directory), step, extra)
+        _finalize_durable(directory, step, extra, tree_spec)
     else:
-        _PENDING_MARKERS[manager] = (step, extra)
+        _PENDING_MARKERS[manager] = (step, extra, tree_spec)
+    if sizes_before:
+        _journal_gc(directory, sizes_before,
+                    kept=list(manager.all_steps()) + [step])
     dur = time.perf_counter() - t0
     # blocking saves time the full durable write; async saves time only the
     # dispatch (the overlap IS the feature) — the mode label keeps the two
@@ -153,14 +386,96 @@ def restore(manager: ocp.CheckpointManager, step: int, abstract_state: Any,
     return out["state"]
 
 
+class CheckpointCorruptError(RuntimeError):
+    """The step tree's bytes no longer match its digest manifest."""
+
+
+class CheckpointIncompatibleError(RuntimeError):
+    """The checkpoint's recorded state tree (leaf paths/shapes/dtypes)
+    does not match the restore target — a topology change, not corruption.
+    Raised explicitly because this orbax version otherwise 'restores'
+    mismatched shapes silently (garbage weights, no error)."""
+
+
+def _check_compatible(directory: str, step: int, abstract_state: Any) -> None:
+    manifest = read_manifest(directory, step)
+    want = manifest.get("state_tree") if manifest else None
+    if not want:
+        return  # legacy manifest / none: restore proceeds on trust
+    have = _tree_spec(abstract_state)
+    if have is None:
+        return
+
+    def _norm(spec):
+        return [(p, tuple(shape), dt) for p, shape, dt in spec]
+
+    if _norm(want) == _norm(have):
+        return
+    want_map = {p: (shape, dt) for p, shape, dt in _norm(want)}
+    have_map = {p: (shape, dt) for p, shape, dt in _norm(have)}
+    for path in sorted(set(want_map) | set(have_map)):
+        if want_map.get(path) != have_map.get(path):
+            raise CheckpointIncompatibleError(
+                f"checkpoint step {step} is incompatible with the restore "
+                f"target at {path!r}: saved "
+                f"{want_map.get(path, 'nothing')}, target expects "
+                f"{have_map.get(path, 'nothing')}")
+
+
 def restore_latest(manager: ocp.CheckpointManager, abstract_state: Any,
                    with_extra: bool = False):
-    """Auto-resume: restore the newest checkpoint or return None."""
-    step = latest_step(manager)
-    if step is None:
+    """Auto-resume with a recovery ladder: restore the newest checkpoint —
+    or, when its digest manifest fails verification or the restore itself
+    errors (truncated blob, unreadable object), fall back to the newest
+    EARLIER verified step instead of crashing the restart loop.  Every rung
+    skipped is journaled as `checkpoint_fallback` (the restart budget's
+    durable-progress probe and an operator both need to see it).  Returns
+    None when no checkpoint exists at all; re-raises the FIRST error when
+    every retained step fails — a genuinely incompatible checkpoint must
+    surface, not silently restart training from scratch."""
+    steps = sorted(manager.all_steps(), reverse=True)
+    if not steps:
         return None
-    out = restore(manager, step, abstract_state, with_extra=with_extra)
-    if with_extra:
-        state, extra = out
-        return state, extra, step
-    return out, step
+    directory = str(manager.directory)
+    first_err: Optional[Exception] = None
+    for i, step in enumerate(steps):
+        try:
+            # probe BEFORE the verify: an injected read failure must cost
+            # this rung even when the bytes underneath are intact
+            chaos.maybe_fail("checkpoint.restore", step=int(step))
+            # SHIFU_TPU_CKPT_VERIFY=0 skips the re-hash (restore-time
+            # verification reads the step tree twice; an operator resuming
+            # a multi-TB checkpoint on trusted storage may prefer speed)
+            if (os.environ.get("SHIFU_TPU_CKPT_VERIFY", "1") != "0"
+                    and verify_manifest(directory, step) is False):
+                raise CheckpointCorruptError(
+                    f"checkpoint step {step} failed digest verification "
+                    f"(manifest-{step}.json)")
+            _check_compatible(directory, step, abstract_state)
+            out = restore(manager, step, abstract_state,
+                          with_extra=with_extra)
+        except CheckpointIncompatibleError:
+            # NOT a ladder case: incompatibility is a topology change, and
+            # the right recovery is a layout CONVERSION of this newest
+            # checkpoint (train/loop.py restore_latest_any_layout) — an
+            # older same-layout rung would silently lose epochs instead
+            raise
+        except Exception as e:  # noqa: BLE001 - each rung may fail its own way
+            if first_err is None:
+                first_err = e
+            obs.counter("checkpoint_fallback_total",
+                        "restores that fell back past a bad step").inc(
+                reason=type(e).__name__)
+            obs.event("checkpoint_fallback", failed_step=int(step),
+                      reason=type(e).__name__, error=str(e)[:300],
+                      remaining_steps=[int(s) for s in steps[i + 1:]])
+            obs.flush()
+            continue
+        if i > 0:
+            obs.event("checkpoint_fallback_resolved", step=int(step),
+                      skipped=[int(s) for s in steps[:i]])
+        if with_extra:
+            state, extra = out
+            return state, extra, step
+        return out, step
+    raise first_err

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from .. import obs
+from .. import chaos, obs
 from ..config.schema import ConfigError, JobConfig
 from ..data import pipeline as pipe
 from ..models.registry import build_model
@@ -775,13 +775,25 @@ def train(job: JobConfig,
         the price of mid-epoch durability (the reference's Supervisor
         restore had equally coarse step semantics)."""
         nonlocal last_save
+        # chaos site "train.chunk": the safe-point boundary itself — a
+        # crash here models dying between a chunk's compute and its save
+        chaos.maybe_fail("train.chunk", echo=console, epoch=epoch)
         if term_flag["hit"]:
             if manager is not None:
                 cur = int(jax.device_get(state.step))
+                saved = False
                 if (ckpt_lib.latest_step(manager) or -1) < cur:
                     ckpt_lib.save(manager, cur, state,
                                   extra={"epoch": epoch}, block=True)
+                    saved = True
                 ckpt_lib.finalize(manager)
+                # preemption grace: the journal records WHERE the drain
+                # landed, so an operator (and chaos-verify) can confirm the
+                # resume point is the grace-saved step, not the prior
+                # epoch boundary
+                obs.event("preemption_grace", epoch=int(epoch),
+                          step=cur, saved=saved)
+                obs.flush()
                 console("SIGTERM: checkpoint saved, exiting for restart")
             else:
                 console("SIGTERM: exiting (no checkpoint directory)")
@@ -830,6 +842,10 @@ def train(job: JobConfig,
     pending_loader = None  # streamed loader whose train set is not yet built
     try:
       for epoch in range(start_epoch, job.train.epochs):
+        # chaos site "train.epoch_start": the epoch boundary BEFORE any
+        # work — a crash here must lose nothing (the previous epoch's save
+        # already landed); distinct from the CLI's post-epoch "train.epoch"
+        chaos.maybe_fail("train.epoch_start", echo=console, epoch=epoch)
         t0 = time.perf_counter()
         if pending_loader is not None and epoch > start_epoch:
             # first epoch after the streamed one: assemble the retained

@@ -1,0 +1,22 @@
+"""Compatibility shims across the jax versions this repo meets in the wild.
+
+`shard_map` graduated from `jax.experimental.shard_map` (kw `check_rep`)
+to top-level `jax.shard_map` (kw `check_vma`); images pinned to jax 0.4.x
+only carry the experimental spelling, and calling the missing top-level
+name raises AttributeError deep inside model build.  One resolver keeps
+every call site on the modern signature.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    import jax
+
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        return native(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as legacy
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
