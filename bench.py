@@ -47,17 +47,12 @@ import numpy as np
 
 BASELINE_SAMPLES_PER_SEC_PER_CHIP = 10_000_000 / 16  # v5e-16 north star
 
-# peak dense bf16 TFLOP/s per chip by device kind substring (public specs);
-# used for the MFU estimate — tabular MLPs are bandwidth-bound, so MFU is
-# reported for context, not as the target
-_PEAK_BF16_TFLOPS = (
-    ("v6", 918.0),       # Trillium / v6e
-    ("v5p", 459.0),
-    ("v5", 197.0),       # v5e / "TPU v5 lite"
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-)
+# peak dense bf16 TFLOP/s per chip lives in obs/goodput.py now (ONE
+# per-platform table feeding bench MFU, the goodput ledger, and the
+# SHIFU_TPU_PEAK_TFLOPS override); used for the MFU estimate — tabular
+# MLPs are bandwidth-bound, so MFU is reported for context, not as the
+# target
+from shifu_tpu.obs.goodput import PEAK_BF16_TFLOPS as _PEAK_BF16_TFLOPS
 
 # peak HBM GB/s per chip (public specs) — the roofline that actually binds
 # the embedding rungs (VERDICT r3 weak #4: MFU is meaningless for a
@@ -1172,6 +1167,35 @@ def main() -> None:
         extras["e2e_error"] = str(e)[:200]
 
     phases.mark(None)
+    # goodput + XLA-compile accounting (obs/goodput.py, obs/introspect.py):
+    # the e2e tiers run real train() epochs whose goodput ledger and
+    # instrumented step compiles land in this process's registry — summed
+    # here into STABLE artifact fields so tools/perf_gate.py can diff the
+    # goodput fraction and compile count across rounds (next to `phases`)
+    goodput_summary = xla_summary = None
+    try:
+        from shifu_tpu.obs import goodput as goodput_mod
+        from shifu_tpu.obs import introspect as introspect_mod
+        gsec = obs.default_registry().counter("goodput_bucket_seconds_total")
+        buckets = {b: round(gsec.value(bucket=b), 3)
+                   for b in goodput_mod.BUCKETS}
+        wall = sum(buckets.values())
+        if wall > 0:
+            goodput_summary = {
+                "buckets": buckets,
+                # seconds-weighted mean across every ledgered epoch
+                "goodput_fraction_mean": round(buckets["step"] / wall, 4),
+            }
+        cstats = introspect_mod.stats()
+        if cstats:
+            xla_summary = {
+                "total": sum(c["compiles"] for c in cstats.values()),
+                "compile_s": round(sum(c["compile_s"]
+                                       for c in cstats.values()), 3),
+                "by_fn": {k: c["compiles"] for k, c in sorted(cstats.items())},
+            }
+    except Exception:
+        pass
     full = {
         "metric": "tabular_train_samples_per_sec_per_chip",
         "value": round(resident_per_chip, 1),
@@ -1185,6 +1209,10 @@ def main() -> None:
         "phases": {k: round(v, 2) for k, v in phases.totals.items()},
         **extras,
     }
+    if goodput_summary:
+        full["goodput"] = goodput_summary
+    if xla_summary:
+        full["xla_compiles"] = xla_summary
     # full record -> file; stdout gets ONE compact line the driver's
     # 2000-char tail capture always parses (VERDICT r3 weak #2: the r03
     # single line outgrew the capture and the headline was lost)

@@ -81,15 +81,18 @@ def export_stablehlo(forward_fn, params, num_features: int, path: str,
 
         fn = lambda feats: forward_fn(params, feats)
         exported = None
-        try:  # symbolic batch: score any (N, F) without re-export
-            (dim,) = jax_export.symbolic_shape("batch")
-            shape = jax.ShapeDtypeStruct((dim, num_features), jnp.float32)
-            exported = jax_export.export(jax.jit(fn))(shape)
-        except Exception:
-            pass  # fall back to a concrete batch below
-        if exported is None:
-            shape = jax.ShapeDtypeStruct((batch, num_features), jnp.float32)
-            exported = jax_export.export(jax.jit(fn))(shape)
+        from ..obs.introspect import compile_span
+        with compile_span("export_stablehlo"):
+            try:  # symbolic batch: score any (N, F) without re-export
+                (dim,) = jax_export.symbolic_shape("batch")
+                shape = jax.ShapeDtypeStruct((dim, num_features), jnp.float32)
+                exported = jax_export.export(jax.jit(fn))(shape)
+            except Exception:
+                pass  # fall back to a concrete batch below
+            if exported is None:
+                shape = jax.ShapeDtypeStruct((batch, num_features),
+                                             jnp.float32)
+                exported = jax_export.export(jax.jit(fn))(shape)
         with open(path, "w") as f:
             f.write(exported.mlir_module())
         try:

@@ -295,7 +295,8 @@ class JaxScorer:
         def fwd(feats):
             return jax.nn.sigmoid(model.apply({"params": params}, feats))
 
-        self._fwd = jax.jit(fwd)
+        from ..obs.introspect import instrument_jit
+        self._fwd = instrument_jit(fwd, "jax_scorer")
         self._jnp = jnp
 
     def compute_batch(self, rows: np.ndarray) -> np.ndarray:

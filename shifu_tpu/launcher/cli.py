@@ -120,6 +120,17 @@ def build_parser() -> argparse.ArgumentParser:
     mt.add_argument("--follow", action="store_true",
                     help="stream journal events as JSONL until ^C "
                          "(tail_board for the structured stream)")
+    pf = sub.add_parser(
+        "profile", help="render a job's goodput ledger: per-epoch wall-time "
+                        "buckets (compile/input/step/checkpoint/restore/"
+                        "eval/other), MFU, top compiled functions by XLA "
+                        "cost, and the recovery tax (docs/PERF.md "
+                        "'Goodput & MFU')")
+    pf.add_argument("job_dir",
+                    help="job dir, telemetry dir, or journal.jsonl path "
+                         "(local or gs:// hdfs:// URI)")
+    pf.add_argument("--json", action="store_true",
+                    help="machine-readable profile dict instead of text")
     cv = sub.add_parser(
         "chaos-verify", help="audit a finished chaos drill: replay the "
                              "recorded plan against the run journal and "
@@ -955,6 +966,29 @@ def run_metrics(args) -> int:
     return EXIT_OK
 
 
+def run_profile(args) -> int:
+    """`shifu-tpu profile <dir>`: the goodput / XLA-cost view of a run —
+    where the wall time and FLOPs went, epoch by epoch, straight from the
+    `goodput` / `xla_compile` journal events (obs/goodput.py,
+    obs/introspect.py)."""
+    from ..obs import render as obs_render
+
+    try:
+        summary = obs_render.profile_summary(args.job_dir)
+    except Exception as e:
+        print(f"profile: {e}", file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    if summary is None:
+        print(f"no telemetry journal found under {args.job_dir} (expected "
+              f"<job_dir>/telemetry/journal.jsonl — run with "
+              f"SHIFU_TPU_METRICS_DIR or a CLI train job)",
+              file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    print(json.dumps(summary) if args.json
+          else obs_render.render_profile_text(summary))
+    return EXIT_OK
+
+
 def run_chaos_verify(args) -> int:
     """`shifu-tpu chaos-verify <job_dir>`: audit a finished chaos drill.
 
@@ -1403,6 +1437,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.command == "metrics":
         # pure file reads — must not pay the jax import or compile cache
         return run_metrics(args)
+    if args.command == "profile":
+        # likewise journal reads only — no jax import
+        return run_profile(args)
     if args.command == "chaos-verify":
         # likewise journal/plan reads only — no jax import
         return run_chaos_verify(args)

@@ -219,15 +219,30 @@ def _telemetry_quick_summary(jpath: str) -> Optional[dict]:
             # fragment would report last_event=null on a healthy journal
             tail = (tail + chunk)[-65536:]
     last_kind = None
+    goodput = None
     for line in reversed(tail.splitlines()):
         try:
             rec = json.loads(line)
         except ValueError:
             continue
-        if isinstance(rec, dict):
+        if not isinstance(rec, dict):
+            continue
+        if last_kind is None:
             last_kind = rec.get("kind")
+        if goodput is None and rec.get("kind") == "goodput":
+            # latest goodput ledger record within the tail window: the
+            # at-a-glance "is the job actually stepping" numbers
+            # (docs/PERF.md "Goodput & MFU"); a run that never emitted
+            # one (pre-ledger journal) just omits the key
+            goodput = {"epoch": rec.get("epoch"),
+                       "goodput_fraction": rec.get("goodput_fraction"),
+                       "mfu": rec.get("mfu")}
+        if last_kind is not None and goodput is not None:
             break
-    return {"events": n, "last_event": last_kind}
+    out = {"events": n, "last_event": last_kind}
+    if goodput is not None:
+        out["goodput"] = goodput
+    return out
 
 
 def run_status(out_dir: str, echo=print) -> int:

@@ -18,12 +18,19 @@ from SHIFU_TPU_METRICS_DIR via `configure_from_env`); until then the
 registry still collects in memory and `event()` is a no-op, so
 instrumented call sites never need to know whether telemetry is on.
 `obs/aggregate.py` adds the cross-host skew table (one allgather per
-epoch); `obs/render.py` renders a job's telemetry for `shifu-tpu metrics`.
+epoch); `obs/render.py` renders a job's telemetry for `shifu-tpu metrics`
+and `shifu-tpu profile`.  On top of the pillars, ISSUE 3 adds
+`obs/introspect.py` (per-compiled-program XLA cost/memory capture,
+`xla_compile` events) and `obs/goodput.py` (the per-epoch goodput
+ledger: wall time classified into compile / input / step / checkpoint /
+restore / eval / other buckets, with MFU against a per-platform peak
+table) — docs/PERF.md "Goodput & MFU".
 """
 
 from __future__ import annotations
 
-from . import aggregate, journal, metrics, render, spans  # noqa: F401
+from . import (aggregate, goodput, introspect, journal, metrics,  # noqa: F401
+               render, spans)
 from ._sinks import (ENV_METRICS_DIR, SCRAPE_FILE, configure,  # noqa: F401
                      configure_from_env, event, flush, get_journal,
                      reset_for_tests, resolve_metrics_dir, set_journal,

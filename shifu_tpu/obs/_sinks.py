@@ -130,3 +130,6 @@ def reset_for_tests() -> None:
         _journal = None
         _scrape_path = None
         metrics_mod.default_registry().clear()
+        from . import goodput, introspect
+        introspect.reset_for_tests()
+        goodput.reset_for_tests()

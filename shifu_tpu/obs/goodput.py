@@ -1,0 +1,222 @@
+"""Goodput ledger: classify every epoch's wall time into named buckets.
+
+Raw step time says a PR made "the job" slower; it cannot say WHICH part.
+Pod-scale TPU practice (MLPerf-0.6 on v3 pods, arXiv:1909.09756; the
+TensorFlow system paper, arXiv:1605.08695) optimizes *utilization* —
+what fraction of the wall the chips spent on model math — not wall time
+alone.  This module is that accounting for shifu_tpu:
+
+- **Buckets** (`BUCKETS`): `compile` (XLA compiles, reported by
+  obs/introspect.py), `input` (host-side input wait), `step` (device
+  step/scan dispatch-to-done, compile time subtracted), `checkpoint`
+  (save), `restore` (mid-run restore/recovery — chaos drills land
+  here), `eval` (validation pass), `other` (the unclassified residue:
+  tier setup, shuffles, journal flushes).  Buckets sum to the epoch
+  wall by construction (`other` absorbs the remainder).
+- **Goodput fraction** = step seconds / wall: the fraction of the epoch
+  the devices spent advancing the model.
+- **MFU** = achieved FLOP/s ÷ the platform's peak.  Achieved FLOPs come
+  from the XLA `cost_analysis()` of the instrumented step programs
+  (per-dispatch FLOPs x dispatches, accumulated via `note_flops`); the
+  peak comes from `PEAK_BF16_TFLOPS` below, overridable with
+  `SHIFU_TPU_PEAK_TFLOPS` (the escape hatch for new parts and for CPU
+  tests).  On backends where cost capture is off (see introspect.py)
+  MFU is null, never guessed.
+
+Every epoch journals ONE `goodput` event and feeds the
+`goodput_bucket_seconds_total{bucket=...}` counter plus the
+`goodput_fraction` / `mfu` gauges, so `shifu-tpu profile`,
+`shifu-tpu status`, bench.py, and tools/perf_gate.py all read the same
+record (docs/PERF.md "Goodput & MFU").
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+# peak dense bf16 TFLOP/s per chip by device-kind substring (public
+# specs) — THE per-platform table the MFU denominator comes from
+# (bench.py imports this; one table, one truth).  First match wins, so
+# "v5p" must precede "v5".
+PEAK_BF16_TFLOPS: tuple[tuple[str, float], ...] = (
+    ("v6", 918.0),       # Trillium / v6e
+    ("v5p", 459.0),
+    ("v5", 197.0),       # v5e / "TPU v5 lite"
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+ENV_PEAK_TFLOPS = "SHIFU_TPU_PEAK_TFLOPS"
+
+BUCKETS = ("compile", "input", "step", "checkpoint", "restore", "eval",
+           "other")
+
+
+def peak_tflops(device_kind: Optional[str] = None) -> Optional[float]:
+    """Peak bf16 TFLOP/s for a device kind (current backend's device 0
+    when omitted); SHIFU_TPU_PEAK_TFLOPS overrides the table; None when
+    the platform is unknown (CPU, new parts) — MFU is then null."""
+    env = os.environ.get(ENV_PEAK_TFLOPS)
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass  # a typo'd override must not crash telemetry
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return None
+    kind = str(device_kind).lower()
+    for sub, peak in PEAK_BF16_TFLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+class GoodputLedger:
+    """One epoch's wall-time classification.  Threads may `add` /
+    `add_flops` concurrently (the prefetch producer compiles its
+    device_put path; checkpoint saves may run from hooks)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._seconds: dict[str, float] = {}
+        self._flops = 0.0
+        self._compiles = 0
+
+    def add(self, bucket: str, seconds: float) -> None:
+        # `not (seconds > 0)` rather than `<= 0`: it also rejects NaN (a
+        # clock hiccup upstream must not poison the whole ledger, the
+        # bucket counters, and every artifact field derived from them)
+        if not (seconds > 0) or seconds == float("inf"):
+            return
+        with self._lock:
+            self._seconds[bucket] = self._seconds.get(bucket, 0.0) + seconds
+            if bucket == "compile":
+                self._compiles += 1
+
+    def add_flops(self, flops: float) -> None:
+        if flops > 0 and flops != float("inf"):  # NaN > 0 is False
+            with self._lock:
+                self._flops += float(flops)
+
+    def summary(self, wall_s: float) -> dict:
+        """The goodput record for an epoch of `wall_s` seconds.  Compile
+        time happens INSIDE the timed step/eval dispatches (a compiling
+        call's wall includes its compile), so it is subtracted from
+        `step` first, then `eval` — the buckets stay disjoint and sum to
+        the wall, with `other` absorbing the unclassified residue."""
+        with self._lock:
+            b = dict(self._seconds)
+            flops = self._flops
+            compiles = self._compiles
+        compile_s = b.get("compile", 0.0)
+        overlap = min(compile_s, b.get("step", 0.0))
+        b["step"] = b.get("step", 0.0) - overlap
+        b["eval"] = max(b.get("eval", 0.0) - (compile_s - overlap), 0.0)
+        buckets = {k: round(b.get(k, 0.0), 6) for k in BUCKETS
+                   if k != "other"}
+        classified = sum(buckets.values())
+        buckets["other"] = round(max(wall_s - classified, 0.0), 6)
+        out = {
+            "wall_s": round(wall_s, 6),
+            "buckets": buckets,
+            "goodput_fraction": round(buckets["step"] / wall_s, 4)
+            if wall_s > 0 else None,
+            "compiles": compiles,
+        }
+        peak = peak_tflops()
+        achieved = (flops / wall_s / 1e12) if wall_s > 0 and flops > 0 \
+            else None
+        # significant digits, not fixed decimals: CPU-scale TFLOP/s (and
+        # the MFU they imply) are legitimately tiny and must not round
+        # to a meaningless 0.0
+        out["achieved_tflops"] = (float(f"{achieved:.6g}")
+                                  if achieved is not None else None)
+        out["peak_tflops"] = peak
+        out["mfu"] = (float(f"{achieved / peak:.6g}")
+                      if achieved is not None and peak else None)
+        return out
+
+
+_lock = threading.Lock()
+_current: Optional[GoodputLedger] = None
+
+
+def begin_epoch() -> GoodputLedger:
+    """Open a fresh ledger as the process's active epoch ledger."""
+    global _current
+    with _lock:
+        _current = GoodputLedger()
+        return _current
+
+
+def current() -> Optional[GoodputLedger]:
+    return _current
+
+
+def note(bucket: str, seconds: float) -> None:
+    """Credit `seconds` to `bucket` on the active ledger; no-op between
+    epochs — instrumented call sites (checkpoint saves, compiles) never
+    check whether a ledger is open.  Never raises."""
+    led = _current
+    if led is not None:
+        try:
+            led.add(bucket, seconds)
+        except Exception:
+            pass
+
+
+def note_flops(flops: float) -> None:
+    led = _current
+    if led is not None:
+        try:
+            led.add_flops(flops)
+        except Exception:
+            pass
+
+
+def end_epoch(epoch: int, wall_s: float) -> Optional[dict]:
+    """Close the active ledger: journal the `goodput` event, feed the
+    registry, return the record (None when no ledger is open)."""
+    global _current
+    with _lock:
+        led = _current
+        _current = None
+    if led is None:
+        return None
+    try:
+        from . import _sinks, metrics as metrics_mod
+        rec = led.summary(wall_s)
+        rec["epoch"] = int(epoch)
+        sec = metrics_mod.counter(
+            "goodput_bucket_seconds_total",
+            "epoch wall seconds by goodput bucket (docs/PERF.md)")
+        for bucket, s in rec["buckets"].items():
+            sec.inc(s, bucket=bucket)
+        if rec["goodput_fraction"] is not None:
+            metrics_mod.gauge(
+                "goodput_fraction",
+                "last epoch's device-step fraction of wall time",
+            ).set(rec["goodput_fraction"])
+        if rec["mfu"] is not None:
+            metrics_mod.gauge(
+                "mfu", "last epoch's model FLOP utilization").set(rec["mfu"])
+        _sinks.event("goodput", **rec)
+        return rec
+    except Exception:
+        return None  # telemetry must never fail the epoch it measures
+
+
+def reset_for_tests() -> None:
+    """Drop any ledger left open by an aborted epoch (obs.reset_for_tests
+    calls this — a mid-epoch exception must not leak state across
+    tests)."""
+    global _current
+    with _lock:
+        _current = None

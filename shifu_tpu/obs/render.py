@@ -91,16 +91,12 @@ def parse_scrape_totals(text: str) -> dict[str, float]:
     return totals
 
 
-def summarize(path: str) -> Optional[dict]:
-    """The telemetry summary dict for a job/telemetry dir, or None when no
-    journal is found."""
-    jpath = find_journal(path)
-    if jpath is None:
-        return None
+def _load_events(jpath: str) -> list[dict]:
+    """One journal's events, with the supervisor's remote-dir sidecar
+    journal merged when present (two writers on one remote object would
+    erase each other — see obs/_sinks.configure); sort restores one
+    timeline."""
     events = journal_mod.read_journal(jpath)
-    # merge the supervisor's remote-dir sidecar journal, if present (two
-    # writers on one remote object would erase each other — see
-    # obs/_sinks.configure); sort restores one timeline
     sidecar = (jpath.rsplit("/", 1)[0] + "/journal-supervisor.jsonl"
                if "/" in jpath
                else os.path.join(os.path.dirname(jpath),
@@ -112,6 +108,16 @@ def summarize(path: str) -> Optional[dict]:
                                            r.get("seq") or 0))
         except Exception:
             pass
+    return events
+
+
+def summarize(path: str) -> Optional[dict]:
+    """The telemetry summary dict for a job/telemetry dir, or None when no
+    journal is found."""
+    jpath = find_journal(path)
+    if jpath is None:
+        return None
+    events = _load_events(jpath)
     kinds: dict[str, int] = {}
     epochs: list[dict] = []
     run: dict = {}
@@ -188,4 +194,148 @@ def render_text(summary: dict) -> str:
     if last:
         lines.append(f"last event: {last.get('kind')} at ts "
                      f"{last.get('ts')}")
+    return "\n".join(lines)
+
+
+# -- `shifu-tpu profile`: the goodput / XLA-cost view ----------------------
+
+def profile_summary(path: str) -> Optional[dict]:
+    """The performance-profile dict for a job/telemetry dir: per-epoch
+    goodput bucket records, compiled functions aggregated by cost, and
+    the recovery tax (restore / fallback / preemption-grace seconds) —
+    assembled purely from `goodput` / `xla_compile` / checkpoint journal
+    events (docs/PERF.md "Goodput & MFU").  None when no journal."""
+    jpath = find_journal(path)
+    if jpath is None:
+        return None
+    events = _load_events(jpath)
+
+    epochs: list[dict] = []
+    compiles: dict[str, dict] = {}
+    recovery = {"restore_s": 0.0, "restores": 0, "fallbacks": 0,
+                "preemption_graces": 0, "resumes": 0}
+    for rec in events:
+        kind = rec.get("kind")
+        if kind == "goodput":
+            epochs.append({k: rec.get(k) for k in
+                           ("epoch", "wall_s", "buckets", "goodput_fraction",
+                            "mfu", "achieved_tflops", "peak_tflops",
+                            "compiles")})
+        elif kind == "xla_compile":
+            fn = str(rec.get("fn", "?"))
+            c = compiles.setdefault(fn, {"compiles": 0, "compile_s": 0.0,
+                                         "cache": {}})
+            c["compiles"] += 1
+            try:
+                c["compile_s"] = round(
+                    c["compile_s"] + float(rec.get("compile_s") or 0), 6)
+            except (TypeError, ValueError):
+                pass
+            cache = str(rec.get("cache") or "off")
+            c["cache"][cache] = c["cache"].get(cache, 0) + 1
+            for k in ("flops", "bytes_accessed", "peak_bytes"):
+                if rec.get(k) is not None:
+                    c[k] = rec[k]  # last capture wins (latest signature)
+        elif kind == "checkpoint_restore":
+            recovery["restores"] += 1
+            try:
+                recovery["restore_s"] = round(
+                    recovery["restore_s"] + float(rec.get("dur_s") or 0), 6)
+            except (TypeError, ValueError):
+                pass
+        elif kind == "checkpoint_fallback":
+            recovery["fallbacks"] += 1
+        elif kind == "preemption_grace":
+            recovery["preemption_graces"] += 1
+        elif kind == "train_resume":
+            recovery["resumes"] += 1
+
+    totals: dict[str, float] = {}
+    fracs, mfus = [], []
+    for e in epochs:
+        for b, s in (e.get("buckets") or {}).items():
+            if isinstance(s, (int, float)):
+                totals[b] = round(totals.get(b, 0.0) + s, 6)
+        if isinstance(e.get("goodput_fraction"), (int, float)):
+            fracs.append(e["goodput_fraction"])
+        if isinstance(e.get("mfu"), (int, float)):
+            mfus.append(e["mfu"])
+    out = {
+        "journal": jpath,
+        "epochs": epochs,
+        "bucket_totals_s": totals,
+        "goodput_fraction_mean": (round(sum(fracs) / len(fracs), 4)
+                                  if fracs else None),
+        "mfu_max": (round(max(mfus), 6) if mfus else None),
+        # by cost: captured FLOPs first (the honest "expensive" ranking),
+        # compile seconds as the tiebreak/no-capture fallback
+        "compiled_functions": dict(sorted(
+            compiles.items(),
+            key=lambda kv: (-(kv[1].get("flops") or 0),
+                            -kv[1]["compile_s"]))),
+        "recovery": recovery,
+    }
+    return out
+
+
+def render_profile_text(summary: dict) -> str:
+    """Human rendering of `profile_summary`'s dict: the per-epoch bucket
+    table, top compiled functions, and the recovery tax."""
+    lines = [f"journal: {summary['journal']}"]
+    epochs = summary.get("epochs") or []
+    if not epochs:
+        lines.append("no goodput events (run predates the ledger, or no "
+                     "epoch completed)")
+    else:
+        hdr = (f"{'epoch':>5} {'wall_s':>8} {'compile':>8} {'input':>8} "
+               f"{'step':>8} {'ckpt':>8} {'restore':>8} {'eval':>8} "
+               f"{'other':>8} {'goodput':>8} {'mfu':>8}")
+        lines.append(hdr)
+
+        def f(v, spec="0.3f"):
+            return format(v, spec) if isinstance(v, (int, float)) else "-"
+
+        for e in epochs:
+            b = e.get("buckets") or {}
+            lines.append(
+                f"{f(e.get('epoch'), 'd'):>5} {f(e.get('wall_s')):>8} "
+                f"{f(b.get('compile')):>8} {f(b.get('input')):>8} "
+                f"{f(b.get('step')):>8} {f(b.get('checkpoint')):>8} "
+                f"{f(b.get('restore')):>8} {f(b.get('eval')):>8} "
+                f"{f(b.get('other')):>8} "
+                f"{f(e.get('goodput_fraction'), '.1%'):>8} "
+                f"{f(e.get('mfu'), '.4f'):>8}")
+        mean_frac = summary.get("goodput_fraction_mean")
+        mfu_max = summary.get("mfu_max")
+        tail = [f"goodput mean {mean_frac:.1%}"
+                if isinstance(mean_frac, (int, float)) else "goodput mean -"]
+        if isinstance(mfu_max, (int, float)):
+            tail.append(f"mfu max {mfu_max:.4f}")
+        lines.append("  ".join(tail))
+    comp = summary.get("compiled_functions") or {}
+    if comp:
+        lines.append("compiled functions (by cost):")
+        for fn, c in comp.items():
+            parts = [f"  {fn}: {c['compiles']} compile(s) "
+                     f"{c['compile_s']:.3f}s"]
+            if c.get("flops") is not None:
+                parts.append(f"flops/dispatch {c['flops']:.3g}")
+            if c.get("bytes_accessed") is not None:
+                parts.append(f"bytes {c['bytes_accessed']:.3g}")
+            if c.get("peak_bytes") is not None:
+                parts.append(f"peak {c['peak_bytes']:.3g}B")
+            cache = c.get("cache") or {}
+            if cache:
+                parts.append("cache " + "/".join(
+                    f"{k}={v}" for k, v in sorted(cache.items())))
+            lines.append(" ".join(parts))
+    rec = summary.get("recovery") or {}
+    if any(rec.get(k) for k in ("restores", "fallbacks",
+                                "preemption_graces", "resumes")):
+        lines.append(
+            f"recovery: {rec.get('restores', 0)} restore(s) "
+            f"{rec.get('restore_s', 0.0):.3f}s, "
+            f"{rec.get('fallbacks', 0)} fallback(s), "
+            f"{rec.get('preemption_graces', 0)} preemption grace(s), "
+            f"{rec.get('resumes', 0)} resume(s)")
     return "\n".join(lines)

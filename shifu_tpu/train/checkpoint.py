@@ -355,6 +355,9 @@ def save(manager: ocp.CheckpointManager, step: int, state: Any,
     obs.event("checkpoint_save", step=int(step),
               epoch=(extra or {}).get("epoch"), mode=mode,
               dur_s=round(dur, 4))
+    # goodput ledger: save wall is epoch time NOT spent stepping (async
+    # saves credit only their dispatch — the overlap is the feature)
+    obs.goodput.note("checkpoint", dur)
 
 
 def finalize(manager: ocp.CheckpointManager) -> None:
@@ -381,6 +384,9 @@ def restore(manager: ocp.CheckpointManager, step: int, abstract_state: Any,
     obs.histogram("checkpoint_restore_seconds",
                   "checkpoint restore latency").observe(dur)
     obs.event("checkpoint_restore", step=int(step), dur_s=round(dur, 4))
+    # a mid-run restore (chaos recovery) lands in the active epoch's
+    # ledger; the pre-loop resume restore has no ledger open — no-op
+    obs.goodput.note("restore", dur)
     if with_extra:
         return out["state"], out.get("extra")
     return out["state"]

@@ -847,6 +847,11 @@ def train(job: JobConfig,
         # already landed); distinct from the CLI's post-epoch "train.epoch"
         chaos.maybe_fail("train.epoch_start", echo=console, epoch=epoch)
         t0 = time.perf_counter()
+        # goodput ledger (obs/goodput.py): this epoch's wall gets
+        # classified into compile/input/step/checkpoint/restore/eval/other
+        # buckets; instrumented compiles and checkpoint saves credit it
+        # from their own call sites while it is open
+        obs.goodput.begin_epoch()
         if pending_loader is not None and epoch > start_epoch:
             # first epoch after the streamed one: assemble the retained
             # dataset and resolve the input tiers for the rest of the job
@@ -1174,6 +1179,20 @@ def train(job: JobConfig,
             # epoch boundary is the safe SIGTERM drain point for the
             # on-device scan tiers (the epoch itself is one dispatch)
             maybe_midtrain_save(epoch + 1)
+
+        # close the goodput ledger over the FULL epoch wall (train + eval
+        # + saves): input is the consumer-visible wait (the gap the device
+        # sat idle before each dispatch — producer-side host_input_times
+        # overlap compute and are the straggler line's lens, not this
+        # one's), step is dispatch-to-done; compile/checkpoint/restore
+        # were credited in-flight; `other` absorbs the residue so the
+        # buckets always sum to the wall
+        led = obs.goodput.current()
+        if led is not None:
+            led.add("input", sum(timer.input_times))
+            led.add("step", sum(timer.step_times))
+            led.add("eval", valid_time)
+            obs.goodput.end_epoch(epoch, time.perf_counter() - t0)
 
         if epoch_callback is not None:
             epoch_callback(m)

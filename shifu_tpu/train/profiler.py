@@ -59,15 +59,29 @@ class StepTimer:
         hstep = obs.histogram(f"{prefix}_step_seconds",
                               "device step/chunk dispatch-to-done time")
         for v in self.input_times:
-            hin.observe(v, **labels)
+            if v == v and v != float("inf"):  # finite only, like summary()
+                hin.observe(v, **labels)
         for v in self.step_times:
-            hstep.observe(v, **labels)
+            if v == v and v != float("inf"):
+                hstep.observe(v, **labels)
 
     def summary(self) -> dict[str, float]:
         def stats(xs: list[float], prefix: str) -> dict[str, float]:
-            if not xs:
+            # finite samples only: one NaN timing (a clock hiccup, a
+            # poisoned mark) would otherwise propagate into EVERY field
+            # via mean/percentile, and a single-chunk epoch (the scan
+            # tiers dispatch once per epoch) must still produce a
+            # well-formed record — p50 == p99 == the sample, never NaN
+            arr = np.asarray([x for x in xs if x == x and x != float("inf")],
+                             dtype=np.float64)
+            if arr.size == 0:
                 return {}
-            arr = np.asarray(xs)
+            if arr.size == 1:
+                v_ms = float(arr[0]) * 1e3
+                return {f"{prefix}_mean_ms": v_ms,
+                        f"{prefix}_p50_ms": v_ms,
+                        f"{prefix}_p99_ms": v_ms,
+                        f"{prefix}_total_s": float(arr[0])}
             return {
                 f"{prefix}_mean_ms": float(arr.mean() * 1e3),
                 f"{prefix}_p50_ms": float(np.percentile(arr, 50) * 1e3),
@@ -77,9 +91,10 @@ class StepTimer:
         out = {}
         out.update(stats(self.input_times, "input"))
         out.update(stats(self.step_times, "step"))
-        if self.input_times and self.step_times:
-            total = sum(self.input_times) + sum(self.step_times)
-            out["input_fraction"] = float(sum(self.input_times) / max(total, 1e-9))
+        if "input_total_s" in out and "step_total_s" in out:
+            total = out["input_total_s"] + out["step_total_s"]
+            out["input_fraction"] = float(out["input_total_s"]
+                                          / max(total, 1e-9))
         return out
 
     def console_line(self) -> str:

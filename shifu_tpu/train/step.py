@@ -138,8 +138,9 @@ def make_train_step(job: JobConfig, mesh: Optional[Mesh] = None,
     # init_state, batches device_put by the loop with data-axis sharding);
     # XLA propagates them and inserts the grad all-reduce; `mesh` feeds
     # only the sparse apply's replication constraint and donation hints.
+    from ..obs.introspect import instrument_jit
     donate_argnums = (0,) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+    return instrument_jit(step, "train_step", donate_argnums=donate_argnums)
 
 
 def make_epoch_scan_step(job: JobConfig, mesh: Optional[Mesh] = None,
@@ -169,8 +170,10 @@ def make_epoch_scan_step(job: JobConfig, mesh: Optional[Mesh] = None,
             body, (state, jnp.float32(0.0)), blocks)
         return state2, acc
 
+    from ..obs.introspect import instrument_jit
     donate_argnums = (0,) if donate else ()
-    return jax.jit(epoch_step, donate_argnums=donate_argnums)
+    return instrument_jit(epoch_step, "epoch_scan_step",
+                          donate_argnums=donate_argnums)
 
 
 def make_device_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
@@ -204,8 +207,10 @@ def make_device_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
         (state2, acc), _ = jax.lax.scan(body, (state, jnp.float32(0.0)), order)
         return state2, acc
 
+    from ..obs.introspect import instrument_jit
     donate_argnums = (0,) if donate else ()
-    return jax.jit(epoch_step, donate_argnums=donate_argnums)
+    return instrument_jit(epoch_step, "device_epoch_step",
+                          donate_argnums=donate_argnums)
 
 
 def make_local_sgd_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
@@ -348,12 +353,15 @@ def make_local_sgd_epoch_step(job: JobConfig, mesh: Optional[Mesh] = None,
             param_shardings.append([leaf_shardings(l) for l in flat])
             param_shardings.append(treedef)
             cache["shardings"] = observed
+            from ..obs.introspect import instrument_jit
             if with_order:
-                cache["fn"] = jax.jit(epoch_step,
-                                      donate_argnums=donate_argnums)
+                cache["fn"] = instrument_jit(epoch_step,
+                                             "local_sgd_epoch_step",
+                                             donate_argnums=donate_argnums)
             else:
-                cache["fn"] = jax.jit(
+                cache["fn"] = instrument_jit(
                     lambda st, bl: epoch_step(st, bl),
+                    "local_sgd_epoch_step",
                     donate_argnums=donate_argnums)
         if with_order:
             return cache["fn"](state, blocks, order)
@@ -375,7 +383,8 @@ def make_eval_step(job: JobConfig) -> Callable[[TrainState, Batch], jax.Array]:
         logits = state.apply_fn({"params": state.params}, feats)
         return jax.nn.sigmoid(logits)
 
-    return jax.jit(score)
+    from ..obs.introspect import instrument_jit
+    return instrument_jit(score, "eval_step")
 
 
 def make_forward_fn(job: JobConfig,

@@ -12,10 +12,49 @@ staged epoch program on a v5e chip).
 from __future__ import annotations
 
 import os
+import threading
+from typing import Optional
 
 ENV_DISABLE = "SHIFU_TPU_NO_COMPILE_CACHE"
 ENV_DIR = "JAX_COMPILATION_CACHE_DIR"
 DEFAULT_DIR = "~/.cache/shifu_tpu/xla"
+
+# persistent-cache observation state (obs/introspect.py classifies each
+# XLA compile as hit/miss from the entry-set delta): the directory the
+# cache was enabled at, and the entries seen at the last observation
+_lock = threading.Lock()
+_active_dir: Optional[str] = None
+_seen_entries: frozenset[str] = frozenset()
+
+
+def active_dir() -> Optional[str]:
+    """The persistent-cache directory in use this process, or None."""
+    return _active_dir
+
+
+def _list_entries(path: str) -> frozenset[str]:
+    try:
+        return frozenset(os.listdir(path))
+    except OSError:
+        return frozenset()
+
+
+def observe_compile() -> str:
+    """Classify the XLA compile that just finished against the
+    persistent cache: "off" (cache disabled), "miss" (a new cache entry
+    appeared — this compile was real work, now persisted), or "hit"
+    (no new entry: either deserialized from the cache or below the
+    persistence thresholds — small/fast programs are never written, so
+    "hit" is an upper bound; docs/OBSERVABILITY.md).  Updates the seen
+    set so back-to-back compiles classify independently."""
+    global _seen_entries
+    if _active_dir is None:
+        return "off"
+    with _lock:
+        now = _list_entries(_active_dir)
+        fresh = now - _seen_entries
+        _seen_entries = now
+    return "miss" if fresh else "hit"
 
 
 def enable_persistent_cache(directory: str | None = None) -> str | None:
@@ -29,6 +68,7 @@ def enable_persistent_cache(directory: str | None = None) -> str | None:
         return None
     path = directory or os.environ.get(ENV_DIR) or os.path.expanduser(
         DEFAULT_DIR)
+    global _active_dir, _seen_entries
     try:
         os.makedirs(path, exist_ok=True)
         import jax
@@ -37,6 +77,9 @@ def enable_persistent_cache(directory: str | None = None) -> str | None:
         # multi-second compiles this cache exists for
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        with _lock:
+            _active_dir = path
+            _seen_entries = _list_entries(path)
     except Exception:
         return None  # cache is an optimization, never a failure
     try:

@@ -1,0 +1,224 @@
+"""Perf-regression gate: diff a fresh bench artifact against the latest
+BENCH baseline and exit nonzero on regression.
+
+The repo's first *enforceable* perf trajectory (ISSUE 3): every round the
+driver captures a `BENCH_r*.json`; this gate compares a freshly produced
+`bench_full.json` against the newest of those baselines on three axes —
+
+- **throughput / step time**: the headline resident-tier
+  samples/sec/chip (`value`) must not fall below
+  `--value-threshold` (default 0.3) of the baseline.  The wide default
+  is deliberate: the bench rig's shared tunnel swings 2-3x with
+  co-tenant load (docs/PERF.md "How bench.py measures"), so the
+  default sits just OUTSIDE that noise band — the gate catches
+  collapses, not noise; tighten it on a dedicated host.
+- **goodput fraction**: the e2e tiers' mean device-step fraction of
+  wall (`goodput.goodput_fraction_mean`, emitted by bench.py from the
+  goodput ledger) must not drop more than `--goodput-drop` (absolute,
+  default 0.1) below the baseline.
+- **compile count**: total observed XLA compiles
+  (`xla_compiles.total`) must not exceed `baseline * --compile-factor
+  + 2` — a recompile explosion (a shape leak, a lost cache) is a perf
+  bug even when the steady-state rate survives it.
+
+Checks whose fields are missing on either side are SKIPPED (pre-ledger
+baselines carry no goodput/compile fields), never failed.
+
+`--check-only` is the tier-1 spelling (wired via
+tests/test_introspect.py, `perf` marker): a missing or corrupt baseline
+/ fresh artifact degrades to a journaled warning (`perf_gate_warning`
+when SHIFU_TPU_METRICS_DIR is configured) and exit 0 — the gate must
+never hard-fail a checkout that simply has no bench artifacts yet.
+Without it, missing inputs exit 2 (usage error, distinct from a real
+regression's 1).
+
+Usage:
+    python tools/perf_gate.py                       # repo-root defaults
+    python tools/perf_gate.py --fresh bench_full.json \
+        --baseline BENCH_r05.json [--json] [--check-only]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+EXIT_PASS = 0
+EXIT_REGRESSION = 1
+EXIT_USAGE = 2
+
+
+def find_latest_baseline(root: str = _REPO) -> str | None:
+    """Newest BENCH_r*.json by round number (the driver's capture)."""
+    best, best_n = None, -1
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m and int(m.group(1)) > best_n:
+            best, best_n = path, int(m.group(1))
+    return best
+
+
+def load_artifact(path: str) -> dict:
+    """A bench artifact dict, whichever wrapper it arrived in: the
+    driver's capture ({"parsed": {...headline...}}), bench_full.json
+    (the full dict), or a raw headline dict."""
+    with open(path) as f:
+        d = json.load(f)
+    if not isinstance(d, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    parsed = d.get("parsed")
+    if isinstance(parsed, dict) and "value" in parsed:
+        return parsed
+    if "value" not in d and "goodput" not in d:
+        raise ValueError(f"{path}: no bench fields (value/goodput) found")
+    return d
+
+
+def _num(d: dict, *keys):
+    cur = d
+    for k in keys:
+        if not isinstance(cur, dict) or k not in cur:
+            return None
+        cur = cur[k]
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
+             goodput_drop: float = 0.1,
+             compile_factor: float = 2.0) -> dict:
+    """The comparison itself (pure — unit-tested on synthetic pairs).
+    Returns {"checks": [...], "verdict": "PASS"|"REGRESSION"}."""
+    checks: list[dict] = []
+
+    def check(name, fresh_v, base_v, ok, limit) -> None:
+        checks.append({"name": name, "fresh": fresh_v, "baseline": base_v,
+                       "limit": limit,
+                       "status": ("SKIP" if ok is None
+                                  else "OK" if ok else "REGRESSION")})
+
+    fv, bv = _num(fresh, "value"), _num(baseline, "value")
+    if fv is None or bv is None or bv <= 0:
+        check("throughput_samples_per_sec_per_chip", fv, bv, None, None)
+    else:
+        limit = bv * value_threshold
+        check("throughput_samples_per_sec_per_chip", fv, bv,
+              fv >= limit, round(limit, 1))
+
+    fg = _num(fresh, "goodput", "goodput_fraction_mean")
+    bg = _num(baseline, "goodput", "goodput_fraction_mean")
+    if fg is None or bg is None:
+        check("goodput_fraction_mean", fg, bg, None, None)
+    else:
+        limit = bg - goodput_drop
+        check("goodput_fraction_mean", fg, bg, fg >= limit, round(limit, 4))
+
+    fc = _num(fresh, "xla_compiles", "total")
+    bc = _num(baseline, "xla_compiles", "total")
+    if fc is None or bc is None:
+        check("xla_compile_count", fc, bc, None, None)
+    else:
+        limit = bc * compile_factor + 2
+        check("xla_compile_count", fc, bc, fc <= limit, round(limit, 1))
+
+    regressed = [c for c in checks if c["status"] == "REGRESSION"]
+    return {"checks": checks,
+            "verdict": "REGRESSION" if regressed else "PASS"}
+
+
+def _journal(kind: str, **fields) -> None:
+    """Best-effort journal hook: lands in SHIFU_TPU_METRICS_DIR when
+    configured, silently no-ops otherwise (the gate must work in a bare
+    checkout with no telemetry and no jax)."""
+    try:
+        from shifu_tpu import obs
+        if obs.configure_from_env():
+            obs.event(kind, **fields)
+            obs.flush()
+    except Exception:
+        pass
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="perf_gate",
+        description="compare a fresh bench artifact against the latest "
+                    "BENCH_r*.json baseline; exit 1 on regression")
+    p.add_argument("--fresh", default=os.path.join(_REPO, "bench_full.json"),
+                   help="fresh bench artifact (default: repo bench_full.json)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline artifact (default: newest BENCH_r*.json)")
+    p.add_argument("--value-threshold", type=float, default=0.3,
+                   help="fresh throughput must be >= baseline * this "
+                        "fraction (default 0.3 — just outside the shared "
+                        "tunnel's documented 2-3x noise band)")
+    p.add_argument("--goodput-drop", type=float, default=0.1,
+                   help="max absolute drop in mean goodput fraction")
+    p.add_argument("--compile-factor", type=float, default=2.0,
+                   help="fresh compile count must be <= baseline * this + 2")
+    p.add_argument("--check-only", action="store_true",
+                   help="tier-1 mode: missing/corrupt artifacts degrade to "
+                        "a journaled warning and exit 0")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report instead of text")
+    args = p.parse_args(argv)
+
+    baseline_path = args.baseline or find_latest_baseline()
+    problems = []
+    fresh = baseline = None
+    if baseline_path is None:
+        problems.append("no BENCH_r*.json baseline found")
+    else:
+        try:
+            baseline = load_artifact(baseline_path)
+        except (OSError, ValueError) as e:
+            problems.append(f"baseline unreadable: {e}")
+    try:
+        fresh = load_artifact(args.fresh)
+    except (OSError, ValueError) as e:
+        problems.append(f"fresh artifact unreadable: {e}")
+
+    if problems:
+        msg = "; ".join(problems)
+        if args.check_only:
+            # degraded, not failed: a checkout with no bench artifacts
+            # (or a half-written one) must never fail tier-1
+            _journal("perf_gate_warning", problems=problems)
+            report = {"verdict": "SKIPPED", "problems": problems}
+            print(json.dumps(report) if args.json
+                  else f"perf-gate: SKIPPED — {msg}")
+            return EXIT_PASS
+        print(f"perf-gate: {msg}", file=sys.stderr, flush=True)
+        return EXIT_USAGE
+
+    report = run_gate(fresh, baseline,
+                      value_threshold=args.value_threshold,
+                      goodput_drop=args.goodput_drop,
+                      compile_factor=args.compile_factor)
+    report["fresh"] = args.fresh
+    report["baseline"] = baseline_path
+    _journal("perf_gate", verdict=report["verdict"],
+             baseline=os.path.basename(baseline_path),
+             checks={c["name"]: c["status"] for c in report["checks"]})
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(f"perf-gate: {report['verdict']} "
+              f"(fresh {args.fresh} vs baseline "
+              f"{os.path.basename(baseline_path)})")
+        for c in report["checks"]:
+            print(f"  {c['status']:>10}  {c['name']}: "
+                  f"fresh={c['fresh']} baseline={c['baseline']} "
+                  f"limit={c['limit']}")
+    return (EXIT_PASS if report["verdict"] == "PASS" else EXIT_REGRESSION)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

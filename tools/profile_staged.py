@@ -5,6 +5,15 @@ staged epoch: host block assembly (gather+cast), device_put, and the scan
 dispatch — plus epoch walls and the raw H2D probe — so the missing
 roofline fraction can be attributed to a specific phase instead of
 guessed at.  Run on the tunneled TPU: `python tools/profile_staged.py`.
+
+Results ride the unified telemetry layer (ISSUE 3): each format emits
+ONE `goodput` journal event (`source="profile_staged"`, the inline
+phase seconds mapped onto the ledger's input/step buckets) and the
+instrumented scan programs journal their own `xla_compile` events — so
+`shifu-tpu profile <dir>` renders a profiling session exactly like a
+training run.  With SHIFU_TPU_METRICS_DIR set the journal lands there;
+otherwise the collected events print as JSONL at the end
+(docs/PERF.md "Goodput & MFU").
 """
 
 from __future__ import annotations
@@ -29,6 +38,17 @@ def main() -> None:
     from shifu_tpu.utils.compilecache import enable_persistent_cache
 
     enable_persistent_cache()
+
+    # telemetry sinks: SHIFU_TPU_METRICS_DIR when set (journal + scrape on
+    # disk, like a training job), else an in-memory journal whose records
+    # print as JSONL at the end — structured either way, no ad-hoc prints
+    from shifu_tpu import obs
+    metrics_dir = obs.resolve_metrics_dir()
+    if metrics_dir:
+        obs.configure(metrics_dir)
+    else:
+        obs.set_journal(obs.RunJournal(None))
+
     num_features = 30
     batch_size = 98304
     schema = synthetic.make_schema(num_features=num_features)
@@ -59,7 +79,8 @@ def main() -> None:
     # raw H2D probe (both before and after, to see drift)
     from bench import _h2d_bandwidth_bytes_per_sec
     h2d0 = _h2d_bandwidth_bytes_per_sec()
-    print(f"h2d probe (before): {h2d0/1e6:.1f} MB/s", flush=True)
+    obs.event("h2d_probe", when="before",
+              mb_per_sec=round(h2d0 / 1e6, 1))
 
     results = {}
     for name, wire, compact in (("bf16", "auto", False),
@@ -156,15 +177,45 @@ def main() -> None:
             "wall_prefetch_s": [round(w, 3) for w in walls],
             "rate_prefetch": round(rows / best / n_chips, 1),
         }
-        print(name, json.dumps(results[name]), flush=True)
+        # the inline epoch's phases mapped onto the ledger's buckets
+        # (obs/goodput.py): assemble+put are host input work the device
+        # waited on (the inline epoch runs the producer serially by
+        # design), dispatch+sync is device step time
+        input_s = sum(phase["assemble"]) + sum(phase["put"])
+        step_s = sum(phase["dispatch"]) + sum(phase["sync"])
+        obs.event(
+            "goodput", source="profile_staged", wire=name,
+            wall_s=round(wall_inline, 6),
+            buckets={"compile": 0.0, "input": round(input_s, 6),
+                     "step": round(step_s, 6), "checkpoint": 0.0,
+                     "restore": 0.0, "eval": 0.0,
+                     "other": round(max(wall_inline - input_s - step_s,
+                                        0.0), 6)},
+            goodput_fraction=(round(step_s / wall_inline, 4)
+                              if wall_inline > 0 else None),
+            mfu=None, **results[name])
 
     h2d1 = _h2d_bandwidth_bytes_per_sec()
-    print(f"h2d probe (after): {h2d1/1e6:.1f} MB/s", flush=True)
+    obs.event("h2d_probe", when="after", mb_per_sec=round(h2d1 / 1e6, 1))
     for name, r in results.items():
-        for h2d in (h2d0, h2d1):
-            frac = r["rate_prefetch"] * n_chips * r["row_bytes"] / h2d
-            print(f"{name}: roofline_fraction={frac:.3f} "
-                  f"@ {h2d/1e6:.1f} MB/s")
+        # explicit before/after keys: probe-derived key names would
+        # collide (and drop one fraction) whenever the two probes round
+        # to the same MB/s — exactly the no-drift case
+        frac = lambda h2d: (round(r["rate_prefetch"] * n_chips
+                                  * r["row_bytes"] / h2d, 3)
+                            if h2d > 0 else None)
+        obs.event("staged_roofline", wire=name,
+                  fraction_at_before_probe=frac(h2d0),
+                  fraction_at_after_probe=frac(h2d1),
+                  before_mb_per_sec=round(h2d0 / 1e6, 1),
+                  after_mb_per_sec=round(h2d1 / 1e6, 1))
+    obs.flush()
+    j = obs.get_journal()
+    if j is not None and j.path is None:
+        for rec in j.records:  # no metrics dir: the JSONL goes to stdout
+            print(json.dumps(rec), flush=True)
+    elif j is not None:
+        print(f"telemetry written to {j.path}", flush=True)
 
 
 if __name__ == "__main__":
