@@ -1194,6 +1194,21 @@ def main() -> None:
                                        for c in cstats.values()), 3),
                 "by_fn": {k: c["compiles"] for k, c in sorted(cstats.items())},
             }
+        # overlap engine accounting (the e2e tiers are the only train()
+        # runs in this process, so the registry totals ARE the e2e
+        # numbers): fraction of the host input work the cross-epoch feeder
+        # hid behind device compute — the direct measure of whether the
+        # epoch loop re-serialized (tools/perf_gate.py guards the ceiling
+        # fraction this drives)
+        ohid = obs.default_registry().counter(
+            "overlap_hidden_seconds_total").value(kind="input")
+        oexp = obs.default_registry().counter(
+            "overlap_exposed_seconds_total").value(kind="input")
+        if ohid + oexp > 0:
+            extras["e2e_overlap_hidden_fraction"] = round(
+                ohid / (ohid + oexp), 4)
+            extras["e2e_overlap_hidden_seconds"] = round(ohid, 3)
+            extras["e2e_overlap_exposed_seconds"] = round(oexp, 3)
     except Exception:
         pass
     full = {
@@ -1240,6 +1255,7 @@ _HEADLINE_OPTIONAL = (
     "mfu",
     "e2e_cached_disk_samples_per_sec_per_chip",
     "e2e_cached_disk_fraction_of_ceiling",
+    "e2e_overlap_hidden_fraction",
     "e2e_cold_disk_samples_per_sec_per_chip",
     "e2e_h2d_ceiling_int8_samples_per_sec_per_chip",
     "e2e_h2d_ceiling_samples_per_sec_per_chip",

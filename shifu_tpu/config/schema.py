@@ -103,6 +103,23 @@ class DataConfig:
     shuffle: bool = True
     drop_remainder: bool = True     # static shapes for XLA
     prefetch: int = 2
+    # host-side queue depth of the input feeders: the streamed first
+    # epoch's parse-result queue and the overlap engine's host staging
+    # queue (data/pipeline.EpochFeeder) both run this many items ahead.
+    # Distinct from `prefetch`, which bounds DEVICE-resident blocks (HBM);
+    # this knob bounds host RAM held by assembled-but-unstaged chunks.
+    # 0 = auto: the feeder instead resizes its DEVICE staging gate per
+    # epoch from the goodput ledger's exposed-input measurement
+    # (data/pipeline.next_prefetch_depth — HBM-side run-ahead between 2
+    # and 8 chunks, superseding `prefetch`; the host queue stays at 4).
+    prefetch_depth: int = 4
+    # cross-epoch overlap engine (train/loop.py + data/pipeline.EpochFeeder):
+    # a persistent feeder shuffles and assembles epoch N+1's batches on host
+    # threads while epoch N still executes on device, and next-epoch work
+    # overlaps the eval dispatch tail — batch order stays a pure function of
+    # (seed, epoch), byte-identical to the non-overlapped order.  False
+    # restores the per-epoch producer thread (stop-the-world boundaries).
+    overlap_epochs: bool = True
     # staged epochs: device-put (block_batches, B, F) blocks once and
     # lax.scan the train step on device — one H2D transfer per block instead
     # of per batch; the 10M+ samples/sec input path (SURVEY.md section 7.3)
@@ -167,6 +184,10 @@ class DataConfig:
             raise ConfigError(f"valid_ratio must be in [0,1): {self.valid_ratio}")
         if self.batch_size <= 0:
             raise ConfigError("batch_size must be positive")
+        if self.prefetch_depth < 0:
+            raise ConfigError(
+                f"prefetch_depth must be >= 0 (0 = auto): "
+                f"{self.prefetch_depth}")
         if self.wire_dtype not in ("auto", "float32", "bfloat16", "int8"):
             raise ConfigError(
                 f"wire_dtype must be auto/float32/bfloat16/int8: "

@@ -451,7 +451,10 @@ class StreamingLoader:
 
         import queue
         import threading
-        self._q: "queue.Queue" = queue.Queue(maxsize=4)
+        # parse-result queue depth: DataConfig.prefetch_depth (auto=0 keeps
+        # the historical 4 — the parse queue has no per-epoch ledger to
+        # adapt from; only the cross-epoch feeder resizes itself)
+        self._q: "queue.Queue" = queue.Queue(maxsize=data.prefetch_depth or 4)
         self._abort = False  # see abort_blocks()
         self._thread = threading.Thread(target=self._produce, daemon=True)
         self._thread.start()
@@ -655,6 +658,30 @@ class StreamingLoader:
         return self._datasets
 
 
+def epoch_permutation(n: int, *, shuffle: bool = True, seed: int = 0,
+                      epoch: int = 0) -> np.ndarray:
+    """THE per-epoch order stream — a pure function of (seed, epoch), so
+    every host and every restart agrees.  Single-sourced: batch_iterator
+    (row order), staged_epoch_blocks (block order), the device-resident
+    tier (train/loop.py), and epoch_order_digest all draw from HERE, so
+    the journaled order fingerprint can never silently drift from the
+    order the tiers actually train in."""
+    if not shuffle:
+        return np.arange(n)
+    return np.random.default_rng(
+        np.random.PCG64(seed * 1_000_003 + epoch)).permutation(n)
+
+
+def staged_epoch_offset(num_rows: int, batch_size: int, *,
+                        shuffle: bool = True, epoch: int = 0) -> int:
+    """The staged tier's per-epoch row-offset rotation (batch composition
+    drifts across epochs when rows don't divide the batch evenly) —
+    single-sourced next to epoch_permutation for the same reason."""
+    nb_total = num_rows // batch_size
+    slack = num_rows - nb_total * batch_size
+    return (epoch * 997) % (slack + 1) if (shuffle and slack > 0) else 0
+
+
 def batch_iterator(
     ds: TabularDataset,
     batch_size: int,
@@ -673,11 +700,7 @@ def batch_iterator(
     n = ds.num_rows
     if n == 0:
         return
-    if shuffle:
-        rng = np.random.default_rng(np.random.PCG64(seed * 1_000_003 + epoch))
-        order = rng.permutation(n)
-    else:
-        order = np.arange(n)
+    order = epoch_permutation(n, shuffle=shuffle, seed=seed, epoch=epoch)
     num_full = n // batch_size
     end = num_full * batch_size if drop_remainder else n
     for start in range(0, end, batch_size):
@@ -753,6 +776,348 @@ def prefetch_to_device(batches: Iterator[dict[str, np.ndarray]],
         yield item
 
 
+def next_prefetch_depth(current: int, exposed_fraction: float,
+                        lo: int = 2, hi: int = 8) -> int:
+    """Auto prefetch-depth policy (DataConfig.prefetch_depth == 0): one
+    step per epoch, driven by the goodput ledger's exposed-input fraction
+    (the share of the epoch wall the device sat waiting for input).
+    Resizes the feeder's DEVICE staging gate — the HBM-side run-ahead
+    (the host queue keeps its fixed depth).  Visible starvation doubles
+    the depth — a starved consumer needs more run-ahead NOW, and a
+    half-step would leave it starved for several more epochs; a fully
+    hidden input path decays one step per epoch toward `lo`, releasing
+    the HBM the extra staged chunks pin.  `hi`=8 bounds worst-case
+    run-ahead to 8 chunks (~32 MB wire each — ~256 MB HBM), a deliberate
+    ceiling since this gate supersedes DataConfig.prefetch in auto mode."""
+    if exposed_fraction > 0.05:
+        return min(max(current * 2, lo), hi)
+    if exposed_fraction < 0.01 and current > lo:
+        return current - 1
+    return current
+
+
+def epoch_order_digest(tier: str, num_rows: int, batch_size: int, *,
+                       shuffle: bool = True, seed: int = 0,
+                       epoch: int = 0) -> Optional[str]:
+    """blake2b hex digest of THE batch order a tier draws for (seed, epoch)
+    — the restart/resume determinism contract made checkable: overlap on
+    vs off, and a resumed epoch vs the uninterrupted run, must journal the
+    same digest (`overlap_report.order_digest`).
+
+    Built from the SAME epoch_permutation / staged_epoch_offset the tiers
+    themselves draw from (pinned against the real iterators by
+    tests/test_overlap.py): `staged` = block permutation + row-offset
+    rotation (staged_epoch_blocks); `batch` = batch_iterator's row
+    permutation; `resident` = the train loop's block order.  None when
+    the tier has no deterministic (seed, epoch) order (the streamed
+    first epoch trains in file-arrival order)."""
+    import hashlib
+
+    if tier == "staged":
+        nb_total = num_rows // batch_size
+        if nb_total == 0:
+            return None
+        offset = staged_epoch_offset(num_rows, batch_size, shuffle=shuffle,
+                                     epoch=epoch)
+        order = epoch_permutation(nb_total, shuffle=shuffle, seed=seed,
+                                  epoch=epoch)
+        payload = np.concatenate([[offset], order]).astype(np.int64)
+    elif tier in ("batch", "resident"):
+        n = num_rows if tier == "batch" else num_rows // batch_size
+        if n == 0:
+            return None
+        payload = np.asarray(epoch_permutation(n, shuffle=shuffle, seed=seed,
+                                               epoch=epoch), np.int64)
+    else:
+        return None  # "stream" and unknown tiers: no (seed, epoch) order
+    return hashlib.blake2b(payload.tobytes(), digest_size=16).hexdigest()
+
+
+class _DepthGate:
+    """Resizable counting gate bounding the feeder's device queue: the
+    placement thread acquires a slot per staged item, the consumer releases
+    one per item drained.  A plain Queue(maxsize=) cannot do this — the
+    auto mode resizes the bound BETWEEN epochs (next_prefetch_depth), and
+    queue maxsize is fixed at construction.  Shrinking records a deficit
+    that absorbs future releases instead of blocking anyone."""
+
+    def __init__(self, depth: int):
+        import threading
+        self._sem = threading.Semaphore(depth)
+        self._lock = threading.Lock()
+        self._deficit = 0
+        self.depth = depth
+
+    def acquire(self, timeout: float) -> bool:
+        return self._sem.acquire(timeout=timeout)
+
+    def release(self) -> None:
+        with self._lock:
+            if self._deficit > 0:
+                self._deficit -= 1
+                return
+        self._sem.release()
+
+    def resize(self, depth: int) -> None:
+        with self._lock:
+            delta = depth - self.depth
+            self.depth = depth
+            if delta < 0:
+                self._deficit += -delta
+                return
+            # pay down an outstanding shrink deficit BEFORE releasing new
+            # permits: a cancelled absorption already restores one unit of
+            # future capacity, and releasing on top of it would transiently
+            # admit more in-flight items than the new bound
+            paid = min(self._deficit, delta)
+            self._deficit -= paid
+            delta -= paid
+        for _ in range(delta):
+            self._sem.release()
+
+
+class FeederError(RuntimeError):
+    """The persistent feeder died without delivering its epoch — raised in
+    the CONSUMER so a dead producer thread fails the epoch loudly instead
+    of deadlocking the queue (docs/ROBUSTNESS.md site `data.feeder`)."""
+
+
+class EpochFeeder:
+    """Persistent cross-epoch input feeder — the overlap engine's producer
+    side (docs/PERF.md "Overlap engine").
+
+    Replaces the per-epoch producer thread prefetch_to_device spins up:
+    ONE pair of host threads lives for the whole job and runs ahead across
+    epoch boundaries, so epoch N+1's shuffle + block assembly (and its
+    first device_put staging) happen while epoch N is still executing on
+    device and while its eval dispatch tail drains — the serialized wall
+    between epochs the reference's train→eval→shuffle loop paid every
+    epoch (ssgd_monitor.py-style).  Two pipeline stages double-buffer the
+    H2D staging itself:
+
+      assembly thread:  epoch_source(epoch) → host items   (shuffle+gather)
+      placement thread: put_fn(item) → device items        (cast+device_put)
+
+    so chunk k+1 assembles while chunk k stages.  Determinism is untouched:
+    `epoch_source` draws each epoch's order as a pure function of
+    (seed, epoch) exactly as the per-epoch path did, and items are
+    delivered strictly in epoch order — a restart/resume consumes
+    byte-identical batches (pinned by tests/test_overlap.py).
+
+    Bounds: the host staging queue holds `host_depth` assembled chunks
+    (DataConfig.prefetch_depth; host RAM), the device queue `depth` staged
+    chunks (DataConfig.prefetch; HBM).  `set_depth` resizes the device
+    bound between epochs (the auto mode, next_prefetch_depth).
+
+    Failure contract: an assembly/placement exception (including the
+    `data.feeder` chaos probe, evaluated at each epoch's assembly start)
+    is forwarded and re-raised in the consumer; a thread that dies without
+    a sentinel raises FeederError at the consumer's next poll — never a
+    silent deadlock.  `close()` (idempotent; the train loop's finally)
+    aborts both threads and discards anything produced ahead."""
+
+    _POLL_S = 0.1
+
+    def __init__(self, epoch_source, put_fn, epochs, *,
+                 depth: int = 2, host_depth: int = 4):
+        import queue
+        import threading
+
+        self._source = epoch_source
+        self._put_fn = put_fn
+        self._epochs = list(epochs)
+        self._abort = threading.Event()
+        self._hostq: "queue.Queue" = queue.Queue(maxsize=max(host_depth, 1))
+        self._devq: "queue.Queue" = queue.Queue()  # bounded by _gate
+        self._gate = _DepthGate(max(depth, 1))
+        self._staged_lock = threading.Lock()
+        self._staged = 0  # 'item' records in devq (sentinels excluded)
+        self._prod_s: dict[int, float] = {}  # epoch -> host seconds
+        self._lat = obs.histogram(
+            "data_batch_latency_seconds",
+            "host batch production + device placement latency")
+        self._threads = [
+            threading.Thread(target=self._assemble, daemon=True,
+                             name="shifu-feeder-assemble"),
+            threading.Thread(target=self._place, daemon=True,
+                             name="shifu-feeder-place"),
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- producer side ------------------------------------------------------
+
+    def _put(self, q, item) -> bool:
+        import queue as queue_lib
+        while not self._abort.is_set():
+            try:
+                q.put(item, timeout=self._POLL_S)
+                return True
+            except queue_lib.Full:
+                continue
+        return False
+
+    def _assemble(self) -> None:
+        from .. import chaos
+        try:
+            for ep in self._epochs:
+                if self._abort.is_set():
+                    return
+                # chaos site "data.feeder": the feeder thread boundary —
+                # a raise here must fail the epoch in the CONSUMER
+                chaos.maybe_fail("data.feeder", epoch=ep)
+                prod = 0.0
+                t0 = time.perf_counter()
+                for item in self._source(ep):
+                    prod += time.perf_counter() - t0
+                    if not self._put(self._hostq, ("item", ep, item, prod)):
+                        return
+                    prod = 0.0
+                    t0 = time.perf_counter()
+                prod += time.perf_counter() - t0
+                if not self._put(self._hostq, ("end", ep, None, prod)):
+                    return
+            self._put(self._hostq, ("done", None, None, 0.0))
+        except BaseException as e:  # forwarded, re-raised by the consumer
+            self._put(self._hostq, ("error", None, e, 0.0))
+
+    def _host_get(self):
+        """Next host-queue record, or None when assembly is gone for good.
+        The dead-thread check re-polls the queue non-blocking FIRST: the
+        assembly thread's final sentinel ('done'/'error') may land between
+        a get timeout and its exit, and returning on liveness alone would
+        drop it — the consumer would then see a generic FeederError instead
+        of the original error (same defense _get applies device-side)."""
+        import queue as queue_lib
+        while not self._abort.is_set():
+            try:
+                return self._hostq.get(timeout=self._POLL_S)
+            except queue_lib.Empty:
+                if not self._threads[0].is_alive():
+                    try:
+                        return self._hostq.get_nowait()
+                    except queue_lib.Empty:
+                        return None
+        return None
+
+    def _place(self) -> None:
+        place_s: dict[int, float] = {}
+        try:
+            while not self._abort.is_set():
+                item = self._host_get()
+                if item is None:
+                    return
+                tag, ep, payload, prod = item
+                if tag == "item":
+                    t0 = time.perf_counter()
+                    dev = self._put_fn(payload)
+                    dt = time.perf_counter() - t0
+                    self._lat.observe(prod + dt)
+                    place_s[ep] = place_s.get(ep, 0.0) + prod + dt
+                    while not self._abort.is_set():
+                        if self._gate.acquire(timeout=self._POLL_S):
+                            with self._staged_lock:
+                                self._staged += 1
+                            self._devq.put(("item", ep, dev))
+                            break
+                    continue
+                if tag == "end":
+                    total = place_s.pop(ep, 0.0) + prod
+                    self._devq.put(("end", ep, total))
+                    continue
+                self._devq.put((tag, ep, payload))  # done / error
+                return
+        except BaseException as e:
+            self._devq.put(("error", None, e))
+
+    # -- consumer side ------------------------------------------------------
+
+    def _get(self):
+        import queue as queue_lib
+        while True:
+            try:
+                return self._devq.get(timeout=self._POLL_S)
+            except queue_lib.Empty:
+                if self._abort.is_set() or not any(
+                        t.is_alive() for t in self._threads):
+                    # one last non-blocking look: the sentinel may have
+                    # landed between the timeout and the liveness check
+                    try:
+                        return self._devq.get_nowait()
+                    except queue_lib.Empty:
+                        raise FeederError(
+                            "input feeder died without delivering its "
+                            "epoch (producer thread gone; see the journal "
+                            "for a chaos_inject or the original error)")
+
+    def epoch(self, epoch: int) -> Iterator:
+        """Device items for `epoch`, in deterministic order.  Epochs must
+        be consumed in the order the feeder was constructed with."""
+        while True:
+            tag, ep, payload = self._get()
+            if tag == "error":
+                self._abort.set()
+                raise payload
+            if tag == "done":
+                raise FeederError(
+                    f"feeder exhausted before epoch {epoch} (consumed out "
+                    "of order?)")
+            if ep != epoch:
+                self._abort.set()
+                raise FeederError(
+                    f"feeder/consumer epoch mismatch: got {ep}, "
+                    f"expected {epoch}")
+            if tag == "end":
+                self._prod_s[epoch] = payload
+                return
+            with self._staged_lock:
+                self._staged -= 1
+            try:
+                yield payload
+            finally:
+                self._gate.release()
+
+    def production_seconds(self, epoch: int) -> float:
+        """Host seconds this epoch's items cost to assemble + stage (the
+        producer-side, per-host-attributable input cost — the straggler
+        line's lens), regardless of WHEN they ran; 0.0 until the epoch's
+        end marker was consumed."""
+        return self._prod_s.get(epoch, 0.0)
+
+    def ready_ahead(self) -> int:
+        """Items already staged on device beyond what the consumer pulled —
+        at an epoch boundary this is the NEXT epoch's prefetched chunks
+        (the boundary work the overlap hid).  Counts real items only
+        (epoch-end sentinels in the queue never held gate slots and would
+        overstate the report)."""
+        with self._staged_lock:
+            return max(self._staged, 0)
+
+    @property
+    def depth(self) -> int:
+        return self._gate.depth
+
+    def set_depth(self, depth: int) -> None:
+        """Resize the device-queue bound (auto mode; between epochs)."""
+        self._gate.resize(max(int(depth), 1))
+
+    def close(self) -> None:
+        """Abort both threads and discard run-ahead items (early stop,
+        SIGTERM drain, mid-epoch exceptions).  Idempotent."""
+        import queue as queue_lib
+        self._abort.set()
+        deadline = time.monotonic() + 10.0
+        while (any(t.is_alive() for t in self._threads)
+               and time.monotonic() < deadline):
+            try:  # drain so a producer blocked on a full gate/queue exits
+                self._devq.get_nowait()
+                self._gate.release()
+            except queue_lib.Empty:
+                time.sleep(self._POLL_S / 2)
+        for t in self._threads:
+            t.join(timeout=1.0)
+
+
 def staged_epoch_blocks(
     ds: TabularDataset,
     batch_size: int,
@@ -777,8 +1142,7 @@ def staged_epoch_blocks(
     nb_total = n // batch_size
     if nb_total == 0:
         return
-    slack = n - nb_total * batch_size
-    offset = (epoch * 997) % (slack + 1) if (shuffle and slack > 0) else 0
+    offset = staged_epoch_offset(n, batch_size, shuffle=shuffle, epoch=epoch)
 
     def as_blocks(arr: np.ndarray) -> np.ndarray:
         return arr[offset:offset + nb_total * batch_size].reshape(
@@ -788,11 +1152,8 @@ def staged_epoch_blocks(
     targ = as_blocks(ds.target)
     wgt = as_blocks(ds.weight)
 
-    if shuffle:
-        rng = np.random.default_rng(np.random.PCG64(seed * 1_000_003 + epoch))
-        order = rng.permutation(nb_total)
-    else:
-        order = np.arange(nb_total)
+    order = epoch_permutation(nb_total, shuffle=shuffle, seed=seed,
+                              epoch=epoch)
 
     for start in range(0, nb_total, block_batches):
         idx = order[start:start + block_batches]

@@ -212,6 +212,7 @@ def profile_summary(path: str) -> Optional[dict]:
 
     epochs: list[dict] = []
     compiles: dict[str, dict] = {}
+    overlap_epochs: list[dict] = []
     recovery = {"restore_s": 0.0, "restores": 0, "fallbacks": 0,
                 "preemption_graces": 0, "resumes": 0}
     for rec in events:
@@ -221,6 +222,13 @@ def profile_summary(path: str) -> Optional[dict]:
                            ("epoch", "wall_s", "buckets", "goodput_fraction",
                             "mfu", "achieved_tflops", "peak_tflops",
                             "compiles")})
+        elif kind == "overlap_report":
+            overlap_epochs.append({k: rec.get(k) for k in
+                                   ("epoch", "tier", "overlap",
+                                    "prefetch_depth", "input_exposed_s",
+                                    "input_production_s", "input_hidden_s",
+                                    "eval_s", "prefetched_chunks",
+                                    "overlap_efficiency", "order_digest")})
         elif kind == "xla_compile":
             fn = str(rec.get("fn", "?"))
             c = compiles.setdefault(fn, {"compiles": 0, "compile_s": 0.0,
@@ -260,6 +268,21 @@ def profile_summary(path: str) -> Optional[dict]:
             fracs.append(e["goodput_fraction"])
         if isinstance(e.get("mfu"), (int, float)):
             mfus.append(e["mfu"])
+    # overlap engine rollup (docs/PERF.md "Overlap engine"): how much of
+    # the epochs' host input work ran behind device compute
+    hidden = sum(e["input_hidden_s"] for e in overlap_epochs
+                 if isinstance(e.get("input_hidden_s"), (int, float)))
+    exposed = sum(e["input_exposed_s"] for e in overlap_epochs
+                  if isinstance(e.get("input_exposed_s"), (int, float)))
+    overlap = None
+    if overlap_epochs:
+        overlap = {
+            "epochs": overlap_epochs,
+            "input_hidden_s": round(hidden, 6),
+            "input_exposed_s": round(exposed, 6),
+            "efficiency": (round(hidden / (hidden + exposed), 4)
+                           if hidden + exposed > 0 else None),
+        }
     out = {
         "journal": jpath,
         "epochs": epochs,
@@ -267,6 +290,7 @@ def profile_summary(path: str) -> Optional[dict]:
         "goodput_fraction_mean": (round(sum(fracs) / len(fracs), 4)
                                   if fracs else None),
         "mfu_max": (round(max(mfus), 6) if mfus else None),
+        "overlap": overlap,
         # by cost: captured FLOPs first (the honest "expensive" ranking),
         # compile seconds as the tiebreak/no-capture fallback
         "compiled_functions": dict(sorted(
@@ -312,6 +336,27 @@ def render_profile_text(summary: dict) -> str:
         if isinstance(mfu_max, (int, float)):
             tail.append(f"mfu max {mfu_max:.4f}")
         lines.append("  ".join(tail))
+    overlap = summary.get("overlap")
+    if overlap:
+        eff = overlap.get("efficiency")
+        lines.append(
+            f"overlap engine: input hidden {overlap['input_hidden_s']:g}s "
+            f"exposed {overlap['input_exposed_s']:g}s"
+            + (f" ({eff:.1%} hidden)" if isinstance(eff, (int, float))
+               else ""))
+        for e in overlap.get("epochs") or []:
+            if not e.get("overlap"):
+                continue
+            eeff = e.get("overlap_efficiency")
+            lines.append(
+                f"  epoch {e.get('epoch')}: tier={e.get('tier')} "
+                f"depth={e.get('prefetch_depth')} "
+                f"hidden={e.get('input_hidden_s')}s "
+                f"exposed={e.get('input_exposed_s')}s "
+                f"eval={e.get('eval_s')}s "
+                f"prefetched_next={e.get('prefetched_chunks')}"
+                + (f" eff={eeff:.1%}"
+                   if isinstance(eeff, (int, float)) else ""))
     comp = summary.get("compiled_functions") or {}
     if comp:
         lines.append("compiled functions (by cost):")

@@ -313,8 +313,26 @@ def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
     wcast = pipe.wire_cast_fn(job.schema, job.data, job.model.compute_dtype)
     if not multihost:
         # streaming accumulation (O(bins), not O(valid set)) through the
-        # shared _accumulate_streaming helper
+        # shared _accumulate_streaming helper.  ASYNC dispatch: score
+        # fetches run one bounded window behind the dispatches, so the
+        # device pipelines the whole eval instead of draining after every
+        # batch (the old per-batch jax.device_get serialized dispatch →
+        # sync → host accumulate → dispatch, and that blocking tail is
+        # exactly the dead epoch-boundary time the overlap engine hides —
+        # the `gather3` collective path already fetched this way).  The
+        # window bounds in-flight device memory to `window` input batches
+        # + score vectors; host accumulation stays O(bins).
+        window = 8
+
         def triples():
+            from collections import deque
+
+            pend: "deque" = deque()
+
+            def fetch(entry):
+                s, n, tgt, wgt = entry
+                return (np.asarray(jax.device_get(s))[:n, 0], tgt, wgt)
+
             for batch in pipe.batch_iterator(ds, bs, shuffle=False,
                                              drop_remainder=False):
                 padded, mask = pipe.pad_to_batch(batch, bs)
@@ -322,10 +340,12 @@ def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
                     padded = wcast(padded)
                 if mesh is not None:
                     padded = shard_lib.shard_batch(padded, mesh)
-                s = np.asarray(jax.device_get(eval_step(state, padded)))
-                n = int(mask.sum())
-                yield (s[:n, 0], batch["target"][:, 0],
-                       batch["weight"][:, 0])
+                pend.append((eval_step(state, padded), int(mask.sum()),
+                             batch["target"][:, 0], batch["weight"][:, 0]))
+                if len(pend) >= window:
+                    yield fetch(pend.popleft())
+            while pend:
+                yield fetch(pend.popleft())
 
         return _accumulate_streaming(triples())
 
@@ -529,7 +549,12 @@ def train(job: JobConfig,
         k_win = job.train.local_sgd_window
         staged_block_batches = -(-job.data.block_batches // k_win) * k_win
     else:
-        epoch_scan_step = make_epoch_scan_step(job, mesh)
+        # donate_blocks: every streamed/staged chunk is consumed exactly
+        # once, so its device buffers are donated through the scan — the
+        # runtime reclaims each chunk's HBM at dispatch instead of at
+        # Python GC, and steady-state H2D cycles a fixed buffer set
+        epoch_scan_step = make_epoch_scan_step(job, mesh,
+                                               donate_blocks=True)
         staged_block_batches = job.data.block_batches
     # cap chunks near ~32 MB of WIRE bytes so H2D stays sub-second per
     # chunk and overlaps compute.  Byte-based, not row-based: the compact
@@ -559,6 +584,40 @@ def train(job: JobConfig,
     train_step = None
     staged_put_fn = None
     staged_source = None
+
+    # cross-epoch overlap engine (data/pipeline.EpochFeeder): ONE persistent
+    # feeder replaces the per-epoch prefetch producer for the staged and
+    # per-batch tiers — epoch N+1's shuffle + assembly + first H2D staging
+    # run while epoch N computes and while its eval dispatch tail drains.
+    # Created lazily at the first epoch whose tier it serves (tiers resolve
+    # only once train_ds exists); batch order stays a pure function of
+    # (seed, epoch), byte-identical to the non-overlapped path.
+    use_overlap = job.data.overlap_epochs
+    feeder: Optional[pipe.EpochFeeder] = None
+    # host staging depth: prefetch_depth (0 = auto adapts the DEVICE gate
+    # per epoch from the ledger's exposed-input fraction, starting shallow)
+    feeder_host_depth = job.data.prefetch_depth or 4
+    feeder_dev_depth = (job.data.prefetch if job.data.prefetch_depth
+                        else 2)
+
+    def _staged_host_blocks(ep: int):
+        """Assembly-thread source for one staged epoch (same order
+        derivation as the per-epoch path — staged_source may copy a
+        deterministic per-epoch subset on imbalanced multihost shards)."""
+        return pipe.staged_epoch_blocks(
+            staged_source(ep), local_bs, shuffle=job.data.shuffle,
+            seed=job.data.shuffle_seed, epoch=ep,
+            block_batches=staged_block_batches)
+
+    def _perbatch_host_batches(ep: int):
+        import itertools
+        hb = pipe.batch_iterator(
+            train_ds, local_bs, shuffle=job.data.shuffle,
+            seed=job.data.shuffle_seed, epoch=ep,
+            drop_remainder=job.data.drop_remainder or multihost)
+        if multihost:
+            hb = itertools.islice(hb, steps_per_epoch)
+        return hb
 
     def _feed_put_fn(shard_local, shard_global, cast):
         """Device placement for host arrays — blocks or batches, mesh or
@@ -737,7 +796,8 @@ def train(job: JobConfig,
                     keep = np.arange(min_host_rows)
                 return train_ds.take(keep)
         elif not use_resident:
-            train_step = make_train_step(job, mesh)
+            # donate_batch: the loop consumes each prefetched batch once
+            train_step = make_train_step(job, mesh, donate_batch=True)
 
     if train_ds is not None:
         _prepare_tiers()
@@ -840,6 +900,8 @@ def train(job: JobConfig,
     evals_since_best = 0
     best_params_host = None
     pending_loader = None  # streamed loader whose train set is not yet built
+    pending_thread = None  # background assembly of the retained dataset
+    pending_assembly: dict = {}
     try:
       for epoch in range(start_epoch, job.train.epochs):
         # chaos site "train.epoch_start": the epoch boundary BEFORE any
@@ -853,9 +915,18 @@ def train(job: JobConfig,
         # from their own call sites while it is open
         obs.goodput.begin_epoch()
         if pending_loader is not None and epoch > start_epoch:
-            # first epoch after the streamed one: assemble the retained
-            # dataset and resolve the input tiers for the rest of the job
-            train_ds = pending_loader.train_dataset()
+            # first epoch after the streamed one: the retained dataset's
+            # assembly + global shuffle either ran in the background thread
+            # the streamed epoch kicked off (overlap engine: it was hidden
+            # behind that epoch's eval) or runs here, serialized
+            if pending_thread is not None:
+                pending_thread.join()
+                pending_thread = None
+                if "error" in pending_assembly:
+                    raise pending_assembly["error"]
+                train_ds = pending_assembly.pop("train_ds")
+            else:
+                train_ds = pending_loader.train_dataset()
             pending_loader = None
             _prepare_tiers()
         # loss accumulates on device; host sync happens once per epoch so
@@ -980,6 +1051,27 @@ def train(job: JobConfig,
                 valid_ds = stream_loader.valid_dataset()
                 pending_loader, stream_loader = stream_loader, None
                 streamed_this_epoch = loss_n > 0
+                if (streamed_this_epoch and use_overlap
+                        and epoch + 1 < job.train.epochs):
+                    # overlap engine: assemble + globally shuffle the
+                    # retained dataset on a background thread NOW, so the
+                    # work hides behind this epoch's eval instead of
+                    # serializing at the next epoch's start (the loader is
+                    # quiescent — valid_dataset() above already drained the
+                    # parse, and only this thread touches it until the join)
+                    import threading as _threading
+
+                    def _assemble_retained(loader=pending_loader,
+                                           box=pending_assembly):
+                        try:
+                            box["train_ds"] = loader.train_dataset()
+                        except BaseException as e:  # re-raised at the join
+                            box["error"] = e
+
+                    pending_thread = _threading.Thread(
+                        target=_assemble_retained, daemon=True,
+                        name="shifu-retained-assembly")
+                    pending_thread.start()
                 if not streamed_this_epoch:
                     # empty stream (no train rows at all): assemble now so
                     # _prepare_tiers can clamp or raise its usual errors
@@ -993,12 +1085,11 @@ def train(job: JobConfig,
                 pass
             elif use_resident:
                 nb_total = resident_blocks["features"].shape[0]
-                if job.data.shuffle:
-                    rng = np.random.default_rng(
-                        np.random.PCG64(job.data.shuffle_seed * 1_000_003 + epoch))
-                    order = rng.permutation(nb_total).astype(np.int32)
-                else:
-                    order = np.arange(nb_total, dtype=np.int32)
+                # THE shared per-epoch order stream (pipeline.py): the
+                # journaled order_digest derives from the same function
+                order = pipe.epoch_permutation(
+                    nb_total, shuffle=job.data.shuffle,
+                    seed=job.data.shuffle_seed, epoch=epoch).astype(np.int32)
                 timer.mark_input_ready()
                 state, loss_acc = device_epoch_step(
                     state, resident_blocks, jnp.asarray(order))
@@ -1012,18 +1103,28 @@ def train(job: JobConfig,
                 # each chunk's scan is one agreed collective dispatch — the
                 # out-of-HBM successor of the per-batch collective path, at
                 # scan-tier dispatch rates
-                t_src = time.perf_counter()
-                epoch_src = staged_source(epoch)  # may copy an epoch subset
-                host_blocks = pipe.staged_epoch_blocks(
-                    epoch_src, local_bs, shuffle=job.data.shuffle,
-                    seed=job.data.shuffle_seed, epoch=epoch,
-                    block_batches=staged_block_batches)
-                if multihost:  # single-host never reads host_input_times
-                    host_input_times.append(time.perf_counter() - t_src)
-                    host_blocks = _timed_source(host_blocks)
-                put_fn = staged_put_fn
-                for blocks in pipe.prefetch_to_device(
-                        host_blocks, mesh, size=job.data.prefetch, put_fn=put_fn):
+                if use_overlap:
+                    if feeder is None:
+                        feeder = pipe.EpochFeeder(
+                            _staged_host_blocks, staged_put_fn,
+                            range(epoch, job.train.epochs),
+                            depth=feeder_dev_depth,
+                            host_depth=feeder_host_depth)
+                    block_iter = feeder.epoch(epoch)
+                else:
+                    t_src = time.perf_counter()
+                    epoch_src = staged_source(epoch)  # epoch-subset copy?
+                    host_blocks = pipe.staged_epoch_blocks(
+                        epoch_src, local_bs, shuffle=job.data.shuffle,
+                        seed=job.data.shuffle_seed, epoch=epoch,
+                        block_batches=staged_block_batches)
+                    if multihost:  # single-host never reads the times
+                        host_input_times.append(time.perf_counter() - t_src)
+                        host_blocks = _timed_source(host_blocks)
+                    block_iter = pipe.prefetch_to_device(
+                        host_blocks, mesh, size=job.data.prefetch,
+                        put_fn=staged_put_fn)
+                for blocks in block_iter:
                     timer.mark_input_ready()
                     nb = blocks["features"].shape[0]
                     state, loss_sum_blk = epoch_scan_step(state, blocks)
@@ -1037,23 +1138,27 @@ def train(job: JobConfig,
                         # length is exactly why mid-epoch durability matters
                         maybe_midtrain_save(epoch)
             else:
-                import itertools
-                host_batches = pipe.batch_iterator(
-                    train_ds, local_bs, shuffle=job.data.shuffle,
-                    seed=job.data.shuffle_seed, epoch=epoch,
-                    drop_remainder=job.data.drop_remainder or multihost)
-                if multihost:
-                    # every host must run the SAME number of collective steps
-                    host_batches = itertools.islice(host_batches,
-                                                    steps_per_epoch)
-                if multihost:  # single-host never reads host_input_times
-                    host_batches = _timed_source(iter(host_batches))
                 put_fn = _feed_put_fn(shard_lib.shard_batch,
                                       shard_lib.shard_batch_process_local,
                                       wcast)
-                for batch in pipe.prefetch_to_device(host_batches, mesh,
-                                                     size=job.data.prefetch,
-                                                     put_fn=put_fn):
+                if use_overlap:
+                    if feeder is None:
+                        feeder = pipe.EpochFeeder(
+                            _perbatch_host_batches, put_fn,
+                            range(epoch, job.train.epochs),
+                            depth=feeder_dev_depth,
+                            host_depth=feeder_host_depth)
+                    batch_iter = feeder.epoch(epoch)
+                else:
+                    # every host runs the SAME number of collective steps
+                    # (_perbatch_host_batches islices to the agreed count)
+                    host_batches = _perbatch_host_batches(epoch)
+                    if multihost:  # single-host never reads the times
+                        host_batches = _timed_source(iter(host_batches))
+                    batch_iter = pipe.prefetch_to_device(
+                        host_batches, mesh, size=job.data.prefetch,
+                        put_fn=put_fn)
+                for batch in batch_iter:
                     timer.mark_input_ready()
                     state, step_metrics = train_step(state, batch)
                     loss = step_metrics["loss"]
@@ -1118,8 +1223,16 @@ def train(job: JobConfig,
             # TensorflowSession.java:515-549).  Host input seconds from the
             # timed source when a tier used one (staged/per-batch), else
             # the consumer-side input waits (streamed/resident epochs)
-            input_s = (sum(host_input_times) if host_input_times
-                       else sum(timer.input_times))
+            if feeder is not None:
+                # overlap engine: producer-side host seconds per epoch are
+                # tracked by the feeder itself (production may have run
+                # DURING the previous epoch — attribution is by epoch, not
+                # by when the threads happened to do the work)
+                input_s = feeder.production_seconds(epoch)
+            elif host_input_times:
+                input_s = sum(host_input_times)
+            else:
+                input_s = sum(timer.input_times)
             prof_lib.straggler_line(epoch, epoch_time, valid_time,
                                     input_s, console)
 
@@ -1194,12 +1307,88 @@ def train(job: JobConfig,
             led.add("eval", valid_time)
             obs.goodput.end_epoch(epoch, time.perf_counter() - t0)
 
+        # overlap report: what the engine hid vs what the device still
+        # waited for this epoch (docs/OBSERVABILITY.md).  `exposed` is the
+        # consumer-visible input wait (same lens as the ledger's input
+        # bucket); `production` is the host seconds the epoch's items cost
+        # to assemble + stage wherever they ran; `hidden` is the
+        # difference — host input work that overlapped device compute.
+        # `order_digest` pins the determinism contract: a pure function of
+        # (seed, epoch, tier), byte-identical with overlap on or off and
+        # across a restart resume (tests/test_overlap.py).
+        tier = ("stream" if streamed_this_epoch else
+                "resident" if use_resident else
+                "staged" if use_staged else "batch")
+        exposed_s = sum(timer.input_times)
+        if feeder is not None:
+            prod_s = feeder.production_seconds(epoch)
+        elif host_input_times:
+            prod_s = sum(host_input_times)
+        else:
+            prod_s = exposed_s  # untimed producer: nothing provably hidden
+        hidden_s = max(prod_s - exposed_s, 0.0)
+        digest_rows = 0
+        if train_ds is not None:
+            digest_rows = (min_host_rows
+                           if multihost and tier in ("staged", "resident")
+                           else train_ds.num_rows)
+        order_digest = pipe.epoch_order_digest(
+            tier, digest_rows, local_bs, shuffle=job.data.shuffle,
+            seed=job.data.shuffle_seed, epoch=epoch)
+        eff = (hidden_s / (hidden_s + exposed_s)
+               if hidden_s + exposed_s > 0 else None)
+        obs.event("overlap_report", epoch=epoch, tier=tier,
+                  overlap=feeder is not None,
+                  prefetch_depth=(feeder.depth if feeder is not None
+                                  else job.data.prefetch),
+                  input_exposed_s=round(exposed_s, 6),
+                  input_production_s=round(prod_s, 6),
+                  input_hidden_s=round(hidden_s, 6),
+                  eval_s=round(valid_time, 6),
+                  prefetched_chunks=(feeder.ready_ahead()
+                                     if feeder is not None else 0),
+                  overlap_efficiency=(round(eff, 4) if eff is not None
+                                      else None),
+                  order_digest=order_digest)
+        hid_c = obs.counter("overlap_hidden_seconds_total",
+                            "input seconds hidden behind device compute "
+                            "by the overlap engine")
+        exp_c = obs.counter("overlap_exposed_seconds_total",
+                            "epoch-boundary seconds still exposed on the "
+                            "critical path (device idle)")
+        hid_c.inc(hidden_s, kind="input")
+        exp_c.inc(exposed_s, kind="input")
+        exp_c.inc(valid_time, kind="eval")
+        if eff is not None:
+            obs.gauge("overlap_efficiency",
+                      "last epoch's hidden / (hidden + exposed) input "
+                      "fraction").set(round(eff, 4))
+        wall_now = time.perf_counter() - t0
+        if (feeder is not None and job.data.prefetch_depth == 0
+                and wall_now > 0):
+            # auto mode: one depth step per epoch from the ledger's
+            # exposed-input fraction (data/pipeline.next_prefetch_depth)
+            feeder.set_depth(pipe.next_prefetch_depth(
+                feeder.depth, exposed_s / wall_now))
+
         if epoch_callback is not None:
             epoch_callback(m)
 
         if early_stop_now:
             break
     finally:
+      if feeder is not None:
+          # however the loop exits (done, early stop, SIGTERM drain, error):
+          # abort the persistent feeder and free its run-ahead device blocks
+          feeder.close()
+      if pending_thread is not None:
+          # bounded-courtesy join only: if the loop is exiting with the
+          # background retained-dataset assembly unconsumed (early stop,
+          # SIGTERM drain, error), nobody will ever use its result — a
+          # long join here would eat the 15s preemption-grace window on a
+          # multi-GB shuffle.  The thread is a daemon doing pure host
+          # compute; it finishes (or dies with the process) on its own.
+          pending_thread.join(timeout=1.0)
       if old_term is not None:
           _signal.signal(_signal.SIGTERM, old_term)
       if manager is not None:

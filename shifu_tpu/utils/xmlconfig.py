@@ -82,6 +82,11 @@ KEY_DATA_WIRE_INT8_CLIP = "shifu.data.wire-int8-clip"
 # auto/elide/float32 (DataConfig.wire_label_dtype / wire_weight_mode)
 KEY_DATA_WIRE_LABEL_DTYPE = "shifu.data.wire-label-dtype"
 KEY_DATA_WIRE_WEIGHT_MODE = "shifu.data.wire-weight-mode"
+# host-side input-feeder queue depth (DataConfig.prefetch_depth; 0 = auto —
+# resized per epoch from the goodput ledger's exposed-input measurement)
+KEY_DATA_PREFETCH_DEPTH = "shifu.data.prefetch-depth"
+# cross-epoch overlap engine on/off (DataConfig.overlap_epochs)
+KEY_DATA_OVERLAP_EPOCHS = "shifu.data.overlap-epochs"
 # rows-touched-only embedding optimizer updates: auto / on / off
 # (TrainConfig.sparse_embedding_update, train/sparse_embed.py)
 KEY_TRAIN_SPARSE_EMBED = "shifu.train.sparse-embedding-update"
@@ -224,6 +229,14 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         data = dataclasses.replace(
             data,
             wire_weight_mode=conf[KEY_DATA_WIRE_WEIGHT_MODE].strip().lower())
+    if KEY_DATA_PREFETCH_DEPTH in conf:
+        import dataclasses
+        data = dataclasses.replace(
+            data, prefetch_depth=int(conf[KEY_DATA_PREFETCH_DEPTH]))
+    if KEY_DATA_OVERLAP_EPOCHS in conf:
+        import dataclasses
+        data = dataclasses.replace(
+            data, overlap_epochs=parse_bool(conf[KEY_DATA_OVERLAP_EPOCHS]))
     if KEY_TRAIN_SPARSE_EMBED in conf:
         import dataclasses
         train = dataclasses.replace(

@@ -307,10 +307,11 @@ def test_status_quick_summary_carries_goodput(tmp_path, monkeypatch):
 # --------------------------------------------------------------- perf gate
 
 
-def _artifact(value=100.0, goodput_frac=0.5, compiles=10):
+def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7):
     return {"value": value, "unit": "samples/sec/chip",
             "goodput": {"goodput_fraction_mean": goodput_frac},
-            "xla_compiles": {"total": compiles}}
+            "xla_compiles": {"total": compiles},
+            "e2e_cached_disk_fraction_of_ceiling": ceiling}
 
 
 @pytest.mark.perf
@@ -339,10 +340,20 @@ def test_perf_gate_fails_each_axis():
     # compile-count explosion
     r = perf_gate.run_gate(_artifact(compiles=50), base)
     assert r["verdict"] == "REGRESSION"
+    # e2e ceiling-fraction collapse (the epoch loop re-serialized)
+    r = perf_gate.run_gate(_artifact(ceiling=0.3), base)
+    assert r["verdict"] == "REGRESSION"
+    assert [c for c in r["checks"]
+            if c["name"] == "e2e_ceiling_fraction"][0]["status"] \
+        == "REGRESSION"
+    # ...a small dip inside the tolerance passes (normalization drift)
+    r = perf_gate.run_gate(_artifact(ceiling=0.6), base)
+    assert r["verdict"] == "PASS"
     # missing fields on either side SKIP, never fail
     r = perf_gate.run_gate({"value": 100.0}, base)
     assert r["verdict"] == "PASS"
-    assert [c["status"] for c in r["checks"]] == ["OK", "SKIP", "SKIP"]
+    assert [c["status"] for c in r["checks"]] == ["OK", "SKIP", "SKIP",
+                                                  "SKIP"]
 
 
 @pytest.mark.perf
@@ -358,7 +369,7 @@ def test_perf_gate_cli_pass_fail_and_check_only(tmp_path):
     fresh_ok.write_text(json.dumps(_artifact()))
     fresh_bad = tmp_path / "fresh_bad.json"
     fresh_bad.write_text(json.dumps(
-        _artifact(value=10.0, goodput_frac=0.1, compiles=100)))
+        _artifact(value=10.0, goodput_frac=0.1, compiles=100, ceiling=0.1)))
 
     def run(*args):
         return subprocess.run([sys.executable, gate, *args],

@@ -3,7 +3,7 @@ BENCH baseline and exit nonzero on regression.
 
 The repo's first *enforceable* perf trajectory (ISSUE 3): every round the
 driver captures a `BENCH_r*.json`; this gate compares a freshly produced
-`bench_full.json` against the newest of those baselines on three axes —
+`bench_full.json` against the newest of those baselines on four axes —
 
 - **throughput / step time**: the headline resident-tier
   samples/sec/chip (`value`) must not fall below
@@ -20,6 +20,12 @@ driver captures a `BENCH_r*.json`; this gate compares a freshly produced
   (`xla_compiles.total`) must not exceed `baseline * --compile-factor
   + 2` — a recompile explosion (a shape leak, a lost cache) is a perf
   bug even when the steady-state rate survives it.
+- **e2e ceiling fraction**: `e2e_cached_disk_fraction_of_ceiling` (the
+  end-to-end rate normalized by the live-probed H2D link ceiling —
+  tunnel-drift-immune) must not drop more than `--e2e-ceiling-drop`
+  (absolute, default 0.2) below the baseline: the guard that future
+  changes cannot silently re-serialize the epoch loop the overlap
+  engine (ISSUE 4) pipelined.
 
 Checks whose fields are missing on either side are SKIPPED (pre-ledger
 baselines carry no goodput/compile fields), never failed.
@@ -93,7 +99,8 @@ def _num(d: dict, *keys):
 
 def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
              goodput_drop: float = 0.1,
-             compile_factor: float = 2.0) -> dict:
+             compile_factor: float = 2.0,
+             e2e_ceiling_drop: float = 0.2) -> dict:
     """The comparison itself (pure — unit-tested on synthetic pairs).
     Returns {"checks": [...], "verdict": "PASS"|"REGRESSION"}."""
     checks: list[dict] = []
@@ -127,6 +134,21 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
     else:
         limit = bc * compile_factor + 2
         check("xla_compile_count", fc, bc, fc <= limit, round(limit, 1))
+
+    # e2e ceiling fraction: the link-normalized end-to-end number (rows/s
+    # as a fraction of the measured H2D ceiling — tunnel-drift-immune,
+    # unlike the absolute rate).  A drop here means the epoch loop
+    # re-serialized (lost overlap, a reintroduced blocking eval, a dead
+    # feeder) even when raw throughput noise hides it.  Absolute
+    # tolerance: the bracketing H2D probes still leave some drift in the
+    # normalization (docs/PERF.md).
+    fe = _num(fresh, "e2e_cached_disk_fraction_of_ceiling")
+    be = _num(baseline, "e2e_cached_disk_fraction_of_ceiling")
+    if fe is None or be is None:
+        check("e2e_ceiling_fraction", fe, be, None, None)
+    else:
+        limit = be - e2e_ceiling_drop
+        check("e2e_ceiling_fraction", fe, be, fe >= limit, round(limit, 4))
 
     regressed = [c for c in checks if c["status"] == "REGRESSION"]
     return {"checks": checks,
@@ -163,6 +185,10 @@ def main(argv=None) -> int:
                    help="max absolute drop in mean goodput fraction")
     p.add_argument("--compile-factor", type=float, default=2.0,
                    help="fresh compile count must be <= baseline * this + 2")
+    p.add_argument("--e2e-ceiling-drop", type=float, default=0.2,
+                   help="max absolute drop in e2e_cached_disk_fraction_of_"
+                        "ceiling (the link-normalized e2e number — a drop "
+                        "means the epoch loop re-serialized)")
     p.add_argument("--check-only", action="store_true",
                    help="tier-1 mode: missing/corrupt artifacts degrade to "
                         "a journaled warning and exit 0")
@@ -201,7 +227,8 @@ def main(argv=None) -> int:
     report = run_gate(fresh, baseline,
                       value_threshold=args.value_threshold,
                       goodput_drop=args.goodput_drop,
-                      compile_factor=args.compile_factor)
+                      compile_factor=args.compile_factor,
+                      e2e_ceiling_drop=args.e2e_ceiling_drop)
     report["fresh"] = args.fresh
     report["baseline"] = baseline_path
     _journal("perf_gate", verdict=report["verdict"],
