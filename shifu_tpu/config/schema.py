@@ -132,9 +132,23 @@ class DataConfig:
     # parse-once columnar cache directory (data/cache.py); None defers to the
     # SHIFU_TPU_DATA_CACHE env var, empty-or-unset means no cache.
     cache_dir: str | None = None
+    # cache entry format generation (data/cache.py CACHE_FORMAT_VERSION):
+    # 0 = latest (v2: wire-format projected entries with compact
+    # target/weight storage and an entry.json manifest — ¼ the disk bytes
+    # of raw float32, zero re-quantize on warm starts); 1 pins the legacy
+    # v1 layout for interop with pre-v2 readers sharing the cache dir.
+    # Both formats reconstruct bit-identical arrays on load.
+    cache_format: int = 0
     # file-level read parallelism for load_datasets; 0 = one thread per file
     # capped at cpu_count.
     read_threads: int = 0
+    # cold-ingest parse pool width: how many part-files inflate+parse
+    # concurrently (native parser per file; v2 cache writes overlap on a
+    # separate writer thread).  0 = auto (read_threads when set, else one
+    # worker per file capped at cpu_count).  Takes precedence over
+    # read_threads when both are set; intra-file parser threads scale down
+    # as the pool widens so total parallelism stays ~cores, not cores².
+    ingest_workers: int = 0
     # out-of-core mode: consolidate the host shard into on-disk projected
     # arrays once (requires cache_dir) and train from read-only memmaps —
     # host shards larger than RAM stream through the staged tier
@@ -188,6 +202,14 @@ class DataConfig:
             raise ConfigError(
                 f"prefetch_depth must be >= 0 (0 = auto): "
                 f"{self.prefetch_depth}")
+        if self.cache_format not in (0, 1, 2):
+            raise ConfigError(
+                f"cache_format must be 0 (latest), 1, or 2: "
+                f"{self.cache_format}")
+        if self.ingest_workers < 0:
+            raise ConfigError(
+                f"ingest_workers must be >= 0 (0 = auto): "
+                f"{self.ingest_workers}")
         if self.wire_dtype not in ("auto", "float32", "bfloat16", "int8"):
             raise ConfigError(
                 f"wire_dtype must be auto/float32/bfloat16/int8: "

@@ -75,6 +75,20 @@ def _num_threads() -> int:
         return 0  # 0 = hardware_concurrency (decided in C++)
 
 
+def pool_parser_threads(pool_width: int) -> int:
+    """Per-file intra-parse thread budget when `pool_width` files parse
+    concurrently: split the cores across the pool instead of pinning every
+    file to 1 thread.  A 2-file shard on an 8-core host then still inflates
+    8-wide (4 threads per file) while an 8-file pool degrades to the old
+    1-thread-per-file policy — total parallelism stays ~cores, never
+    cores².  SHIFU_TPU_PARSER_THREADS (when set) wins outright: an
+    operator override is an override."""
+    env = _num_threads()
+    if env > 0:
+        return env
+    return max(1, (os.cpu_count() or 1) // max(int(pool_width), 1))
+
+
 def _take(lib, out_pp, rows_p, cols_p) -> np.ndarray:
     rows, cols = rows_p.value, cols_p.value
     if rows == 0 or cols == 0:

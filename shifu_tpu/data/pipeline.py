@@ -57,42 +57,163 @@ class TabularDataset:
                               self.target[idx], self.weight[idx])
 
 
+def resolved_cache_format(data: DataConfig) -> int:
+    """The cache entry format generation this job writes/keys by:
+    DataConfig.cache_format, 0 meaning the current CACHE_FORMAT_VERSION."""
+    from . import cache as cache_lib
+    return int(getattr(data, "cache_format", 0)) \
+        or cache_lib.CACHE_FORMAT_VERSION
+
+
+def ingest_pool_width(data: DataConfig, n_files: int) -> int:
+    """Width of the cold-ingest parse pool (how many part-files
+    inflate+parse concurrently): DataConfig.ingest_workers, falling back to
+    the legacy read_threads spelling, else one worker per file capped at
+    cpu_count.  Intra-file parser threads scale inversely
+    (native_parser.pool_parser_threads) so total parallelism stays ~cores.
+    """
+    if n_files <= 0:
+        return 1
+    width = data.ingest_workers or data.read_threads \
+        or min(n_files, os.cpu_count() or 1)
+    return max(1, min(int(width), n_files))
+
+
+def _write_projected(writer, cache_dir: str, name: str, arrays: dict,
+                     source: str, delimiter: str, version: int,
+                     rec: Optional[dict],
+                     supersedes: Optional[str] = None) -> None:
+    """Route one v2 entry write through the async writer (cold ingest:
+    inflate+parse of the next file overlaps this write) or do it inline;
+    either way the wall lands in the ingest_report's per-file write_s and
+    the `write` phase counter."""
+    from . import cache as cache_lib
+    wsec = obs.counter("ingest_seconds_total",
+                       "cold-ingest wall seconds by phase "
+                       "(docs/OBSERVABILITY.md ingest_report)")
+
+    def record(dt: float) -> None:
+        wsec.inc(dt, phase="write")
+        if rec is not None:
+            rec["write_s"] = rec.get("write_s", 0.0) + dt
+
+    if writer is not None:
+        writer.submit(cache_dir, name, arrays, source=source,
+                      delimiter=delimiter, version=version,
+                      supersedes=supersedes, record=record)
+        return
+    t0 = time.perf_counter()
+    cache_lib.write_projected_entry(cache_dir, name, arrays, source=source,
+                                    delimiter=delimiter, version=version,
+                                    supersedes=supersedes)
+    record(time.perf_counter() - t0)
+
+
 def _load_one_projected(item: tuple[int, str], schema: DataSchema,
                         data: DataConfig, feature_dtype: str,
-                        threaded: bool):
+                        threaded: bool, parser_threads: Optional[int] = None,
+                        stats: Optional[list] = None, writer=None):
     """Parse + project + split + wire-cast ONE file; the raw (N, C) matrix
     dies here, so peak memory is (in-flight raw files) + (projected
     columns), never all raw matrices at once.  With a cache_dir the fully
-    PROJECTED result is cached (data/cache.py projected entries): a hit
-    replaces parse + project + split + cast with one npz load."""
+    PROJECTED result is cached (data/cache.py v2 entries: wire-format
+    features, compact target/weight): a hit replaces
+    parse + project + split + quantize with one mmap-backed load.  A v1
+    entry under the old key serves once and is rewritten as v2 (the
+    transparent upgrade; the v1 entry is pruned by the write).  `stats`
+    collects the per-file ingest_report record; `writer` (an
+    AsyncEntryWriter) overlaps entry writes with the pool's parses."""
     from . import cache as cache_lib
     file_idx, path = item
     cache_dir = cache_lib.resolve_cache_dir(data.cache_dir)
+    version = resolved_cache_format(data)
+    rec = {"file": os.path.basename(path), "tier": "parse", "rows": 0,
+           "inflate_s": 0.0, "parse_s": 0.0, "write_s": 0.0}
+    if stats is not None:
+        stats.append(rec)
+    isec = obs.counter("ingest_seconds_total",
+                       "cold-ingest wall seconds by phase "
+                       "(docs/OBSERVABILITY.md ingest_report)")
     name = None
     if cache_dir is not None:
         name = cache_lib.projected_entry_name(
             path, data.delimiter, file_idx, schema, data.valid_ratio,
-            data.split_seed, feature_dtype)
+            data.split_seed, feature_dtype, version=version)
         if name is not None:
+            t_load = time.perf_counter()
             hit = cache_lib.load_projected_entry(cache_dir, name)
+            upgraded = False
+            if hit is None and version >= 2:
+                # transparent v1 upgrade: serve the legacy-keyed entry once,
+                # republish it as v2 (which prunes the v1 bytes)
+                v1name = cache_lib.projected_entry_name(
+                    path, data.delimiter, file_idx, schema, data.valid_ratio,
+                    data.split_seed, feature_dtype, version=1)
+                if v1name is not None:
+                    hit = cache_lib.load_projected_entry(cache_dir, v1name)
+                    upgraded = hit is not None
             if hit is not None:
+                isec.inc(time.perf_counter() - t_load, phase="cache_load")
                 mask = hit.pop("valid_mask")
+                rec.update(tier="cache_v1" if upgraded else "cache",
+                           rows=int(hit["features"].shape[0]))
                 obs.counter("data_cache_hits_total",
-                            "projected-cache hits (one npz/npd load "
+                            "projected-cache hits (one entry load "
                             "replaced parse+project+split+cast)").inc()
                 obs.counter("data_rows_read_total",
                             "rows ingested into datasets").inc(
                     int(hit["features"].shape[0]), source="cache")
+                if upgraded:
+                    obs.counter("data_cache_upgraded_total",
+                                "legacy v1 projected entries rewritten "
+                                "as v2").inc()
+                    # supersedes=v1name: the upgrade removes exactly the
+                    # old-key entry it replaced — the generic prune spares
+                    # other format generations (v1-pinned jobs may share
+                    # the dir)
+                    _write_projected(writer, cache_dir, name,
+                                     {**hit, "valid_mask": mask}, path,
+                                     data.delimiter, version, rec,
+                                     supersedes=v1name)
                 return hit, mask
         obs.counter("data_cache_misses_total",
                     "projected-cache misses (full parse path taken)").inc()
     t_parse = time.perf_counter()
+    if parser_threads is None and threaded:
+        parser_threads = 1  # legacy callers: file-level pool, 1 thread each
+    reader._note_io("raw_cache", 0.0, 0.0, 0)  # raw hits skip read_file;
+    # a stale record from this thread's previous parse must not be charged
+    # write=False when a projected entry will land: the v2 entry IS the
+    # warm-start intermediate, and duplicating the matrix as raw float32
+    # would cost 4x its bytes again on disk (raw hits — this job's earlier
+    # format, or another job's read_files cache — are still served)
     rows = cache_lib.read_file_cached(
         path, data.delimiter, cache_dir=data.cache_dir,
-        parser_threads=1 if threaded else None)
+        parser_threads=parser_threads, write=(name is None))
+    parse_wall = time.perf_counter() - t_parse
+    io_stats = reader.last_io_stats()
+    rec["rows"] = int(rows.shape[0])
+    if io_stats.get("tier") == "raw_cache":
+        # the sentinel survived: no parse ran — a raw `.npy` entry served
+        # (another job's read_files cache, or a pre-v2 run).  Its np.load
+        # wall is cache time, not parse time: charging it to `parse` would
+        # put phantom parse seconds with zero source bytes into the
+        # cold-ingest throughput the perf gate guards
+        rec["tier"] = "raw_cache"
+        isec.inc(parse_wall, phase="cache_load")
+    else:
+        inflate_s = min(max(io_stats.get("inflate_s", 0.0), 0.0),
+                        parse_wall)
+        rec["parse_s"] = round(parse_wall - inflate_s, 6)
+        rec["inflate_s"] = round(inflate_s, 6)
+        isec.inc(inflate_s, phase="inflate")
+        isec.inc(parse_wall - inflate_s, phase="parse")
+        obs.counter("ingest_source_bytes_total",
+                    "source (compressed) bytes cold ingest read").inc(
+            int(io_stats.get("source_bytes", 0)))
     obs.histogram("data_file_parse_seconds",
                   "per-file parse (or raw-cache load) latency").observe(
-        time.perf_counter() - t_parse)
+        parse_wall)
     obs.counter("data_files_read_total", "data files parsed").inc()
     obs.counter("data_rows_read_total",
                 "rows ingested into datasets").inc(
@@ -116,9 +237,86 @@ def _load_one_projected(item: tuple[int, str], schema: DataSchema,
     _, valid_mask = split.train_valid_mask(row_ids, data.valid_ratio,
                                            data.split_seed)
     if cache_dir is not None and name is not None:
-        cache_lib.write_projected_entry(
-            cache_dir, name, {**cols, "valid_mask": valid_mask})
+        _write_projected(writer, cache_dir, name,
+                         {**cols, "valid_mask": valid_mask}, path,
+                         data.delimiter, version, rec)
     return cols, valid_mask
+
+
+def _emit_ingest_report(stats: list, pool_width: int, wall_s: float,
+                        mode: str) -> None:
+    """One `ingest_report` journal event per completed ingest: the pool
+    shape, the per-phase cost split, which cache tier served each file,
+    and a (capped) per-file table — the observable record of the cold/warm
+    ingest gap docs/PERF.md "Data plane" reasons about.  Never raises."""
+    try:
+        files = sorted(stats, key=lambda r: r["file"])
+        tiers: dict[str, int] = {}
+        for r in files:
+            tiers[r["tier"]] = tiers.get(r["tier"], 0) + 1
+        per_file = [
+            {k: (round(v, 6) if isinstance(v, float) else v)
+             for k, v in r.items()} for r in files[:32]]
+        obs.event(
+            "ingest_report", mode=mode, files=len(files),
+            pool_width=int(pool_width), wall_s=round(wall_s, 6),
+            rows=int(sum(r["rows"] for r in files)),
+            parse_s=round(sum(r["parse_s"] for r in files), 6),
+            inflate_s=round(sum(r["inflate_s"] for r in files), 6),
+            write_s=round(sum(r["write_s"] for r in files), 6),
+            tiers=tiers, per_file=per_file,
+            per_file_truncated=len(files) > 32)
+    except Exception:
+        pass  # telemetry must never fail the ingest it measures
+
+
+def _run_ingest_pool(items: Sequence[tuple[int, str]], schema: DataSchema,
+                     data: DataConfig, feature_dtype: str, width: int,
+                     on_result) -> list:
+    """The bounded multi-file ingest pool: `width` part-files inflate+parse
+    concurrently (native parser per file, intra-file threads scaled so
+    total parallelism stays ~cores), with v2 cache writes overlapped on a
+    dedicated writer thread — the cold path never serializes parse behind
+    cache IO.  Each per-file result is handed to `on_result` in file order
+    as soon as it completes (Executor.map yields in submit order while
+    workers run ahead), so a streaming consumer starts before the pool
+    drains.  The writer is closed — every entry durable — before this
+    returns (or before an error propagates); returns the ingest stats."""
+    from . import cache as cache_lib, native_parser
+    stats: list = []
+    writer = (cache_lib.AsyncEntryWriter()
+              if cache_lib.resolve_cache_dir(data.cache_dir) else None)
+    threaded = width > 1 and len(items) > 1
+    pt = native_parser.pool_parser_threads(width) if threaded else None
+    try:
+        def load_one(item):
+            return _load_one_projected(item, schema, data, feature_dtype,
+                                       threaded, parser_threads=pt,
+                                       stats=stats, writer=writer)
+
+        if threaded:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=width) as pool:
+                for res in pool.map(load_one, items):
+                    on_result(res)
+        else:
+            for item in items:
+                on_result(load_one(item))
+    finally:
+        if writer is not None:
+            writer.close()
+    return stats
+
+
+def _pool_load_projected(mine: Sequence[tuple[int, str]], schema: DataSchema,
+                         data: DataConfig, feature_dtype: str,
+                         width: int) -> tuple[list, list]:
+    """_run_ingest_pool collecting into a list: (per-file results in file
+    order, ingest stats)."""
+    results: list = []
+    stats = _run_ingest_pool(mine, schema, data, feature_dtype, width,
+                             results.append)
+    return results, stats
 
 
 def host_file_shard(data: DataConfig, host_index: int = 0,
@@ -153,23 +351,18 @@ def load_datasets(
     """
     if data.out_of_core:
         from .outofcore import load_datasets_out_of_core
-        return load_datasets_out_of_core(schema, data, host_index, num_hosts)
+        return load_datasets_out_of_core(schema, data, host_index, num_hosts,
+                                         feature_dtype=feature_dtype)
 
     # global row ids must be stable across hosts: derive from (file idx, row idx);
     # shard by index so duplicate path strings still get distinct ids
     mine = host_file_shard(data, host_index, num_hosts)
-    num_threads = data.read_threads or min(len(mine), os.cpu_count() or 1)
-    threaded = num_threads > 1 and len(mine) > 1
-
-    def load_one(item):
-        return _load_one_projected(item, schema, data, feature_dtype, threaded)
-
-    if threaded:
-        from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max_workers=num_threads) as pool:
-            results = list(pool.map(load_one, mine))  # map preserves file order
-    else:
-        results = [load_one(m) for m in mine]
+    t_ingest = time.perf_counter()
+    num_threads = ingest_pool_width(data, len(mine))
+    results, stats = _pool_load_projected(mine, schema, data, feature_dtype,
+                                          num_threads)
+    _emit_ingest_report(stats, num_threads,
+                        time.perf_counter() - t_ingest, mode="load")
 
     feats, targs, weights, masks_v = [], [], [], []
     for cols, valid_mask in results:
@@ -224,19 +417,28 @@ def projected_cache_complete(schema: DataSchema, data: DataConfig,
         mine = host_file_shard(data, host_index, num_hosts)
         if not mine:
             return False
+        version = resolved_cache_format(data)
         for file_idx, path in mine:
-            name = cache_lib.projected_entry_name(
-                path, data.delimiter, file_idx, schema, data.valid_ratio,
-                data.split_seed, feature_dtype)
-            if name is None:
-                return False
-            entry = os.path.join(cache_dir, name)
-            # a legacy r4-format .npz entry is just as hot (the loader's
-            # fallback serves it) — counting only the directory form would
-            # permanently disable the fast path for upgraded caches
-            if not (os.path.exists(entry)
-                    or os.path.exists(cache_lib.legacy_projected_path(
-                        entry))):
+            # a v1-keyed entry (or a legacy r4-format .npz under either
+            # key) is just as hot: the loader serves it — and upgrades it
+            # to v2 — in one mmap-speed load, so counting only the current
+            # form would permanently disable the fast path for caches
+            # written by earlier formats
+            versions = (version, 1) if version >= 2 else (version,)
+            hot = False
+            for v in versions:
+                name = cache_lib.projected_entry_name(
+                    path, data.delimiter, file_idx, schema, data.valid_ratio,
+                    data.split_seed, feature_dtype, version=v)
+                if name is None:
+                    return False
+                entry = os.path.join(cache_dir, name)
+                if (os.path.exists(entry)
+                        or os.path.exists(cache_lib.legacy_projected_path(
+                            entry))):
+                    hot = True
+                    break
+            if not hot:
                 return False
         return True
     except OSError:
@@ -461,28 +663,20 @@ class StreamingLoader:
 
     def _produce(self) -> None:
         data = self._data
-        num_threads = (data.read_threads
-                       or min(len(self._items), os.cpu_count() or 1))
-        threaded = num_threads > 1 and len(self._items) > 1
+        t_ingest = time.perf_counter()
+        num_threads = ingest_pool_width(data, len(self._items))
         try:
-            if threaded:
-                from concurrent.futures import ThreadPoolExecutor
-                with ThreadPoolExecutor(max_workers=num_threads) as pool:
-                    # Executor.map yields in submit order while workers run
-                    # ahead — file order stays deterministic
-                    for res in pool.map(
-                            lambda it: _load_one_projected(
-                                it, self._schema, data,
-                                self._feature_dtype, True),
-                            self._items):
-                        self._q.put(res)
-            else:
-                for it in self._items:
-                    self._q.put(_load_one_projected(
-                        it, self._schema, data, self._feature_dtype, False))
+            # the pool's writer is closed (entries durable) before the
+            # stats return — i.e. before the hot-cache probe can run —
+            # and before an error is forwarded to the consumer
+            stats = _run_ingest_pool(self._items, self._schema, data,
+                                     self._feature_dtype, num_threads,
+                                     self._q.put)
         except BaseException as e:  # surface parse errors to the consumer
             self._q.put(e)
             return
+        _emit_ingest_report(stats, num_threads,
+                            time.perf_counter() - t_ingest, mode="stream")
         self._q.put(None)
 
     def first_epoch_blocks(self, batch_size: int, block_batches: int,

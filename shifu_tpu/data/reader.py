@@ -14,6 +14,8 @@ from __future__ import annotations
 import gzip
 import io
 import os
+import threading
+import time
 from typing import Iterable, Iterator, Optional, Sequence
 
 import numpy as np
@@ -24,6 +26,26 @@ except Exception:  # pragma: no cover
     _pd = None
 
 from ..config.schema import DataSchema
+
+# Per-thread record of the most recent read_file call's cost split —
+# {tier, inflate_s, parse_s, source_bytes}.  Thread-local so the ingest
+# pool's concurrent parses never mix records; consumed by the ingest
+# report (data/pipeline.py `ingest_report`, docs/OBSERVABILITY.md).  The
+# native parse tier fuses inflate+parse in C++, so its whole wall lands
+# in parse_s.
+_io_local = threading.local()
+
+
+def _note_io(tier: str, inflate_s: float, parse_s: float,
+             source_bytes: int) -> None:
+    _io_local.stats = {"tier": tier, "inflate_s": inflate_s,
+                       "parse_s": parse_s, "source_bytes": source_bytes}
+
+
+def last_io_stats() -> dict:
+    """This thread's cost split for its most recent `read_file` — empty
+    dict before the first read."""
+    return dict(getattr(_io_local, "stats", {}))
 
 
 def open_maybe_gzip(path: str) -> io.BufferedReader:
@@ -123,13 +145,17 @@ def _parse_ragged(text: str, delimiter: str, ncols: int) -> np.ndarray:
     return np.stack(rows)
 
 
-def _fetch_decompressed(path: str) -> bytes:
-    """Remote fetch + gzip-magic decompress (the one place both live)."""
+def _fetch_decompressed(path: str) -> tuple[bytes, int]:
+    """Remote fetch + gzip-magic decompress (the one place both live).
+    Returns (decompressed bytes, fetched source bytes): the fetched length
+    is the source (compressed) size ingest_source_bytes_total counts —
+    captured here so remote ingest needs no second metadata RPC."""
     from . import fsio
     raw = fsio.read_bytes(path)
+    fetched = len(raw)
     if raw[:2] == b"\x1f\x8b":
         raw = gzip.decompress(raw)
-    return raw
+    return raw, fetched
 
 
 def _parse_bytes(raw: bytes, delimiter: str,
@@ -200,19 +226,42 @@ def read_file(path: str, delimiter: str = "|",
     """
     from . import fsio, native_parser
     if is_parquet(path):
-        return _read_parquet(path)
+        t0 = time.perf_counter()
+        arr = _read_parquet(path)
+        _note_io("parquet", 0.0, time.perf_counter() - t0,
+                 _local_size(path))
+        return arr
     if fsio.is_remote(path):
-        return _parse_bytes(_fetch_decompressed(path), delimiter,
-                            parser_threads)
+        t0 = time.perf_counter()
+        raw, fetched = _fetch_decompressed(path)
+        t1 = time.perf_counter()
+        arr = _parse_bytes(raw, delimiter, parser_threads)
+        _note_io("remote", t1 - t0, time.perf_counter() - t1, fetched)
+        return arr
     if len(delimiter.encode()) == 1 and native_parser.available():
         try:
-            return native_parser.parse_file(path, delimiter,
-                                            threads=parser_threads)
+            t0 = time.perf_counter()
+            arr = native_parser.parse_file(path, delimiter,
+                                           threads=parser_threads)
+            _note_io("native", 0.0, time.perf_counter() - t0,
+                     _local_size(path))
+            return arr
         except RuntimeError:  # engine-internal failure: numpy tier serves
             pass  # (IO errors — FileNotFoundError/OSError — propagate)
+    t0 = time.perf_counter()
     with open_maybe_gzip(path) as f:
         raw = f.read()
-    return parse_rows(raw, delimiter)
+    t1 = time.perf_counter()
+    arr = parse_rows(raw, delimiter)
+    _note_io("numpy", t1 - t0, time.perf_counter() - t1, _local_size(path))
+    return arr
+
+
+def _local_size(path: str) -> int:
+    try:
+        return os.path.getsize(path)
+    except OSError:
+        return 0
 
 
 def read_files(

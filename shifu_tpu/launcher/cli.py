@@ -131,6 +131,19 @@ def build_parser() -> argparse.ArgumentParser:
                          "(local or gs:// hdfs:// URI)")
     pf.add_argument("--json", action="store_true",
                     help="machine-readable profile dict instead of text")
+    ch = sub.add_parser(
+        "cache", help="inspect the columnar data cache: list entries "
+                      "(tier/version/bytes/source) and prune superseded, "
+                      "orphaned, or legacy-format ones (data/cache.py, "
+                      "docs/PERF.md 'Data plane')")
+    ch.add_argument("cache_dir",
+                    help="cache directory (DataConfig.cache_dir / "
+                         "SHIFU_TPU_DATA_CACHE)")
+    ch.add_argument("--prune", action="store_true",
+                    help="remove tmp leftovers, legacy pre-v2 entries, and "
+                         "entries whose source changed or vanished")
+    ch.add_argument("--json", action="store_true",
+                    help="machine-readable entry list instead of text")
     cv = sub.add_parser(
         "chaos-verify", help="audit a finished chaos drill: replay the "
                              "recorded plan against the run journal and "
@@ -989,6 +1002,61 @@ def run_profile(args) -> int:
     return EXIT_OK
 
 
+def run_cache(args) -> int:
+    """`shifu-tpu cache <dir>`: the operator view of the columnar cache —
+    every artifact classified (raw / projected / consolidated dataset,
+    format version, bytes, recorded source, freshness), and `--prune` to
+    reclaim the disk held by superseded, orphaned, legacy, or half-written
+    entries.  File reads only: no jax import."""
+    from ..data import cache as cache_lib
+
+    if not os.path.isdir(args.cache_dir):
+        print(f"cache: no such directory: {args.cache_dir}",
+              file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    try:
+        entries = cache_lib.scan_cache(args.cache_dir)
+    except OSError as e:
+        print(f"cache: {e}", file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    removed = cache_lib.prune_cache(args.cache_dir, entries) \
+        if args.prune else []
+    kept = [e for e in entries if e not in removed]
+    if args.json:
+        print(json.dumps({"cache_dir": args.cache_dir, "entries": kept,
+                          "pruned": removed,
+                          "total_bytes": sum(e["bytes"] for e in kept)}))
+        return EXIT_OK
+    if not entries:
+        print(f"{args.cache_dir}: empty cache")
+        return EXIT_OK
+
+    def line(e):
+        src = e["source"] or "-"
+        ver = e["version"] if e["version"] is not None else "-"
+        return (f"  {e['tier']:<9} v{ver:<3} {e['bytes']:>12,} B  "
+                f"{e['status']:<8} {e['name']}"
+                + (f"  <- {src}" if src != "-" else ""))
+
+    print(f"{args.cache_dir}: {len(kept)} entries, "
+          f"{sum(e['bytes'] for e in kept):,} bytes")
+    for e in kept:
+        print(line(e))
+    if args.prune:
+        print(f"pruned {len(removed)} entries "
+              f"({sum(e['bytes'] for e in removed):,} bytes reclaimed)")
+        for e in removed:
+            print(f"  removed [{e['status']}] {e['name']}")
+    else:
+        stale = [e for e in kept
+                 if e["status"] in cache_lib.PRUNE_STATUSES]
+        if stale:
+            print(f"{len(stale)} prunable entries "
+                  f"({sum(e['bytes'] for e in stale):,} bytes) — "
+                  f"rerun with --prune to reclaim")
+    return EXIT_OK
+
+
 def run_chaos_verify(args) -> int:
     """`shifu-tpu chaos-verify <job_dir>`: audit a finished chaos drill.
 
@@ -1443,6 +1511,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.command == "chaos-verify":
         # likewise journal/plan reads only — no jax import
         return run_chaos_verify(args)
+    if args.command == "cache":
+        # cache-dir file reads only — no jax import
+        return run_cache(args)
     from . import detach as detach_lib
     if args.command == "status":
         return detach_lib.run_status(args.job_dir)

@@ -213,8 +213,9 @@ def profile_summary(path: str) -> Optional[dict]:
     epochs: list[dict] = []
     compiles: dict[str, dict] = {}
     overlap_epochs: list[dict] = []
+    ingests: list[dict] = []
     recovery = {"restore_s": 0.0, "restores": 0, "fallbacks": 0,
-                "preemption_graces": 0, "resumes": 0}
+                "cache_fallbacks": 0, "preemption_graces": 0, "resumes": 0}
     for rec in events:
         kind = rec.get("kind")
         if kind == "goodput":
@@ -251,8 +252,18 @@ def profile_summary(path: str) -> Optional[dict]:
                     recovery["restore_s"] + float(rec.get("dur_s") or 0), 6)
             except (TypeError, ValueError):
                 pass
+        elif kind == "ingest_report":
+            # the cold/warm ingest record (docs/OBSERVABILITY.md): pool
+            # shape, phase split, which cache tier served (per_file capped
+            # at the source — keep the rollup fields only here)
+            ingests.append({k: rec.get(k) for k in
+                            ("mode", "files", "pool_width", "wall_s",
+                             "rows", "parse_s", "inflate_s", "write_s",
+                             "tiers")})
         elif kind == "checkpoint_fallback":
             recovery["fallbacks"] += 1
+        elif kind == "cache_fallback":
+            recovery["cache_fallbacks"] += 1
         elif kind == "preemption_grace":
             recovery["preemption_graces"] += 1
         elif kind == "train_resume":
@@ -291,6 +302,7 @@ def profile_summary(path: str) -> Optional[dict]:
                                   if fracs else None),
         "mfu_max": (round(max(mfus), 6) if mfus else None),
         "overlap": overlap,
+        "ingest": ingests or None,
         # by cost: captured FLOPs first (the honest "expensive" ranking),
         # compile seconds as the tiebreak/no-capture fallback
         "compiled_functions": dict(sorted(
@@ -357,6 +369,14 @@ def render_profile_text(summary: dict) -> str:
                 f"prefetched_next={e.get('prefetched_chunks')}"
                 + (f" eff={eeff:.1%}"
                    if isinstance(eeff, (int, float)) else ""))
+    for ing in summary.get("ingest") or []:
+        tiers = ing.get("tiers") or {}
+        tier_s = " ".join(f"{k}={v}" for k, v in sorted(tiers.items()))
+        lines.append(
+            f"ingest[{ing.get('mode')}]: {ing.get('files')} files "
+            f"x{ing.get('pool_width')} pool in {ing.get('wall_s')}s "
+            f"(inflate {ing.get('inflate_s')}s parse {ing.get('parse_s')}s "
+            f"write {ing.get('write_s')}s; {tier_s})")
     comp = summary.get("compiled_functions") or {}
     if comp:
         lines.append("compiled functions (by cost):")

@@ -451,6 +451,7 @@ def train(job: JobConfig,
     # hide and the loaded tiers (device-resident / staged) are strictly
     # faster than training in file order behind a pointless pipeline.
     stream_loader = None
+    pending_ingest_s = 0.0  # blocking pre-loop ingest, charged to epoch 1
     if train_ds is None:
         host, nhosts = mesh_lib.host_shard_info(mesh) if mesh else (0, 1)
         rate = job.train.bagging_sample_rate
@@ -479,9 +480,15 @@ def train(job: JobConfig,
                                                  host_index=host,
                                                  num_hosts=nhosts)
         else:
+            # blocking ingest (hot cache / loaded tiers / out-of-core):
+            # credited to the FIRST epoch's goodput input bucket below —
+            # the cold-start tax must show up in the ledger, not vanish
+            # into unaccounted pre-epoch wall (docs/PERF.md "Data plane")
+            t_ingest = time.perf_counter()
             train_ds, valid_ds = pipe.load_datasets(
                 job.schema, job.data, host, nhosts,
                 feature_dtype=feature_dtype)
+            pending_ingest_s = time.perf_counter() - t_ingest
     assert valid_ds is not None or stream_loader is not None
 
     # Shifu train.baggingSampleRate: deterministic per-run subsample of the
@@ -913,7 +920,14 @@ def train(job: JobConfig,
         # classified into compile/input/step/checkpoint/restore/eval/other
         # buckets; instrumented compiles and checkpoint saves credit it
         # from their own call sites while it is open
-        obs.goodput.begin_epoch()
+        led_open = obs.goodput.begin_epoch()
+        # the blocking dataset load that ran before the loop is charged to
+        # the first epoch it fed: its seconds go to the input bucket and
+        # its wall extends this epoch's wall at close, so the buckets
+        # still sum to the (extended) wall
+        ingest_wall_s, pending_ingest_s = pending_ingest_s, 0.0
+        if ingest_wall_s > 0:
+            led_open.add("input", ingest_wall_s)
         if pending_loader is not None and epoch > start_epoch:
             # first epoch after the streamed one: the retained dataset's
             # assembly + global shuffle either ran in the background thread
@@ -1305,7 +1319,8 @@ def train(job: JobConfig,
             led.add("input", sum(timer.input_times))
             led.add("step", sum(timer.step_times))
             led.add("eval", valid_time)
-            obs.goodput.end_epoch(epoch, time.perf_counter() - t0)
+            obs.goodput.end_epoch(
+                epoch, time.perf_counter() - t0 + ingest_wall_s)
 
         # overlap report: what the engine hid vs what the device still
         # waited for this epoch (docs/OBSERVABILITY.md).  `exposed` is the

@@ -68,9 +68,16 @@ KEY_KERBEROS_KEYTAB = "shifu.security.kerberos.keytab"
 # dim unsharded.  Example: ".*hidden_layer0.*kernel.*=none,model"
 KEY_SHARDING_RULES = "shifu.sharding.rules"
 KEY_DATA_CACHE_DIR = "shifu.data.cache-dir"
+# cache entry format generation (DataConfig.cache_format): 0 = latest
+# (v2 wire-format entries), 1 pins the legacy v1 layout for mixed-version
+# cache dirs (data/cache.py)
+KEY_DATA_CACHE_FORMAT = "shifu.data.cache-format"
 KEY_DATA_OUT_OF_CORE = "shifu.data.out-of-core"
 KEY_DATA_STAGED = "shifu.data.staged"
 KEY_DATA_READ_THREADS = "shifu.data.read-threads"
+# cold-ingest parse pool width (DataConfig.ingest_workers; 0 = auto —
+# one worker per file capped at cpu_count)
+KEY_DATA_INGEST_WORKERS = "shifu.data.ingest-workers"
 # HBM budget for the device-resident input tier (bytes); datasets above it
 # use the staged-blocks tier
 KEY_DATA_RESIDENT_BYTES = "shifu.data.device-resident-bytes"
@@ -195,6 +202,14 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
     if KEY_DATA_CACHE_DIR in conf:
         import dataclasses
         data = dataclasses.replace(data, cache_dir=conf[KEY_DATA_CACHE_DIR])
+    if KEY_DATA_CACHE_FORMAT in conf:
+        import dataclasses
+        data = dataclasses.replace(
+            data, cache_format=int(conf[KEY_DATA_CACHE_FORMAT]))
+    if KEY_DATA_INGEST_WORKERS in conf:
+        import dataclasses
+        data = dataclasses.replace(
+            data, ingest_workers=int(conf[KEY_DATA_INGEST_WORKERS]))
     if KEY_DATA_OUT_OF_CORE in conf:
         import dataclasses
         data = dataclasses.replace(

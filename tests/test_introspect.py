@@ -307,11 +307,13 @@ def test_status_quick_summary_carries_goodput(tmp_path, monkeypatch):
 # --------------------------------------------------------------- perf gate
 
 
-def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7):
+def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7,
+              cold=300.0):
     return {"value": value, "unit": "samples/sec/chip",
             "goodput": {"goodput_fraction_mean": goodput_frac},
             "xla_compiles": {"total": compiles},
-            "e2e_cached_disk_fraction_of_ceiling": ceiling}
+            "e2e_cached_disk_fraction_of_ceiling": ceiling,
+            "e2e_cold_disk_samples_per_sec_per_chip": cold}
 
 
 @pytest.mark.perf
@@ -349,11 +351,21 @@ def test_perf_gate_fails_each_axis():
     # ...a small dip inside the tolerance passes (normalization drift)
     r = perf_gate.run_gate(_artifact(ceiling=0.6), base)
     assert r["verdict"] == "PASS"
+    # cold-ingest collapse (below the 0.3x --cold-drop default): the
+    # parallel-ingest / cache-v2 cold path re-serialized
+    r = perf_gate.run_gate(_artifact(cold=50.0), base)
+    assert r["verdict"] == "REGRESSION"
+    assert [c for c in r["checks"]
+            if c["name"] == "e2e_cold_throughput"][0]["status"] \
+        == "REGRESSION"
+    # ...a within-noise cold dip passes
+    r = perf_gate.run_gate(_artifact(cold=150.0), base)
+    assert r["verdict"] == "PASS"
     # missing fields on either side SKIP, never fail
     r = perf_gate.run_gate({"value": 100.0}, base)
     assert r["verdict"] == "PASS"
     assert [c["status"] for c in r["checks"]] == ["OK", "SKIP", "SKIP",
-                                                  "SKIP"]
+                                                  "SKIP", "SKIP"]
 
 
 @pytest.mark.perf
@@ -369,7 +381,8 @@ def test_perf_gate_cli_pass_fail_and_check_only(tmp_path):
     fresh_ok.write_text(json.dumps(_artifact()))
     fresh_bad = tmp_path / "fresh_bad.json"
     fresh_bad.write_text(json.dumps(
-        _artifact(value=10.0, goodput_frac=0.1, compiles=100, ceiling=0.1)))
+        _artifact(value=10.0, goodput_frac=0.1, compiles=100, ceiling=0.1,
+                  cold=10.0)))
 
     def run(*args):
         return subprocess.run([sys.executable, gate, *args],

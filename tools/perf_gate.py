@@ -3,7 +3,7 @@ BENCH baseline and exit nonzero on regression.
 
 The repo's first *enforceable* perf trajectory (ISSUE 3): every round the
 driver captures a `BENCH_r*.json`; this gate compares a freshly produced
-`bench_full.json` against the newest of those baselines on four axes —
+`bench_full.json` against the newest of those baselines on five axes —
 
 - **throughput / step time**: the headline resident-tier
   samples/sec/chip (`value`) must not fall below
@@ -26,6 +26,10 @@ driver captures a `BENCH_r*.json`; this gate compares a freshly produced
   (absolute, default 0.2) below the baseline: the guard that future
   changes cannot silently re-serialize the epoch loop the overlap
   engine (ISSUE 4) pipelined.
+- **cold-ingest throughput**: `e2e_cold_disk_samples_per_sec_per_chip`
+  must not fall below `--cold-drop` (ratio, default 0.3) of the
+  baseline — the guard on the parallel ingest pool + wire-format
+  cache-v2 cold path (ISSUE 5).
 
 Checks whose fields are missing on either side are SKIPPED (pre-ledger
 baselines carry no goodput/compile fields), never failed.
@@ -100,7 +104,8 @@ def _num(d: dict, *keys):
 def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
              goodput_drop: float = 0.1,
              compile_factor: float = 2.0,
-             e2e_ceiling_drop: float = 0.2) -> dict:
+             e2e_ceiling_drop: float = 0.2,
+             cold_drop: float = 0.3) -> dict:
     """The comparison itself (pure — unit-tested on synthetic pairs).
     Returns {"checks": [...], "verdict": "PASS"|"REGRESSION"}."""
     checks: list[dict] = []
@@ -150,6 +155,21 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
         limit = be - e2e_ceiling_drop
         check("e2e_ceiling_fraction", fe, be, fe >= limit, round(limit, 4))
 
+    # cold-ingest throughput: the end-to-end cold-start rate (first train
+    # from disk: inflate+parse+project+quantize+H2D+train).  The parallel
+    # ingest pool + v2 cache (ISSUE 5) bought this axis; a drop below the
+    # ratio threshold means someone re-serialized the cold path (a lost
+    # pool, a reintroduced raw-float32 double-write).  Ratio-style like the
+    # headline check: the shared tunnel swings absolute numbers 2-3x.
+    fcold = _num(fresh, "e2e_cold_disk_samples_per_sec_per_chip")
+    bcold = _num(baseline, "e2e_cold_disk_samples_per_sec_per_chip")
+    if fcold is None or bcold is None or bcold <= 0:
+        check("e2e_cold_throughput", fcold, bcold, None, None)
+    else:
+        limit = bcold * cold_drop
+        check("e2e_cold_throughput", fcold, bcold, fcold >= limit,
+              round(limit, 1))
+
     regressed = [c for c in checks if c["status"] == "REGRESSION"]
     return {"checks": checks,
             "verdict": "REGRESSION" if regressed else "PASS"}
@@ -189,6 +209,10 @@ def main(argv=None) -> int:
                    help="max absolute drop in e2e_cached_disk_fraction_of_"
                         "ceiling (the link-normalized e2e number — a drop "
                         "means the epoch loop re-serialized)")
+    p.add_argument("--cold-drop", type=float, default=0.3,
+                   help="fresh e2e_cold_disk_samples_per_sec_per_chip must "
+                        "be >= baseline * this fraction (the cold-ingest "
+                        "axis: parallel parse pool + v2 cache, ISSUE 5)")
     p.add_argument("--check-only", action="store_true",
                    help="tier-1 mode: missing/corrupt artifacts degrade to "
                         "a journaled warning and exit 0")
@@ -228,7 +252,8 @@ def main(argv=None) -> int:
                       value_threshold=args.value_threshold,
                       goodput_drop=args.goodput_drop,
                       compile_factor=args.compile_factor,
-                      e2e_ceiling_drop=args.e2e_ceiling_drop)
+                      e2e_ceiling_drop=args.e2e_ceiling_drop,
+                      cold_drop=args.cold_drop)
     report["fresh"] = args.fresh
     report["baseline"] = baseline_path
     _journal("perf_gate", verdict=report["verdict"],
