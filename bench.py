@@ -54,17 +54,13 @@ BASELINE_SAMPLES_PER_SEC_PER_CHIP = 10_000_000 / 16  # v5e-16 north star
 # target
 from shifu_tpu.obs.goodput import PEAK_BF16_TFLOPS as _PEAK_BF16_TFLOPS
 
-# peak HBM GB/s per chip (public specs) — the roofline that actually binds
-# the embedding rungs (VERDICT r3 weak #4: MFU is meaningless for a
-# gather/segment-sum-bound program; fraction-of-HBM is the honest lens)
-_PEAK_HBM_GBPS = (
-    ("v6", 1640.0),      # Trillium / v6e
-    ("v5p", 2765.0),
-    ("v5", 819.0),       # v5e
-    ("v4", 1228.0),
-    ("v3", 900.0),
-    ("v2", 700.0),
-)
+# peak HBM GB/s per chip lives in obs/devprof.py now (ONE table feeding
+# bench's embedding-rung rooflines AND the flight recorder's per-kernel
+# bound verdicts, with the SHIFU_TPU_PEAK_HBM_GBPS override) — the
+# roofline that actually binds the embedding rungs (VERDICT r3 weak #4:
+# MFU is meaningless for a gather/segment-sum-bound program;
+# fraction-of-HBM is the honest lens)
+from shifu_tpu.obs.devprof import PEAK_HBM_GBPS as _PEAK_HBM_GBPS
 
 
 def _peak_lookup(table, device_kind: str):
@@ -670,6 +666,53 @@ def main() -> None:
               "per_batch_dispatch_fixed_overhead_ms":
               dispatch_diag["fixed_overhead_ms"]}
 
+    # -- device flight recorder sample (ISSUE 6) ----------------------------
+    # a ~3-dispatch jax.profiler window over the per-batch step, rolled into
+    # per-kernel device time (obs/tracefmt.py) with roofline attribution —
+    # the artifact names WHICH kernels own the step, round over round
+    # (tools/trace_diff.py diffs these).  Best-effort: a backend whose
+    # profiler misbehaves skips the field, never the bench.
+    try:
+        if not _past_deadline(0.25):
+            import shutil
+            import tempfile
+
+            from shifu_tpu.obs import devprof as devprof_mod
+            from shifu_tpu.obs import introspect as introspect_mod
+            from shifu_tpu.obs import tracefmt as tracefmt_mod
+            tdir = tempfile.mkdtemp(prefix="bench_trace_")
+            try:
+                st_trace = state2
+                disp0 = introspect_mod.dispatch_counts()
+                jax.profiler.start_trace(tdir)
+                try:
+                    for _ in range(3):
+                        st_trace, m = train_step(st_trace, batch)
+                    float(m["loss"])
+                finally:
+                    jax.profiler.stop_trace()
+                    # the steps donated their input state: state2 must
+                    # follow the live tree even when a traced step failed
+                    # mid-loop
+                    state2 = st_trace
+                rollup = tracefmt_mod.rollup_trace_dir(tdir, top_k=8)
+            finally:
+                # a failed step or parse must not strand multi-MB
+                # profiler captures in /tmp per bench run
+                shutil.rmtree(tdir, ignore_errors=True)
+            if rollup:
+                disp = {k: n - disp0.get(k, 0) for k, n in
+                        introspect_mod.dispatch_counts().items()
+                        if n - disp0.get(k, 0) > 0}
+                devprof_mod.roofline_join(rollup, dispatches=disp or None)
+                extras["device_profile_window_us"] = rollup["window_us"]
+                extras["device_profile_top"] = [
+                    {k: kr.get(k) for k in ("name", "calls", "device_us",
+                                            "fraction", "bound")}
+                    for kr in rollup["kernels"][:8]]
+    except Exception as e:
+        extras["device_profile_error"] = str(e)[:200]
+
     # -- device-resident tier on the int8 wire ------------------------------
     # features sit in HBM at 1 B each (half the bf16 footprint: twice the
     # rows fit DataConfig.device_resident_bytes) and dequantize inside the
@@ -1246,6 +1289,18 @@ def main() -> None:
                 ohid / (ohid + oexp), 4)
             extras["e2e_overlap_hidden_seconds"] = round(ohid, 3)
             extras["e2e_overlap_exposed_seconds"] = round(oexp, 3)
+        # device HBM watermark (ISSUE 6): the run's device-memory high
+        # water — live allocator stats where the backend has them, the
+        # XLA memory-analysis estimate elsewhere — the field
+        # tools/perf_gate.py's hbm axis diffs across rounds
+        from shifu_tpu.obs import devprof as devprof_mod
+        snap = devprof_mod.hbm_snapshot()
+        if snap.get("peak_bytes"):
+            extras["device_hbm_peak_bytes"] = int(snap["peak_bytes"])
+            extras["device_hbm_source"] = snap["source"]
+            if snap.get("bytes_in_use"):
+                extras["device_hbm_bytes_in_use"] = int(
+                    snap["bytes_in_use"])
     except Exception:
         pass
     full = {
@@ -1319,6 +1374,7 @@ _HEADLINE_OPTIONAL = (
     "score_single_row_per_sec_native_median",
     "parse_rows_per_sec",
     "per_batch_dispatch_samples_per_sec_per_chip",
+    "device_hbm_peak_bytes",
     "phases",
     "e2e_error", "staged_error", "ladder_error",
     "e2e_skipped", "staged_skipped", "ladder_skipped",

@@ -7,6 +7,7 @@ from .schema import (
     JobConfig,
     MeshConfig,
     ModelSpec,
+    ObsConfig,
     OptimizerConfig,
     RuntimeConfig,
     TrainConfig,
@@ -26,6 +27,7 @@ __all__ = [
     "JobConfig",
     "MeshConfig",
     "ModelSpec",
+    "ObsConfig",
     "OptimizerConfig",
     "RuntimeConfig",
     "TrainConfig",

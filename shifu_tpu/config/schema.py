@@ -458,6 +458,65 @@ class TrainConfig:
 
 
 # ---------------------------------------------------------------------------
+# Observability (device flight recorder — obs/devprof.py)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ObsConfig:
+    """Device-profiling plane knobs (docs/OBSERVABILITY.md "Device flight
+    recorder").  The reference's only profiling hook was a dead
+    start_tensorboard (ssgd_monitor.py:493-502); here trace capture is a
+    scheduled, bounded, journaled part of the train loop."""
+
+    # which epochs capture a jax.profiler trace window, parsed into a
+    # per-kernel `device_profile` journal event: "off" (default — the
+    # flight recorder ring/watermarks stay on, only the profiler is
+    # idle), "first" (the first trained epoch only), "every:N", or a
+    # comma list of epoch numbers ("0,2,5").
+    trace_epochs: str = "off"
+    # where trace windows land; "" anchors a trace/ dir beside the
+    # telemetry sinks (local job dirs; remote telemetry disables capture
+    # — jax.profiler writes real files).
+    trace_dir: str = ""
+    # per-kernel rollup rows kept in the device_profile event (the tail
+    # folds into other_us) — bounds journal bytes and label cardinality.
+    trace_top_k: int = 16
+    # poll device.memory_stats() at epoch boundaries into hbm_* gauges +
+    # an hbm_watermark event (XLA memory-analysis estimate on backends
+    # without allocator stats).
+    hbm_watermarks: bool = True
+    # flight recorder: ring size (last K per-chunk timings), the robust
+    # z-score an anomalous chunk must exceed, how many prior chunks the
+    # detector needs before judging, and the minimum slowdown ratio over
+    # the ring median (the guard that keeps near-constant quiet series
+    # from flagging scheduler jitter).
+    anomaly_window: int = 32
+    anomaly_zscore: float = 6.0
+    anomaly_min_chunks: int = 8
+    anomaly_min_ratio: float = 0.5
+
+    def validate(self) -> None:
+        from ..obs import devprof  # parse, don't duplicate the grammar
+        try:
+            devprof.parse_trace_epochs(self.trace_epochs)
+        except ValueError as e:
+            raise ConfigError(str(e))
+        if self.trace_top_k < 1:
+            raise ConfigError(
+                f"obs.trace_top_k must be >= 1: {self.trace_top_k}")
+        if self.anomaly_window < 4:
+            raise ConfigError(
+                f"obs.anomaly_window must be >= 4: {self.anomaly_window}")
+        if self.anomaly_zscore <= 0 or self.anomaly_min_ratio < 0:
+            raise ConfigError(
+                "obs.anomaly_zscore must be > 0 and anomaly_min_ratio >= 0")
+        if self.anomaly_min_chunks < 2:
+            raise ConfigError(
+                f"obs.anomaly_min_chunks must be >= 2: "
+                f"{self.anomaly_min_chunks}")
+
+
+# ---------------------------------------------------------------------------
 # Runtime / parallelism
 # ---------------------------------------------------------------------------
 
@@ -579,6 +638,7 @@ class JobConfig:
     model: ModelSpec = field(default_factory=ModelSpec)
     train: TrainConfig = field(default_factory=TrainConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     def validate(self) -> "JobConfig":
         self.schema.validate()
@@ -586,6 +646,7 @@ class JobConfig:
         self.model.validate()
         self.train.validate()
         self.runtime.mesh.validate()
+        self.obs.validate()
         if self.train.bagging_sample_rate < 1.0 and self.data.out_of_core:
             # subsampling fancy-indexes the dataset, which would materialize
             # memmap-backed out-of-core shards into RAM

@@ -131,6 +131,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "(local or gs:// hdfs:// URI)")
     pf.add_argument("--json", action="store_true",
                     help="machine-readable profile dict instead of text")
+    tr = sub.add_parser(
+        "trace", help="render a job's device flight recorder: per-kernel "
+                      "device-time rollups from the captured trace "
+                      "windows (compute- vs HBM-bound), the anomaly log "
+                      "with its per-chunk ring, and HBM watermarks "
+                      "(docs/OBSERVABILITY.md 'Device flight recorder')")
+    tr.add_argument("job_dir",
+                    help="job dir, telemetry dir, or journal.jsonl path "
+                         "(local or gs:// hdfs:// URI)")
+    tr.add_argument("--json", action="store_true",
+                    help="machine-readable trace dict instead of text")
     ch = sub.add_parser(
         "cache", help="inspect the columnar data cache: list entries "
                       "(tier/version/bytes/source) and prune superseded, "
@@ -1002,6 +1013,30 @@ def run_profile(args) -> int:
     return EXIT_OK
 
 
+def run_trace(args) -> int:
+    """`shifu-tpu trace <dir>`: the device flight-recorder view of a run —
+    which kernels own the device time (and whether each is compute- or
+    HBM-bound), what the anomaly detector caught, and where HBM peaked —
+    straight from the `device_profile` / `anomaly` / `hbm_watermark`
+    journal events (obs/devprof.py)."""
+    from ..obs import render as obs_render
+
+    try:
+        summary = obs_render.trace_summary(args.job_dir)
+    except Exception as e:
+        print(f"trace: {e}", file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    if summary is None:
+        print(f"no telemetry journal found under {args.job_dir} (expected "
+              f"<job_dir>/telemetry/journal.jsonl — run with "
+              f"SHIFU_TPU_METRICS_DIR or a CLI train job)",
+              file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    print(json.dumps(summary) if args.json
+          else obs_render.render_trace_text(summary))
+    return EXIT_OK
+
+
 def run_cache(args) -> int:
     """`shifu-tpu cache <dir>`: the operator view of the columnar cache —
     every artifact classified (raw / projected / consolidated dataset,
@@ -1508,6 +1543,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.command == "profile":
         # likewise journal reads only — no jax import
         return run_profile(args)
+    if args.command == "trace":
+        # likewise journal reads only — no jax import
+        return run_trace(args)
     if args.command == "chaos-verify":
         # likewise journal/plan reads only — no jax import
         return run_chaos_verify(args)

@@ -220,6 +220,7 @@ def _telemetry_quick_summary(jpath: str) -> Optional[dict]:
             tail = (tail + chunk)[-65536:]
     last_kind = None
     goodput = None
+    hbm = None
     for line in reversed(tail.splitlines()):
         try:
             rec = json.loads(line)
@@ -237,11 +238,20 @@ def _telemetry_quick_summary(jpath: str) -> Optional[dict]:
             goodput = {"epoch": rec.get("epoch"),
                        "goodput_fraction": rec.get("goodput_fraction"),
                        "mfu": rec.get("mfu")}
-        if last_kind is not None and goodput is not None:
+        if hbm is None and rec.get("kind") == "hbm_watermark":
+            # latest HBM watermark (obs/devprof.py): the at-a-glance
+            # "how close to the memory cliff" number next to goodput
+            hbm = {"epoch": rec.get("epoch"),
+                   "peak_bytes": rec.get("peak_bytes"),
+                   "bytes_in_use": rec.get("bytes_in_use"),
+                   "source": rec.get("source")}
+        if last_kind is not None and goodput is not None and hbm is not None:
             break
     out = {"events": n, "last_event": last_kind}
     if goodput is not None:
         out["goodput"] = goodput
+    if hbm is not None:
+        out["hbm"] = hbm
     return out
 
 

@@ -24,17 +24,21 @@ and `shifu-tpu profile`.  On top of the pillars, ISSUE 3 adds
 `xla_compile` events) and `obs/goodput.py` (the per-epoch goodput
 ledger: wall time classified into compile / input / step / checkpoint /
 restore / eval / other buckets, with MFU against a per-platform peak
-table) — docs/PERF.md "Goodput & MFU".
+table) — docs/PERF.md "Goodput & MFU".  ISSUE 6 opens the `step` bucket
+itself: `obs/devprof.py` + `obs/tracefmt.py` (the device flight
+recorder — per-kernel device-time rollups from scheduled jax.profiler
+windows, roofline attribution, HBM watermarks, and an anomaly-triggered
+one-shot trace), rendered by `shifu-tpu trace`.
 """
 
 from __future__ import annotations
 
-from . import (aggregate, goodput, introspect, journal, metrics,  # noqa: F401
-               render, spans)
+from . import (aggregate, devprof, goodput, introspect,  # noqa: F401
+               journal, metrics, render, spans, tracefmt)
 from ._sinks import (ENV_METRICS_DIR, SCRAPE_FILE, configure,  # noqa: F401
                      configure_from_env, event, flush, get_journal,
-                     reset_for_tests, resolve_metrics_dir, set_journal,
-                     shutdown)
+                     metrics_dir, reset_for_tests, resolve_metrics_dir,
+                     set_journal, shutdown)
 from .journal import RunJournal, read_journal, tail_journal  # noqa: F401
 from .metrics import (MetricsRegistry, counter, default_registry,  # noqa: F401
                       gauge, histogram)

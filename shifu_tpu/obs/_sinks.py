@@ -21,6 +21,7 @@ SCRAPE_FILE = "metrics.prom"
 _lock = threading.RLock()
 _journal: Optional[journal_mod.RunJournal] = None
 _scrape_path: Optional[str] = None
+_metrics_dir: Optional[str] = None
 
 
 def _join(base: str, name: str) -> str:
@@ -48,13 +49,14 @@ def configure(metrics_dir: str, scrape: bool = True,
     whole-object rewrites of the writer's OWN lines — two writers on one
     object would erase each other; obs/render.py merges the sidecar).
     Reconfiguring closes the previous journal."""
-    global _journal, _scrape_path
+    global _journal, _scrape_path, _metrics_dir
     with _lock:
         if _journal is not None:
             _journal.close()
         _journal = journal_mod.RunJournal(
             _join(metrics_dir, journal_name), flush_every=flush_every)
         _scrape_path = _join(metrics_dir, SCRAPE_FILE) if scrape else None
+        _metrics_dir = metrics_dir
         return _journal
 
 
@@ -85,6 +87,12 @@ def configure_from_env() -> bool:
 
 def get_journal() -> Optional[journal_mod.RunJournal]:
     return _journal
+
+
+def metrics_dir() -> Optional[str]:
+    """The directory the sinks were configured at (None until then) —
+    siblings like the device-trace dir (obs/devprof.py) anchor here."""
+    return _metrics_dir
 
 
 def event(kind: str, **fields) -> Optional[dict]:
@@ -120,7 +128,7 @@ def shutdown() -> None:
 
 def reset_for_tests() -> None:
     """Tear down all global telemetry state (tests only)."""
-    global _journal, _scrape_path
+    global _journal, _scrape_path, _metrics_dir
     with _lock:
         if _journal is not None:
             try:
@@ -129,6 +137,7 @@ def reset_for_tests() -> None:
                 pass
         _journal = None
         _scrape_path = None
+        _metrics_dir = None
         metrics_mod.default_registry().clear()
         from . import goodput, introspect
         introspect.reset_for_tests()

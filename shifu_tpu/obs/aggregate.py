@@ -104,8 +104,19 @@ def epoch_skew(epoch: int, input_seconds: float, epoch_seconds: float,
 
     if jax.process_count() <= 1:
         return None
+    # per-host HBM high water rides the same gather: a host leaking
+    # device memory shows up as a named outlier in the skew table, the
+    # multihost complement of the chief-local hbm_watermark event
+    extra = {}
+    try:
+        from . import devprof
+        snap = devprof.hbm_snapshot()
+        if snap.get("peak_bytes"):
+            extra["hbm_peak_bytes"] = int(snap["peak_bytes"])
+    except Exception:
+        pass
     rows = gather_host_summaries(host_summary(
-        input_seconds, epoch_seconds, valid_seconds))
+        input_seconds, epoch_seconds, valid_seconds, **extra))
     if jax.process_index() != 0:
         return None
     if console is not None:

@@ -70,6 +70,15 @@ def stats() -> dict[str, dict]:
         return {k: dict(v) for k, v in _stats.items()}
 
 
+def dispatch_counts() -> dict[str, int]:
+    """Per-function dispatch tallies (every call, compiling or cached).
+    The device flight recorder snapshots this around a trace window to
+    scale per-dispatch cost_analysis numbers to the work the window
+    actually executed (obs/devprof.roofline_join)."""
+    with _lock:
+        return {k: int(v.get("dispatches", 0)) for k, v in _stats.items()}
+
+
 def _aval(x):
     """Shape/dtype/sharding abstraction of a pytree leaf — enough to
     re-lower without touching buffers (donated args stay untouched).
@@ -214,6 +223,15 @@ class InstrumentedJit:
         self._capture = capture_enabled()
         self._flops_by_sig: dict[tuple, float] = {}
 
+    def _note_dispatch(self) -> None:
+        # per-name dispatch tally: a plain dict bump (GIL-atomic enough —
+        # an off-by-one under a race is noise next to the window sizes
+        # devprof divides by), skipped until the first compile creates
+        # the stats entry, so the steady-state cost is one dict.get
+        st = _stats.get(self.name)
+        if st is not None:
+            st["dispatches"] = st.get("dispatches", 0) + 1
+
     def _sig_of(self, args, kwargs):
         # AFTER the call is safe: donation deletes buffer *data*, but the
         # shape/dtype metadata _signature reads stays accessible — so the
@@ -247,12 +265,14 @@ class InstrumentedJit:
                         self._flops_by_sig[sig] = analysis["flops"]
                         from . import goodput
                         goodput.note_flops(analysis["flops"])
+                    self._note_dispatch()
                     return out
         if self._flops_by_sig:  # MFU numerator: credit per dispatch
             flops = self._flops_by_sig.get(self._sig_of(args, kwargs))
             if flops:
                 from . import goodput
                 goodput.note_flops(flops)
+        self._note_dispatch()
         return out
 
     def lower(self, *args, **kwargs):

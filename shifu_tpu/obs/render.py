@@ -214,6 +214,11 @@ def profile_summary(path: str) -> Optional[dict]:
     compiles: dict[str, dict] = {}
     overlap_epochs: list[dict] = []
     ingests: list[dict] = []
+    profiles: list[dict] = []
+    hbm_peak = 0
+    hbm_last: Optional[dict] = None
+    anomalies = 0
+    trace_fallbacks = 0
     recovery = {"restore_s": 0.0, "restores": 0, "fallbacks": 0,
                 "cache_fallbacks": 0, "preemption_graces": 0, "resumes": 0}
     for rec in events:
@@ -268,6 +273,18 @@ def profile_summary(path: str) -> Optional[dict]:
             recovery["preemption_graces"] += 1
         elif kind == "train_resume":
             recovery["resumes"] += 1
+        elif kind == "device_profile":
+            profiles.append(rec)
+        elif kind == "hbm_watermark":
+            hbm_last = rec
+            try:
+                hbm_peak = max(hbm_peak, int(rec.get("peak_bytes") or 0))
+            except (TypeError, ValueError):
+                pass
+        elif kind == "anomaly":
+            anomalies += 1
+        elif kind == "trace_fallback":
+            trace_fallbacks += 1
 
     totals: dict[str, float] = {}
     fracs, mfus = [], []
@@ -311,6 +328,26 @@ def profile_summary(path: str) -> Optional[dict]:
                             -kv[1]["compile_s"]))),
         "recovery": recovery,
     }
+    # device flight recorder rollup (docs/PERF.md "Where the step time
+    # goes"): the last device profile's top kernels next to the goodput
+    # buckets they decompose, plus the HBM high water and anomaly count
+    device: dict = {}
+    if profiles:
+        last = profiles[-1]
+        device["profiles"] = len(profiles)
+        device["last"] = {k: last.get(k) for k in
+                          ("epoch", "trigger", "window_us",
+                           "device_us_total", "device_fraction",
+                           "kernel_count", "kernels")}
+    if hbm_last is not None:
+        device["hbm_peak_bytes"] = hbm_peak
+        device["hbm_source"] = hbm_last.get("source")
+        device["hbm_bytes_in_use"] = hbm_last.get("bytes_in_use")
+    if anomalies:
+        device["anomalies"] = anomalies
+    if trace_fallbacks:
+        device["trace_fallbacks"] = trace_fallbacks
+    out["device"] = device or None
     return out
 
 
@@ -394,6 +431,29 @@ def render_profile_text(summary: dict) -> str:
                 parts.append("cache " + "/".join(
                     f"{k}={v}" for k, v in sorted(cache.items())))
             lines.append(" ".join(parts))
+    device = summary.get("device") or {}
+    if device:
+        bits = []
+        if device.get("hbm_peak_bytes") is not None:
+            bits.append(f"hbm peak {device['hbm_peak_bytes']:,} B "
+                        f"({device.get('hbm_source')})")
+        if device.get("profiles"):
+            bits.append(f"{device['profiles']} device profile(s)")
+        if device.get("anomalies"):
+            bits.append(f"{device['anomalies']} anomaly(ies)")
+        if device.get("trace_fallbacks"):
+            bits.append(f"{device['trace_fallbacks']} trace fallback(s)")
+        if bits:
+            lines.append("device: " + ", ".join(bits)
+                         + "  (`shifu-tpu trace` for the kernel table)")
+        last = device.get("last") or {}
+        for k in (last.get("kernels") or [])[:5]:
+            frac = k.get("fraction")
+            lines.append(
+                f"  kernel {k.get('name')}: {k.get('device_us')}us"
+                + (f" ({frac:.1%} of window)"
+                   if isinstance(frac, (int, float)) else "")
+                + (f" [{k['bound']}-bound]" if k.get("bound") else ""))
     rec = summary.get("recovery") or {}
     if any(rec.get(k) for k in ("restores", "fallbacks",
                                 "preemption_graces", "resumes")):
@@ -403,4 +463,111 @@ def render_profile_text(summary: dict) -> str:
             f"{rec.get('fallbacks', 0)} fallback(s), "
             f"{rec.get('preemption_graces', 0)} preemption grace(s), "
             f"{rec.get('resumes', 0)} resume(s)")
+    return "\n".join(lines)
+
+
+# -- `shifu-tpu trace`: the device flight-recorder view ---------------------
+
+def trace_summary(path: str) -> Optional[dict]:
+    """The device flight-recorder dict for a job/telemetry dir: every
+    `device_profile` rollup (scheduled windows + anomaly one-shots), the
+    anomaly log with its ring context, HBM watermark trajectory, and
+    trace fallbacks — assembled purely from journal events
+    (obs/devprof.py writes them).  None when no journal is found."""
+    jpath = find_journal(path)
+    if jpath is None:
+        return None
+    events = _load_events(jpath)
+    profiles: list[dict] = []
+    anomalies: list[dict] = []
+    watermarks: list[dict] = []
+    fallbacks: list[dict] = []
+    for rec in events:
+        kind = rec.get("kind")
+        if kind == "device_profile":
+            profiles.append({k: rec.get(k) for k in
+                             ("epoch", "trigger", "trace_dir", "window_us",
+                              "device_us_total", "device_fraction", "lanes",
+                              "kernel_count", "kernels", "other_us",
+                              "modules", "peak_tflops", "peak_hbm_gbps",
+                              "capture_wall_s")})
+        elif kind == "anomaly":
+            anomalies.append({k: rec.get(k) for k in
+                              ("epoch", "chunk", "step_s", "median_s",
+                               "mad_s", "zscore", "window", "ring")})
+        elif kind == "hbm_watermark":
+            watermarks.append({k: rec.get(k) for k in
+                               ("epoch", "source", "bytes_in_use",
+                                "peak_bytes", "bytes_limit",
+                                "device_count")})
+        elif kind == "trace_fallback":
+            fallbacks.append({k: rec.get(k) for k in
+                              ("epoch", "stage", "error")})
+    peaks = [w.get("peak_bytes") for w in watermarks
+             if isinstance(w.get("peak_bytes"), (int, float))]
+    return {
+        "journal": jpath,
+        "profiles": profiles,
+        "anomalies": anomalies,
+        "watermarks": watermarks,
+        "hbm_peak_bytes": max(peaks) if peaks else None,
+        "trace_fallbacks": fallbacks,
+    }
+
+
+def render_trace_text(summary: dict) -> str:
+    """Human rendering of `trace_summary`: per-capture kernel tables,
+    the anomaly log, and the HBM watermark trajectory."""
+    lines = [f"journal: {summary['journal']}"]
+    profiles = summary.get("profiles") or []
+    if not profiles:
+        lines.append("no device_profile events — enable trace capture "
+                     "with obs.trace_epochs (shifu.obs.trace-epochs), "
+                     "e.g. 'first' (docs/OBSERVABILITY.md)")
+    for p in profiles:
+        frac = p.get("device_fraction")
+        lines.append(
+            f"device profile: epoch {p.get('epoch')} "
+            f"trigger={p.get('trigger')} window {p.get('window_us')}us "
+            f"device {p.get('device_us_total')}us"
+            + (f" ({frac:.1%} busy)" if isinstance(frac, (int, float))
+               else "")
+            + f" kernels={p.get('kernel_count')}")
+        kernels = p.get("kernels") or []
+        if kernels:
+            lines.append(f"  {'kernel':<40} {'calls':>6} {'device_us':>12} "
+                         f"{'frac':>7} {'bound':>8}")
+        for k in kernels:
+            kfrac = k.get("fraction")
+            lines.append(
+                f"  {str(k.get('name'))[:40]:<40} {k.get('calls', 0):>6} "
+                f"{k.get('device_us', 0):>12} "
+                f"{(format(kfrac, '.2%') if isinstance(kfrac, (int, float)) else '-'):>7} "
+                f"{(k.get('bound') or '-'):>8}")
+        other = p.get("other_us")
+        if other:
+            lines.append(f"  (+{other}us across "
+                         f"{p.get('kernel_count', 0) - len(kernels)} more "
+                         f"kernels)")
+    for a in summary.get("anomalies") or []:
+        lines.append(
+            f"anomaly: epoch {a.get('epoch')} chunk {a.get('chunk')} "
+            f"step {a.get('step_s')}s vs median {a.get('median_s')}s "
+            f"(z={a.get('zscore')}, ring of {len(a.get('ring') or [])})")
+    wm = summary.get("watermarks") or []
+    if wm:
+        last = wm[-1]
+        peak = summary.get("hbm_peak_bytes")
+        lines.append(
+            f"hbm: peak {peak:,} B" if isinstance(peak, (int, float))
+            else "hbm: peak -")
+        lines[-1] += (f" in-use {last.get('bytes_in_use'):,} B "
+                      f"source={last.get('source')} "
+                      f"({len(wm)} watermark(s))"
+                      if isinstance(last.get("bytes_in_use"), (int, float))
+                      else f" source={last.get('source')} "
+                           f"({len(wm)} watermark(s))")
+    for f in summary.get("trace_fallbacks") or []:
+        lines.append(f"trace fallback: epoch {f.get('epoch')} "
+                     f"stage={f.get('stage')} error={f.get('error')}")
     return "\n".join(lines)

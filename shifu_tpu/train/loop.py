@@ -815,6 +815,15 @@ def train(job: JobConfig,
     profile_dir = os.environ.get("SHIFU_TPU_PROFILE_DIR")
     timing_on = bool(os.environ.get("SHIFU_TPU_TIMING")) or job.train.log_every_steps > 0
 
+    # device flight recorder (obs/devprof.py): scheduled jax.profiler
+    # windows rolled into per-kernel `device_profile` events, an always-on
+    # per-chunk anomaly ring (fed through StepTimer's chunk hook), and
+    # epoch-boundary HBM watermarks.  Chief only: the profiler traces the
+    # local runtime, and non-chief ranks journal nothing anyway — per-host
+    # HBM still reaches the chief through the skew-table row below.
+    devprof = obs.devprof.DeviceProfiler(job.obs, start_epoch=start_epoch,
+                                         enabled=jax.process_index() == 0)
+
     # Preemption awareness: on SIGTERM (TPU preemption, scheduler kill) save
     # a checkpoint at the next safe point and exit 75 (EX_TEMPFAIL) so the
     # supervisor restarts the job elsewhere — the SPMD successor of hot
@@ -948,11 +957,17 @@ def train(job: JobConfig,
         loss_acc = None
         loss_n = 0
         host_input_times.clear()
-        timer = prof_lib.StepTimer()
+        timer = prof_lib.StepTimer(on_chunk=devprof.chunk_hook(epoch))
         timer.start()
-        trace_ctx = (prof_lib.trace(profile_dir)
-                     if profile_dir and epoch == start_epoch
-                     else prof_lib.maybe_trace(None))
+        # trace seam: the legacy SHIFU_TPU_PROFILE_DIR first-epoch dump
+        # keeps its raw TensorBoard semantics; otherwise the flight
+        # recorder's schedule decides (obs.trace_epochs — a scheduled
+        # epoch's capture closes into a `device_profile` journal event)
+        if profile_dir and epoch == start_epoch:
+            devprof.note_superseded(epoch)  # schedule collision: say so
+            trace_ctx = prof_lib.trace(profile_dir)
+        else:
+            trace_ctx = devprof.epoch_capture(epoch)
         with trace_ctx, obs.span("epoch/train", epoch=epoch):
             streamed_this_epoch = False
             if stream_loader is not None and epoch == start_epoch:
@@ -1322,6 +1337,11 @@ def train(job: JobConfig,
             obs.goodput.end_epoch(
                 epoch, time.perf_counter() - t0 + ingest_wall_s)
 
+        # flight-recorder epoch boundary: close a one-shot anomaly trace
+        # still open (anomaly on the epoch's last chunk) and journal the
+        # HBM watermark next to the goodput record it annotates
+        devprof.end_epoch(epoch)
+
         # overlap report: what the engine hid vs what the device still
         # waited for this epoch (docs/OBSERVABILITY.md).  `exposed` is the
         # consumer-visible input wait (same lens as the ledger's input
@@ -1392,6 +1412,9 @@ def train(job: JobConfig,
         if early_stop_now:
             break
     finally:
+      # never leave jax.profiler tracing, however the loop exits (an open
+      # trace would poison the next capture in this process)
+      devprof.close()
       if feeder is not None:
           # however the loop exits (done, early stop, SIGTERM drain, error):
           # abort the persistent feeder and free its run-ahead device blocks

@@ -19,18 +19,28 @@ from __future__ import annotations
 import contextlib
 import os
 import time
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional
 
 import numpy as np
 
 
 class StepTimer:
-    """Accumulates per-step host/device timings for one epoch."""
+    """Accumulates per-step host/device timings for one epoch.
 
-    def __init__(self) -> None:
+    `on_chunk(input_s, step_s)`, when given, is called at every
+    mark_step_done with the chunk's input wait and dispatch-to-done time
+    — the device flight recorder's feed (obs/devprof.py: ring buffer +
+    anomaly detector), so every input tier gets anomaly detection
+    without per-tier loop changes.  The callback must be cheap (it runs
+    on the chunk boundary) and never raise (exceptions are swallowed —
+    timing must not fail the chunk it times)."""
+
+    def __init__(self, on_chunk: Optional[Callable[[float, float],
+                                                   None]] = None) -> None:
         self.input_times: list[float] = []
         self.step_times: list[float] = []
         self._t: Optional[float] = None
+        self._on_chunk = on_chunk
 
     def start(self) -> None:
         self._t = time.perf_counter()
@@ -46,6 +56,13 @@ class StepTimer:
         if self._t is not None:
             self.step_times.append(now - self._t)
         self._t = now
+        if self._on_chunk is not None and self.step_times:
+            try:
+                self._on_chunk(
+                    self.input_times[-1] if self.input_times else 0.0,
+                    self.step_times[-1])
+            except Exception:
+                pass
 
     def emit(self, prefix: str = "train", **labels) -> None:
         """Feed this epoch's per-step timings into the telemetry registry

@@ -97,6 +97,16 @@ KEY_DATA_OVERLAP_EPOCHS = "shifu.data.overlap-epochs"
 # rows-touched-only embedding optimizer updates: auto / on / off
 # (TrainConfig.sparse_embedding_update, train/sparse_embed.py)
 KEY_TRAIN_SPARSE_EMBED = "shifu.train.sparse-embedding-update"
+# device flight recorder (ObsConfig — obs/devprof.py, docs/OBSERVABILITY.md
+# "Device flight recorder"): trace-window schedule
+# (off/first/every:N/comma-list), capture dir, rollup size, HBM watermark
+# polling, and the anomaly detector's ring/threshold
+KEY_OBS_TRACE_EPOCHS = "shifu.obs.trace-epochs"
+KEY_OBS_TRACE_DIR = "shifu.obs.trace-dir"
+KEY_OBS_TRACE_TOP_K = "shifu.obs.trace-top-k"
+KEY_OBS_HBM_WATERMARKS = "shifu.obs.hbm-watermarks"
+KEY_OBS_ANOMALY_WINDOW = "shifu.obs.anomaly-window"
+KEY_OBS_ANOMALY_ZSCORE = "shifu.obs.anomaly-zscore"
 
 
 def parse_sharding_rules(value: str) -> tuple:
@@ -259,6 +269,19 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
                 conf[KEY_TRAIN_SPARSE_EMBED].strip().lower()))
 
     import dataclasses
+    obs_kw: dict[str, Any] = {}
+    if KEY_OBS_TRACE_EPOCHS in conf:
+        obs_kw["trace_epochs"] = conf[KEY_OBS_TRACE_EPOCHS].strip().lower()
+    if KEY_OBS_TRACE_DIR in conf:
+        obs_kw["trace_dir"] = conf[KEY_OBS_TRACE_DIR]
+    if KEY_OBS_TRACE_TOP_K in conf:
+        obs_kw["trace_top_k"] = int(conf[KEY_OBS_TRACE_TOP_K])
+    if KEY_OBS_HBM_WATERMARKS in conf:
+        obs_kw["hbm_watermarks"] = parse_bool(conf[KEY_OBS_HBM_WATERMARKS])
+    if KEY_OBS_ANOMALY_WINDOW in conf:
+        obs_kw["anomaly_window"] = int(conf[KEY_OBS_ANOMALY_WINDOW])
+    if KEY_OBS_ANOMALY_ZSCORE in conf:
+        obs_kw["anomaly_zscore"] = float(conf[KEY_OBS_ANOMALY_ZSCORE])
     rt_kw: dict[str, Any] = {}
     if KEY_TIMEOUT in conf:
         # reference timeout is milliseconds (client-side kill,
@@ -301,4 +324,14 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
     if rt_kw:
         runtime = dataclasses.replace(runtime, **rt_kw)
 
+    if obs_kw:
+        # only touch `obs` when an obs key is actually set: job-shaped
+        # stubs (and older serialized configs) without the field keep
+        # working through the no-obs path
+        from ..config.schema import ObsConfig
+        base = getattr(job, "obs", None)
+        obs_cfg = (dataclasses.replace(base, **obs_kw)
+                   if base is not None else ObsConfig(**obs_kw))
+        return job.replace(train=train, data=data, runtime=runtime,
+                           obs=obs_cfg)
     return job.replace(train=train, data=data, runtime=runtime)

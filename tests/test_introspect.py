@@ -308,12 +308,13 @@ def test_status_quick_summary_carries_goodput(tmp_path, monkeypatch):
 
 
 def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7,
-              cold=300.0):
+              cold=300.0, hbm=1 << 30):
     return {"value": value, "unit": "samples/sec/chip",
             "goodput": {"goodput_fraction_mean": goodput_frac},
             "xla_compiles": {"total": compiles},
             "e2e_cached_disk_fraction_of_ceiling": ceiling,
-            "e2e_cold_disk_samples_per_sec_per_chip": cold}
+            "e2e_cold_disk_samples_per_sec_per_chip": cold,
+            "device_hbm_peak_bytes": hbm}
 
 
 @pytest.mark.perf
@@ -361,11 +362,44 @@ def test_perf_gate_fails_each_axis():
     # ...a within-noise cold dip passes
     r = perf_gate.run_gate(_artifact(cold=150.0), base)
     assert r["verdict"] == "PASS"
-    # missing fields on either side SKIP, never fail
+    # device HBM footprint explosion (above the 1.5x --hbm-factor default)
+    r = perf_gate.run_gate(_artifact(hbm=2 << 30), base)
+    assert r["verdict"] == "REGRESSION"
+    assert [c for c in r["checks"]
+            if c["name"] == "device_hbm_peak_bytes"][0]["status"] \
+        == "REGRESSION"
+    # ...allocator wobble inside the factor passes
+    r = perf_gate.run_gate(_artifact(hbm=int(1.2 * (1 << 30))), base)
+    assert r["verdict"] == "PASS"
+    # missing fields on either side SKIP, never fail — an artifact that
+    # predates the device flight recorder (no device_hbm_peak_bytes)
+    # still gates the axes it carries
     r = perf_gate.run_gate({"value": 100.0}, base)
     assert r["verdict"] == "PASS"
     assert [c["status"] for c in r["checks"]] == ["OK", "SKIP", "SKIP",
-                                                  "SKIP", "SKIP"]
+                                                  "SKIP", "SKIP", "SKIP"]
+
+
+@pytest.mark.perf
+def test_find_latest_baseline_skips_degraded_rounds(tmp_path):
+    """A round captured on broken hardware (flagged
+    `degraded_accelerator`, e.g. BENCH_r06) must not become the gating
+    baseline — the newest HEALTHY round gates instead."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import perf_gate
+
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"parsed": _artifact()}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": {**_artifact(value=1.0), "degraded_accelerator": "bad"}}))
+    best = perf_gate.find_latest_baseline(str(tmp_path))
+    assert best is not None and best.endswith("BENCH_r01.json")
+    # only degraded rounds left: the newest still serves (degraded vs
+    # degraded is at least consistent), and an empty dir yields None
+    os.remove(tmp_path / "BENCH_r01.json")
+    best = perf_gate.find_latest_baseline(str(tmp_path))
+    assert best is not None and best.endswith("BENCH_r02.json")
+    assert perf_gate.find_latest_baseline(str(tmp_path / "empty")) is None
 
 
 @pytest.mark.perf
@@ -382,7 +416,7 @@ def test_perf_gate_cli_pass_fail_and_check_only(tmp_path):
     fresh_bad = tmp_path / "fresh_bad.json"
     fresh_bad.write_text(json.dumps(
         _artifact(value=10.0, goodput_frac=0.1, compiles=100, ceiling=0.1,
-                  cold=10.0)))
+                  cold=10.0, hbm=8 << 30)))
 
     def run(*args):
         return subprocess.run([sys.executable, gate, *args],

@@ -3,7 +3,7 @@ BENCH baseline and exit nonzero on regression.
 
 The repo's first *enforceable* perf trajectory (ISSUE 3): every round the
 driver captures a `BENCH_r*.json`; this gate compares a freshly produced
-`bench_full.json` against the newest of those baselines on five axes —
+`bench_full.json` against the newest of those baselines on six axes —
 
 - **throughput / step time**: the headline resident-tier
   samples/sec/chip (`value`) must not fall below
@@ -30,9 +30,16 @@ driver captures a `BENCH_r*.json`; this gate compares a freshly produced
   must not fall below `--cold-drop` (ratio, default 0.3) of the
   baseline — the guard on the parallel ingest pool + wire-format
   cache-v2 cold path (ISSUE 5).
+- **device HBM peak**: `device_hbm_peak_bytes` (the device flight
+  recorder's watermark, ISSUE 6) must not exceed `baseline *
+  --hbm-factor` (default 1.5) — a memory-footprint explosion is a
+  capacity regression (the next batch-size bump OOMs) even when
+  throughput survives it.
 
 Checks whose fields are missing on either side are SKIPPED (pre-ledger
-baselines carry no goodput/compile fields), never failed.
+baselines carry no goodput/compile fields; pre-flight-recorder ones no
+device fields), never failed — older baselines keep gating the axes
+they do carry.
 
 `--check-only` is the tier-1 spelling (wired via
 tests/test_introspect.py, `perf` marker): a missing or corrupt baseline
@@ -67,13 +74,27 @@ EXIT_USAGE = 2
 
 
 def find_latest_baseline(root: str = _REPO) -> str | None:
-    """Newest BENCH_r*.json by round number (the driver's capture)."""
-    best, best_n = None, -1
+    """Newest BENCH_r*.json by round number (the driver's capture).
+
+    Rounds whose artifact is flagged `degraded_accelerator` (captured
+    while the shared tunnel delivered broken hardware — e.g. r06's 0.03
+    TFLOP/s against a 197-TFLOP/s part) are skipped: gating against a
+    collapsed baseline would wave every future regression through.  The
+    newest HEALTHY round is the baseline; an unreadable candidate is
+    skipped the same way.
+    """
+    rounds: list[tuple[int, str]] = []
     for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
         m = re.search(r"BENCH_r(\d+)\.json$", path)
-        if m and int(m.group(1)) > best_n:
-            best, best_n = path, int(m.group(1))
-    return best
+        if m:
+            rounds.append((int(m.group(1)), path))
+    for _n, path in sorted(rounds, reverse=True):
+        try:
+            if not load_artifact(path).get("degraded_accelerator"):
+                return path
+        except (OSError, ValueError):
+            continue
+    return rounds and sorted(rounds, reverse=True)[0][1] or None
 
 
 def load_artifact(path: str) -> dict:
@@ -105,7 +126,8 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
              goodput_drop: float = 0.1,
              compile_factor: float = 2.0,
              e2e_ceiling_drop: float = 0.2,
-             cold_drop: float = 0.3) -> dict:
+             cold_drop: float = 0.3,
+             hbm_factor: float = 1.5) -> dict:
     """The comparison itself (pure — unit-tested on synthetic pairs).
     Returns {"checks": [...], "verdict": "PASS"|"REGRESSION"}."""
     checks: list[dict] = []
@@ -170,6 +192,19 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
         check("e2e_cold_throughput", fcold, bcold, fcold >= limit,
               round(limit, 1))
 
+    # device HBM peak: the watermark the flight recorder records at epoch
+    # boundaries (ISSUE 6).  Factor-style upper bound: allocator behavior
+    # wobbles run to run, but a 1.5x footprint jump means a real new
+    # resident (a lost donation, a duplicated table) and eats the headroom
+    # the next scale-up needs.  SKIP when either side predates the field.
+    fh = _num(fresh, "device_hbm_peak_bytes")
+    bh = _num(baseline, "device_hbm_peak_bytes")
+    if fh is None or bh is None or bh <= 0:
+        check("device_hbm_peak_bytes", fh, bh, None, None)
+    else:
+        limit = bh * hbm_factor
+        check("device_hbm_peak_bytes", fh, bh, fh <= limit, round(limit, 1))
+
     regressed = [c for c in checks if c["status"] == "REGRESSION"]
     return {"checks": checks,
             "verdict": "REGRESSION" if regressed else "PASS"}
@@ -213,6 +248,10 @@ def main(argv=None) -> int:
                    help="fresh e2e_cold_disk_samples_per_sec_per_chip must "
                         "be >= baseline * this fraction (the cold-ingest "
                         "axis: parallel parse pool + v2 cache, ISSUE 5)")
+    p.add_argument("--hbm-factor", type=float, default=1.5,
+                   help="fresh device_hbm_peak_bytes must be <= baseline * "
+                        "this factor (the flight recorder's watermark, "
+                        "ISSUE 6; SKIP when either side lacks the field)")
     p.add_argument("--check-only", action="store_true",
                    help="tier-1 mode: missing/corrupt artifacts degrade to "
                         "a journaled warning and exit 0")
@@ -253,7 +292,8 @@ def main(argv=None) -> int:
                       goodput_drop=args.goodput_drop,
                       compile_factor=args.compile_factor,
                       e2e_ceiling_drop=args.e2e_ceiling_drop,
-                      cold_drop=args.cold_drop)
+                      cold_drop=args.cold_drop,
+                      hbm_factor=args.hbm_factor)
     report["fresh"] = args.fresh
     report["baseline"] = baseline_path
     _journal("perf_gate", verdict=report["verdict"],

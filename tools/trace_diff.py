@@ -1,0 +1,145 @@
+"""Diff two device-profile rollups: per-kernel device-time deltas.
+
+The regression-attribution companion to tools/perf_gate.py: the gate says
+*that* a round got slower, this tool says *which kernels* own the
+difference.  Each side is a run's `device_profile` journal event (the
+device flight recorder writes one per captured trace window —
+obs/devprof.py), located from a job dir / telemetry dir / journal path
+exactly like `shifu-tpu trace`, or read from a JSON file holding a raw
+rollup (the `--json` output of `shifu-tpu trace`, or a bare
+device_profile event dict).
+
+Usage:
+    python tools/trace_diff.py <run_A> <run_B> [--epoch N] [--json]
+        [--fail-above PCT]
+
+By default the LAST device_profile of each journal is compared (`--epoch`
+selects a specific captured epoch).  `--fail-above 50` exits 1 when any
+kernel seen on both sides grew more than 50% in device time (or the
+device total did) — wire it after perf_gate when a round needs per-kernel
+accountability, not just a verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+EXIT_PASS = 0
+EXIT_REGRESSION = 1
+EXIT_USAGE = 2
+
+
+def load_rollup(path: str, epoch: int | None = None) -> dict:
+    """One device_profile rollup from a job dir / journal / JSON file.
+    Raises ValueError with the fix spelled out when none is found."""
+    if os.path.isfile(path) and not path.endswith(".jsonl"):
+        with open(path) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict) and doc.get("kernels") is not None:
+            return doc  # a bare rollup / device_profile event
+        if isinstance(doc, dict) and isinstance(doc.get("profiles"), list):
+            profiles = doc["profiles"]  # `shifu-tpu trace --json` output
+        else:
+            raise ValueError(f"{path}: no device_profile rollup found "
+                             "(expected a rollup dict or `shifu-tpu trace "
+                             "--json` output)")
+    else:
+        from shifu_tpu.obs import render as obs_render
+        summary = obs_render.trace_summary(path)
+        if summary is None:
+            raise ValueError(f"{path}: no telemetry journal found")
+        profiles = summary["profiles"]
+    if epoch is not None:
+        profiles = [p for p in profiles if p.get("epoch") == epoch]
+    if not profiles:
+        raise ValueError(
+            f"{path}: no device_profile events"
+            + (f" for epoch {epoch}" if epoch is not None else "")
+            + " — capture one with obs.trace_epochs (docs/OBSERVABILITY.md)")
+    return profiles[-1]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="trace_diff",
+        description="per-kernel device-time deltas between two "
+                    "device_profile rollups (run A vs run B)")
+    p.add_argument("run_a", help="job dir / journal path / rollup JSON "
+                                 "(the baseline side)")
+    p.add_argument("run_b", help="job dir / journal path / rollup JSON "
+                                 "(the fresh side)")
+    p.add_argument("--epoch", type=int, default=None,
+                   help="compare the capture of this epoch (default: the "
+                        "last capture on each side)")
+    p.add_argument("--fail-above", type=float, default=None, metavar="PCT",
+                   help="exit 1 when a kernel present on both sides (or "
+                        "the device total) grew more than PCT%% in device "
+                        "time")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report instead of text")
+    args = p.parse_args(argv)
+
+    from shifu_tpu.obs import tracefmt
+
+    try:
+        a = load_rollup(args.run_a, args.epoch)
+        b = load_rollup(args.run_b, args.epoch)
+    except (OSError, ValueError) as e:
+        print(f"trace-diff: {e}", file=sys.stderr, flush=True)
+        return EXIT_USAGE
+
+    rows = tracefmt.diff_rollups(a, b)
+    tot_a = float(a.get("device_us_total") or 0.0)
+    tot_b = float(b.get("device_us_total") or 0.0)
+    report = {
+        "a": args.run_a, "b": args.run_b,
+        "a_epoch": a.get("epoch"), "b_epoch": b.get("epoch"),
+        "a_device_us_total": round(tot_a, 3),
+        "b_device_us_total": round(tot_b, 3),
+        "total_delta_us": round(tot_b - tot_a, 3),
+        "total_ratio": round(tot_b / tot_a, 4) if tot_a > 0 else None,
+        "kernels": rows,
+    }
+    verdict = "PASS"
+    if args.fail_above is not None:
+        limit = 1.0 + args.fail_above / 100.0
+        blamed = [r for r in rows
+                  if r["a_us"] > 0 and r["b_us"] > 0
+                  and r["b_us"] > r["a_us"] * limit]
+        if tot_a > 0 and tot_b > tot_a * limit:
+            blamed.append({"name": "<device total>", "a_us": tot_a,
+                           "b_us": tot_b})
+        if blamed:
+            verdict = "REGRESSION"
+        report["blamed"] = [r["name"] for r in blamed]
+    report["verdict"] = verdict
+
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(f"trace-diff: {report['verdict']} — device total "
+              f"{report['a_device_us_total']}us -> "
+              f"{report['b_device_us_total']}us "
+              f"(delta {report['total_delta_us']}us"
+              + (f", x{report['total_ratio']}" if report["total_ratio"]
+                 else "") + ")")
+        print(f"  {'kernel':<40} {'A_us':>12} {'B_us':>12} {'delta':>12} "
+              f"{'ratio':>7}")
+        for r in rows:
+            ratio = f"x{r['ratio']}" if r["ratio"] is not None else "new"
+            print(f"  {r['name'][:40]:<40} {r['a_us']:>12} {r['b_us']:>12} "
+                  f"{r['delta_us']:>12} {ratio:>7}")
+        if report.get("blamed"):
+            print("  blamed: " + ", ".join(report["blamed"]))
+    return EXIT_PASS if verdict == "PASS" else EXIT_REGRESSION
+
+
+if __name__ == "__main__":
+    sys.exit(main())
