@@ -1000,6 +1000,33 @@ def main() -> None:
         _rate_stats(extras, "score_single_row_per_sec_native",
                     lambda: nscorer.compute(one_row), 1, reps=2000)
         nscorer.close()
+        # numpy single-row: the engine-matched denominator of the serving
+        # ratio below (daemon-on-numpy vs library-row-loop-on-numpy)
+        _rate_stats(extras, "score_single_row_per_sec_numpy",
+                    lambda: scorer.compute(one_row), 1, reps=500)
+
+        # serving plane (ISSUE 7): the micro-batching daemon's open-loop
+        # loadtest capacity — the highest Poisson-offered single-row rate
+        # it sustains at p99 <= 10ms (runtime/loadtest.py ramp).  The
+        # ratio against score_single_row_per_sec_* above IS the serving
+        # story: same artifact, same host, library row-loop vs daemon.
+        # tools/perf_gate.py gates `serving_scores_per_sec` round-over-
+        # round (--serving-drop).
+        try:
+            from shifu_tpu.runtime import loadtest as loadtest_mod
+            cap = loadtest_mod.find_capacity(
+                export_dir, engine="numpy", p99_target_ms=10.0,
+                start_rate=25_000.0, max_steps=5, step_duration=1.0,
+                senders=1)
+            if cap.get("capacity_scores_per_sec"):
+                extras["serving_scores_per_sec"] = \
+                    cap["capacity_scores_per_sec"]
+                extras["serving_p50_ms"] = cap.get("p50_ms")
+                extras["serving_p99_ms"] = cap.get("p99_ms")
+                extras["serving_batch_mean"] = cap.get("batch_mean")
+                extras["serving_engine"] = cap.get("engine")
+        except Exception as e:
+            extras["serving_error"] = str(e)[:200]
     except Exception:
         pass
 
@@ -1372,6 +1399,8 @@ _HEADLINE_OPTIONAL = (
     "score_rows_per_sec_native",
     "score_single_row_per_sec_native",
     "score_single_row_per_sec_native_median",
+    "serving_scores_per_sec",
+    "serving_p99_ms",
     "parse_rows_per_sec",
     "per_batch_dispatch_samples_per_sec_per_chip",
     "device_hbm_peak_bytes",

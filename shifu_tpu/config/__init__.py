@@ -10,6 +10,7 @@ from .schema import (
     ObsConfig,
     OptimizerConfig,
     RuntimeConfig,
+    ServingConfig,
     TrainConfig,
 )
 from .shifu_compat import (
@@ -30,6 +31,7 @@ __all__ = [
     "ObsConfig",
     "OptimizerConfig",
     "RuntimeConfig",
+    "ServingConfig",
     "TrainConfig",
     "job_config_from_shifu",
     "parse_column_config",

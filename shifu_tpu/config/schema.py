@@ -517,6 +517,73 @@ class ObsConfig:
 
 
 # ---------------------------------------------------------------------------
+# Serving plane (runtime/serve.py — docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ServingConfig:
+    """Knobs for the persistent scoring daemon (`shifu-tpu serve`).
+
+    Standalone, not a JobConfig member: serving is driven from an export
+    ARTIFACT, not a training job — the XML spelling (`shifu.serving.*`,
+    utils/xmlconfig.serving_config_from_conf) layers the same way train
+    keys do, with CLI flags as the top override."""
+
+    # scoring engine tier: auto / native / numpy / stablehlo / jax
+    # (same ladder as `shifu-tpu score --engine`)
+    engine: str = "auto"
+    # adaptive micro-batcher: a LONE request is dispatched after at most
+    # this budget (ms); under load batches fill to max_batch and dispatch
+    # immediately — the deadline only ever binds when traffic is sparse.
+    latency_budget_ms: float = 2.0
+    # largest coalesced batch (queue-depth-driven: everything waiting is
+    # taken up to this, so batch size tracks load)
+    max_batch: int = 4096
+    # smallest padded-bucket shape for static-shape engines (jax /
+    # stablehlo): batches pad up the power-of-two ladder
+    # min_batch_bucket, 2x, 4x ... max_batch so the jit cache holds at
+    # most log2(max_batch/min_batch_bucket)+1 executables
+    min_batch_bucket: int = 16
+    # admission bound: requests beyond this queue depth are rejected
+    # with ServeOverload (backpressure to the caller, never a silent
+    # drop or an unbounded-latency queue)
+    queue_limit: int = 100_000
+    # scoring worker threads draining the admission queue (numpy/native
+    # release the GIL in their kernels, so >1 can help on big hosts)
+    workers: int = 1
+    # `serving_report` journal cadence (seconds); 0 disables the reporter
+    report_every_s: float = 10.0
+    # TCP port for `shifu-tpu serve` (0 = ephemeral, printed at startup)
+    port: int = 8571
+    # bind host for the wire server
+    host: str = "127.0.0.1"
+
+    def validate(self) -> None:
+        if self.engine not in ("auto", "native", "numpy", "stablehlo",
+                               "jax"):
+            raise ConfigError(f"serving.engine must be one of auto/native/"
+                              f"numpy/stablehlo/jax: {self.engine!r}")
+        if self.latency_budget_ms <= 0:
+            raise ConfigError("serving.latency_budget_ms must be > 0: "
+                              f"{self.latency_budget_ms}")
+        if self.max_batch < 1 or self.min_batch_bucket < 1:
+            raise ConfigError("serving.max_batch and min_batch_bucket must "
+                              "be >= 1")
+        if self.min_batch_bucket > self.max_batch:
+            raise ConfigError(
+                f"serving.min_batch_bucket ({self.min_batch_bucket}) must "
+                f"not exceed max_batch ({self.max_batch})")
+        if self.queue_limit < 1:
+            raise ConfigError("serving.queue_limit must be >= 1")
+        if self.workers < 1:
+            raise ConfigError("serving.workers must be >= 1")
+        if self.report_every_s < 0:
+            raise ConfigError("serving.report_every_s must be >= 0")
+        if not (0 <= self.port <= 65535):
+            raise ConfigError(f"serving.port out of range: {self.port}")
+
+
+# ---------------------------------------------------------------------------
 # Runtime / parallelism
 # ---------------------------------------------------------------------------
 

@@ -476,6 +476,16 @@ def wire_quantize(x: np.ndarray, scale: np.ndarray,
     return q.astype(np.int8)
 
 
+def wire_dequantize(q: np.ndarray, scale, offset) -> np.ndarray:
+    """Host-side inverse of wire_quantize: int8 grid values -> float32
+    features (`x = q * scale + offset`).  The device-side inverse is
+    train/step.make_wire_decode; this one is the SERVING ingest seam —
+    runtime/serve_wire.py decodes request payloads that ride the same
+    cache-v2 int8 wire encoding the training data plane stores on disk."""
+    return (np.asarray(q, np.float32) * np.asarray(scale, np.float32)
+            + np.asarray(offset, np.float32))
+
+
 def wire_params(schema: DataSchema,
                 data: DataConfig) -> tuple[np.ndarray, np.ndarray]:
     """Per-column (scale, offset) vectors for the int8 wire grid.

@@ -14,17 +14,30 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Any, Sequence
+from typing import Any, Optional, Sequence
 
 import numpy as np
 
 from .artifact import SIDE_CAR, TOPOLOGY, WEIGHTS
 
 
+# Serving-grade latency buckets (seconds): 50us floor, single-digit-ms
+# resolution through the 10ms p99 budget.  The registry's DEFAULT_BUCKETS
+# start at 500us — too coarse to tell a 2ms p99 from an 8ms one, which is
+# exactly the band the serving daemon's budget lives in.  One bucket table
+# shared by library calls and the daemon so their percentiles merge.
+SCORE_LATENCY_BUCKETS = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
+
+
 def observe_scoring(engine: str, n_rows: int, seconds: float) -> None:
     """One telemetry write per scored batch, shared by every engine tier
-    (numpy / stablehlo / jax here, native in runtime/native_scorer.py):
-    score latency histogram + rows counter, labeled by engine."""
+    (numpy / stablehlo / jax here, native in runtime/native_scorer.py, the
+    serving daemon in runtime/serve.py): rows counter + per-call latency
+    histograms, labeled by engine.  `score_latency_seconds` is the ONE
+    latency schema daemon p99 and library-call scoring share — same name,
+    same buckets, distinguished only by the engine label."""
     from .. import obs
 
     obs.counter("score_rows_total", "rows scored").inc(
@@ -32,6 +45,35 @@ def observe_scoring(engine: str, n_rows: int, seconds: float) -> None:
     obs.histogram("score_batch_seconds",
                   "batch scoring latency by engine").observe(
         seconds, engine=engine)
+    obs.histogram("score_latency_seconds",
+                  "per-call scoring latency by engine (shared schema: "
+                  "library batches and serving-daemon requests)",
+                  buckets=SCORE_LATENCY_BUCKETS).observe(
+        seconds, engine=engine)
+
+
+_LATENCY_BOUNDS = np.asarray(SCORE_LATENCY_BUCKETS, np.float64)
+
+
+def observe_request_latencies(engine: str, latencies) -> None:
+    """Bulk write of per-REQUEST latencies into the shared
+    `score_latency_seconds` schema — the serving daemon records one value
+    per admitted request (admission -> response).  Binning is vectorized
+    here (searchsorted == the histogram's bisect_left rule) and merged
+    under ONE lock, so a 4k-row dispatch costs microseconds, not a
+    4k-iteration Python loop on the dispatch thread."""
+    from .. import obs
+
+    lat = np.asarray(latencies, np.float64)
+    if lat.size == 0:
+        return
+    idx = np.searchsorted(_LATENCY_BOUNDS, lat, side="left")
+    counts = np.bincount(idx, minlength=len(SCORE_LATENCY_BUCKETS) + 1)
+    obs.histogram("score_latency_seconds",
+                  "per-call scoring latency by engine (shared schema: "
+                  "library batches and serving-daemon requests)",
+                  buckets=SCORE_LATENCY_BUCKETS).merge_counts(
+        counts.tolist(), float(lat.sum()), int(lat.size), engine=engine)
 
 _LEAKY_ALPHA = 0.2  # keep in sync with ops/activations.py
 _LN_EPS = 1e-6      # flax nn.LayerNorm default
@@ -217,13 +259,70 @@ def run_program(program: list[dict], weights: dict[str, np.ndarray],
     return cur
 
 
-class Scorer:
+class BatchScorer:
+    """The ONE batch-dispatch seam every scoring engine shares (numpy /
+    stablehlo / jax here, native C++ in runtime/native_scorer.py) and the
+    serving daemon (runtime/serve.py) wraps.
+
+    Subclasses set `engine` (the telemetry label), `num_features`, and
+    implement `_score_batch(x)` on a validated (N, F) float32 matrix;
+    the seam owns input coercion, width validation (one error string for
+    all tiers), timing, and observe_scoring — previously re-implemented
+    per engine, which is exactly what a daemon cannot wrap uniformly.
+
+    `static_shapes` tells the micro-batcher whether this engine compiles
+    per batch shape (jax/stablehlo tiers) — True means the daemon pads
+    batches to bucket sizes so the jit cache stays bounded.
+    """
+
+    engine = "base"
+    static_shapes = False
+    num_features: int
+
+    def _score_batch(self, x: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def _as_batch(self, rows: np.ndarray) -> np.ndarray:
+        x = np.asarray(rows, dtype=np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.shape[1] != self.num_features:
+            raise ValueError(
+                f"expected {self.num_features} features, got {x.shape[1]}")
+        return x
+
+    def compute_batch(self, rows: np.ndarray,
+                      n_valid: Optional[int] = None) -> np.ndarray:
+        """Score (N, F) float rows -> (N, num_heads) probabilities.
+
+        `n_valid` overrides the row count reported to telemetry: the
+        serving daemon pads batches up its bucket ladder for
+        static-shape engines, and the pad rows must not inflate
+        `score_rows_total` / the per-row rates the serving story is
+        measured by."""
+        x = self._as_batch(rows)
+        t0 = time.perf_counter()
+        out = self._score_batch(x)
+        observe_scoring(self.engine,
+                        out.shape[0] if n_valid is None else n_valid,
+                        time.perf_counter() - t0)
+        return out
+
+    def compute(self, row: Sequence[float]) -> float:
+        """Single-row double score in [0,1] — the reference's exact call shape
+        (double[] in, single double out, TensorflowModel.java:63-91)."""
+        return float(self.compute_batch(np.asarray(row, dtype=np.float64))[0, 0])
+
+
+class Scorer(BatchScorer):
     """Loads an artifact directory and scores rows.
 
     API parity with TensorflowModel: `compute(row) -> float` for one row
     (TensorflowModel.java:52-109); `compute_batch(rows) -> (N, H)` is the
     batch extension the reference lacked.
     """
+
+    engine = "numpy"
 
     def __init__(self, export_dir: str):
         with open(os.path.join(export_dir, TOPOLOGY)) as f:
@@ -244,32 +343,20 @@ class Scorer:
         # exactly the reference's contract (TensorflowModel.java:74-87)
         self.extra_inputs = extra_inputs_from_sidecar(self.sidecar)
 
-    def compute_batch(self, rows: np.ndarray) -> np.ndarray:
-        """Score (N, F) float rows -> (N, num_heads) probabilities."""
-        x = np.asarray(rows, dtype=np.float32)
-        if x.ndim == 1:
-            x = x[None, :]
-        if x.shape[1] != self.num_features:
-            raise ValueError(
-                f"expected {self.num_features} features, got {x.shape[1]}")
-        t0 = time.perf_counter()
-        out = run_program(self.program, self.weights, x,
-                          extra_inputs=self.extra_inputs)
-        observe_scoring("numpy", out.shape[0], time.perf_counter() - t0)
-        return out
-
-    def compute(self, row: Sequence[float]) -> float:
-        """Single-row double score in [0,1] — the reference's exact call shape
-        (double[] in, single double out, TensorflowModel.java:63-91)."""
-        return float(self.compute_batch(np.asarray(row, dtype=np.float64))[0, 0])
+    def _score_batch(self, x: np.ndarray) -> np.ndarray:
+        return run_program(self.program, self.weights, x,
+                           extra_inputs=self.extra_inputs)
 
 
-class JaxScorer:
+class JaxScorer(BatchScorer):
     """Fallback scorer for non-chain models (wide_deep/deepfm/multitask/
     ft_transformer): rebuilds the Flax model from the artifact's stored spec
     and scores on the CPU backend.  Still satisfies the eval contract — no TF
     runtime, commodity CPU — at the cost of a jax dependency; the native
     C++ op-list path covers these model types as their ops are lowered."""
+
+    engine = "jax"
+    static_shapes = True  # jit compiles per batch shape — daemon pads
 
     def __init__(self, export_dir: str):
         import jax
@@ -299,23 +386,11 @@ class JaxScorer:
         self._fwd = instrument_jit(fwd, "jax_scorer")
         self._jnp = jnp
 
-    def compute_batch(self, rows: np.ndarray) -> np.ndarray:
-        x = np.asarray(rows, dtype=np.float32)
-        if x.ndim == 1:
-            x = x[None, :]
-        if x.shape[1] != self.num_features:
-            raise ValueError(
-                f"expected {self.num_features} features, got {x.shape[1]}")
-        t0 = time.perf_counter()
-        out = np.asarray(self._fwd(self._jnp.asarray(x)))
-        observe_scoring("jax", out.shape[0], time.perf_counter() - t0)
-        return out
-
-    def compute(self, row: Sequence[float]) -> float:
-        return float(self.compute_batch(np.asarray(row, dtype=np.float64))[0, 0])
+    def _score_batch(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self._fwd(self._jnp.asarray(x)))
 
 
-class StableHloScorer:
+class StableHloScorer(BatchScorer):
     """Scores through the serialized jax.export artifact (`scoring.jaxexport`)
     — the compiled-graph tier.  Unlike JaxScorer it does NOT rebuild the Flax
     model from source, so artifacts stay scoreable even if the model classes
@@ -328,6 +403,12 @@ class StableHloScorer:
     are the bit-faithful mirror of the training forward, while the op-list
     tiers (numpy Scorer / native C++) evaluate the same weights in float32.
     For float32-trained models all tiers agree to float32 roundoff."""
+
+    engine = "stablehlo"
+    # the export usually carries a symbolic batch dim, but replay still
+    # dispatches through jit per concrete shape — padded buckets keep the
+    # executable cache bounded either way, at negligible pad compute
+    static_shapes = True
 
     def __init__(self, export_dir: str):
         from jax import export as jax_export
@@ -345,20 +426,8 @@ class StableHloScorer:
         with open(path, "rb") as f:
             self._exported = jax_export.deserialize(bytearray(f.read()))
 
-    def compute_batch(self, rows: np.ndarray) -> np.ndarray:
-        x = np.asarray(rows, dtype=np.float32)
-        if x.ndim == 1:
-            x = x[None, :]
-        if x.shape[1] != self.num_features:
-            raise ValueError(
-                f"expected {self.num_features} features, got {x.shape[1]}")
-        t0 = time.perf_counter()
-        out = np.asarray(self._exported.call(x))
-        observe_scoring("stablehlo", out.shape[0], time.perf_counter() - t0)
-        return out
-
-    def compute(self, row: Sequence[float]) -> float:
-        return float(self.compute_batch(np.asarray(row, dtype=np.float64))[0, 0])
+    def _score_batch(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self._exported.call(x))
 
 
 def _unflatten(flat: dict[str, np.ndarray]) -> dict:

@@ -192,6 +192,77 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--globalconfig", default=None,
                    help="Hadoop-style XML (shifu.security.* for secured HDFS)")
 
+    sv = sub.add_parser(
+        "serve", help="run the persistent scoring daemon on an exported "
+                      "artifact: admission queue + adaptive micro-batching "
+                      "under a latency budget, multi-model hot-swap, TCP "
+                      "wire front-end (docs/SERVING.md)")
+    sv.add_argument("model", help="artifact dir (the export output)")
+    sv.add_argument("--engine", default=None,
+                    choices=["auto", "native", "numpy", "stablehlo", "jax"],
+                    help="scoring engine tier (default: serving.engine / "
+                         "auto)")
+    sv.add_argument("--port", type=int, default=-1,
+                    help="TCP port (0 = ephemeral, printed at startup; "
+                         "default: shifu.serving.port / 8571)")
+    sv.add_argument("--host", default=None,
+                    help="bind host (default: shifu.serving.host / "
+                         "127.0.0.1)")
+    sv.add_argument("--budget-ms", type=float, default=0,
+                    help="micro-batcher latency budget in ms: a lone "
+                         "request is dispatched after at most this wait "
+                         "(default: shifu.serving.latency-budget-ms / 2)")
+    sv.add_argument("--max-batch", type=int, default=0,
+                    help="largest coalesced batch (default: "
+                         "shifu.serving.max-batch / 4096)")
+    sv.add_argument("--workers", type=int, default=0,
+                    help="scoring worker threads (default: "
+                         "shifu.serving.workers / 1)")
+    sv.add_argument("--globalconfig", default=None,
+                    help="Hadoop-style XML carrying shifu.serving.* keys "
+                         "(flags override)")
+    sv.add_argument("--chaos-plan", default=None,
+                    help="fault-injection plan for serving drills "
+                         "(runtime.serve probe site, docs/ROBUSTNESS.md)")
+    sv.add_argument("--allow-swap", action="store_true",
+                    help="permit wire SWAP frames on a non-loopback bind "
+                         "(hot-loads a filesystem path as the model — "
+                         "loopback binds allow it by default; see the "
+                         "trust model in docs/SERVING.md)")
+
+    lt = sub.add_parser(
+        "loadtest", help="open-loop (Poisson-arrival) load harness for "
+                         "the scoring plane: reports scores/s and "
+                         "p50/p99 latency (tools/loadtest.py, "
+                         "docs/SERVING.md)")
+    lt.add_argument("--model", default=None,
+                    help="artifact dir — in-process mode: spin up a "
+                         "daemon and drive it directly")
+    lt.add_argument("--connect", default=None,
+                    help="host:port of a running `shifu-tpu serve` "
+                         "daemon — socket mode")
+    lt.add_argument("--rate", type=float, default=50_000,
+                    help="offered request rate per second (Poisson "
+                         "arrivals; default 50000)")
+    lt.add_argument("--duration", type=float, default=5.0,
+                    help="seconds of offered load (default 5)")
+    lt.add_argument("--engine", default="auto",
+                    choices=["auto", "native", "numpy", "stablehlo", "jax"],
+                    help="engine tier for --model mode")
+    lt.add_argument("--senders", type=int, default=2,
+                    help="open-loop sender threads (the Poisson stream is "
+                         "striped across them; default 2)")
+    lt.add_argument("--budget-ms", type=float, default=0,
+                    help="daemon latency budget for --model mode "
+                         "(default: serving default)")
+    lt.add_argument("--capacity", action="store_true",
+                    help="ramp the offered rate to find the highest one "
+                         "meeting the p99 target instead of a single run")
+    lt.add_argument("--p99-target-ms", type=float, default=10.0,
+                    help="p99 target for --capacity (default 10ms)")
+    lt.add_argument("--json", action="store_true",
+                    help="machine-readable report instead of text")
+
     x = sub.add_parser(
         "export", help="re-export the scoring artifact from a checkpoint "
                        "(no retraining; crash-after-train recovery)")
@@ -904,30 +975,14 @@ def _load_scorer(model_dir: str, native: bool, engine: str = "auto"):
     op-list engine; numpy / stablehlo / jax select an explicit tier
     (debugging, cross-engine verification); auto = best available
     (export.load_scorer's order).  Raises ValueError with the fix spelled
-    out on contradictory flags or a tier the artifact cannot serve."""
+    out on contradictory flags or a tier the artifact cannot serve.
+    The tier ladder itself is runtime/serve.load_engine — one resolver
+    for score/eval and the serving daemon's model loads."""
     if native and engine not in ("auto", "native"):
         raise ValueError(
             f"--native contradicts --engine {engine}; drop one of them")
-    if native or engine == "native":
-        from ..runtime import NativeScorer
-        return NativeScorer(model_dir)
-    if engine == "numpy":
-        from ..export.scorer import Scorer
-        sc = Scorer(model_dir)
-        if not sc.program:
-            raise ValueError(
-                "artifact has no op-list program (model_type="
-                f"{sc.topology.get('model_type')!r}); use --engine "
-                "stablehlo or jax")
-        return sc
-    if engine == "stablehlo":
-        from ..export.scorer import StableHloScorer
-        return StableHloScorer(model_dir)
-    if engine == "jax":
-        from ..export.scorer import JaxScorer
-        return JaxScorer(model_dir)
-    from ..export import load_scorer
-    return load_scorer(model_dir)
+    from ..runtime.serve import load_engine
+    return load_engine(model_dir, "native" if native else engine)
 
 
 def _project_features(rows, model_dir: str, scorer):
@@ -1219,6 +1274,120 @@ def run_score(args) -> int:
     return EXIT_OK
 
 
+def _serving_config(args) -> "ServingConfig":
+    """ServingConfig from `--globalconfig` shifu.serving.* keys with CLI
+    flags as the top override layer (the same layering train uses)."""
+    import dataclasses
+
+    from ..config.schema import ServingConfig
+    from ..utils import xmlconfig
+
+    cfg = ServingConfig()
+    if getattr(args, "globalconfig", None):
+        conf = xmlconfig.parse_configuration_xml(args.globalconfig)
+        cfg = xmlconfig.serving_config_from_conf(conf, cfg)
+    kw = {}
+    if getattr(args, "engine", None):
+        kw["engine"] = args.engine
+    if getattr(args, "port", -1) >= 0:
+        kw["port"] = args.port
+    if getattr(args, "host", None):
+        kw["host"] = args.host
+    if getattr(args, "budget_ms", 0):
+        kw["latency_budget_ms"] = args.budget_ms
+    if getattr(args, "max_batch", 0):
+        kw["max_batch"] = args.max_batch
+    if getattr(args, "workers", 0):
+        kw["workers"] = args.workers
+    if kw:
+        cfg = dataclasses.replace(cfg, **kw)
+    cfg.validate()
+    return cfg
+
+
+def run_serve(args) -> int:
+    """`shifu-tpu serve <artifact>`: the persistent scoring daemon —
+    admission queue + adaptive micro-batching under a latency budget,
+    hot-swappable model registry, TCP wire front-end (runtime/serve.py,
+    docs/SERVING.md).  Telemetry lands like a train job's: the
+    SHIFU_TPU_METRICS_DIR env wins, else <artifact>/telemetry — so
+    `shifu-tpu metrics <artifact>` reads the serving_report stream."""
+    from .. import chaos, obs
+    from ..config.schema import ConfigError
+    from ..data import fsio
+
+    if getattr(args, "chaos_plan", None):
+        try:
+            base = chaos.load_plan(args.chaos_plan.strip())
+            os.environ[chaos.ENV_CHAOS_PLAN] = base.to_json(indent=None)
+            chaos.reload_from_env()
+        except chaos.ChaosPlanError as e:
+            print(f"chaos plan: {e}", file=sys.stderr, flush=True)
+            return EXIT_FAIL
+    try:
+        config = _serving_config(args)
+    except (ConfigError, ValueError) as e:
+        print(f"serve: {e}", file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    metrics_dir = obs.resolve_metrics_dir() \
+        or fsio.join(args.model, "telemetry")
+    try:
+        obs.configure(metrics_dir)
+    except Exception:
+        pass  # telemetry must never block serving
+    from ..runtime.serve import serve_forever
+    try:
+        rc = serve_forever(args.model, config,
+                           echo=lambda s: print(s, flush=True),
+                           allow_swap=(True if getattr(args, "allow_swap",
+                                                       False) else None))
+    except (ValueError, OSError, KeyError, RuntimeError) as e:
+        print(f"serve: {e}", file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    obs.flush()
+    return rc
+
+
+def run_loadtest(args) -> int:
+    """`shifu-tpu loadtest`: the open-loop Poisson harness
+    (runtime/loadtest.py; standalone spelling in tools/loadtest.py)."""
+    from .. import obs
+    from ..config.schema import ServingConfig
+    from ..runtime import loadtest as lt
+
+    if bool(args.model) == bool(args.connect):
+        print("loadtest: exactly one of --model / --connect",
+              file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    obs.configure_from_env()
+    config = None
+    if getattr(args, "budget_ms", 0):
+        config = ServingConfig(engine=args.engine,
+                               latency_budget_ms=args.budget_ms,
+                               report_every_s=0.0)
+    try:
+        if args.capacity:
+            if not args.model:
+                print("loadtest: --capacity needs --model",
+                      file=sys.stderr, flush=True)
+                return EXIT_FAIL
+            report = lt.find_capacity(args.model, engine=args.engine,
+                                      p99_target_ms=args.p99_target_ms,
+                                      senders=args.senders, config=config)
+        else:
+            report = lt.run_loadtest(args.model, connect=args.connect,
+                                     engine=args.engine, rate=args.rate,
+                                     duration=args.duration,
+                                     senders=args.senders, config=config)
+    except (ValueError, OSError, KeyError, RuntimeError) as e:
+        print(f"loadtest: {e}", file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    print(json.dumps(report) if args.json else lt.render_report(report))
+    obs.flush()
+    return EXIT_OK if report.get("completed") \
+        or report.get("capacity_scores_per_sec") else EXIT_FAIL
+
+
 def _apply_platform_env() -> None:
     """Honor SHIFU_TPU_PLATFORM / SHIFU_TPU_CPU_DEVICES before backend init.
 
@@ -1503,7 +1672,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     _arm_pdeathsig()
     _apply_platform_env()
     args = build_parser().parse_args(argv)
-    if args.command in ("train", "score", "eval", "export"):
+    if args.command in ("train", "score", "eval", "export", "serve",
+                        "loadtest"):
         # repeat compiles (supervisor restarts, re-runs of the same job)
         # deserialize from the persistent cache instead of recompiling.
         # Only for commands that compile: status/attach/kill/provision are
@@ -1531,6 +1701,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return rc
     if args.command == "score":
         return run_score(args)
+    if args.command == "serve":
+        return run_serve(args)
+    if args.command == "loadtest":
+        return run_loadtest(args)
     if args.command == "eval":
         return run_eval(args)
     if args.command == "export":

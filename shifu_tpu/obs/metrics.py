@@ -134,6 +134,73 @@ class Histogram:
             s[1] = _sum + float(value)
             s[2] = _n + 1
 
+    def observe_many(self, values, **labels) -> None:
+        """Bulk observe in one lock acquisition — the stdlib-only bulk
+        path (this module depends on nothing): bin with bisect, then
+        merge.  Callers that already hold numpy arrays should bin with
+        searchsorted and call merge_counts directly — that is what the
+        serving plane's per-request latencies go through
+        (export/scorer.py observe_request_latencies)."""
+        import bisect
+
+        counts = [0] * (len(self.buckets) + 1)
+        total = 0.0
+        n = 0
+        for v in values:
+            v = float(v)
+            # index of the first bound >= v, i.e. the `value <= bound`
+            # bucket observe() finds by scanning; == len(buckets) -> +Inf
+            counts[bisect.bisect_left(self.buckets, v)] += 1
+            total += v
+            n += 1
+        self.merge_counts(counts, total, n, **labels)
+
+    def merge_counts(self, counts, total: float, n: int, **labels) -> None:
+        """Merge a pre-bucketed batch (len(buckets)+1 counts in bound
+        order, +Inf last) in one lock acquisition — the vectorized fast
+        path for per-request serving latencies, where the caller bins
+        thousands of values with numpy (export/scorer.py
+        observe_request_latencies) instead of a Python loop here."""
+        counts = list(counts)
+        if len(counts) != len(self.buckets) + 1:
+            raise ValueError(
+                f"merge_counts: got {len(counts)} buckets, histogram "
+                f"{self.name} has {len(self.buckets) + 1}")
+        key = _label_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = [[0] * (len(self.buckets) + 1),
+                                         0.0, 0]
+            for i, c in enumerate(counts):
+                if c:
+                    s[0][i] += int(c)
+            s[1] += float(total)
+            s[2] += int(n)
+
+    def counts(self, **labels) -> Optional[tuple[list, float, int]]:
+        """Snapshot of one series: (per-bucket counts incl. +Inf, sum,
+        n), or None when empty — lets a caller window/difference a
+        cumulative histogram (e.g. the serving daemon's per-daemon
+        percentiles over the process-global latency schema)."""
+        key = _label_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                return None
+            return list(s[0]), float(s[1]), int(s[2])
+
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Histogram-estimated quantile (linear interpolation inside the
+        owning bucket, Prometheus histogram_quantile semantics).  None for
+        an empty series; values beyond the last finite bound clamp to it.
+        An ESTIMATE bounded by bucket resolution — exact percentiles need
+        the raw samples (tools/loadtest.py keeps them)."""
+        snap = self.counts(**labels)
+        if snap is None or snap[2] == 0:
+            return None
+        return quantile_from_counts(self.buckets, snap[0], snap[2], q)
+
     def count(self, **labels) -> int:
         with self._lock:
             s = self._series.get(_label_key(labels))
@@ -166,6 +233,26 @@ class Histogram:
                 "values": {";".join("=".join(kv) for kv in k):
                            {"sum": s[1], "count": s[2]}
                            for k, s in self._series.items()}}
+
+
+def quantile_from_counts(buckets, counts, n: int, q: float
+                         ) -> Optional[float]:
+    """The quantile interpolation over an explicit (buckets, counts, n)
+    triple — shared by Histogram.quantile and callers that difference
+    two counts() snapshots into a window."""
+    if n <= 0:
+        return None
+    rank = q * n
+    cum = 0.0
+    lo = 0.0
+    for i, bound in enumerate(buckets):
+        prev = cum
+        cum += counts[i]
+        if cum >= rank and counts[i] > 0:
+            frac = (rank - prev) / counts[i]
+            return lo + (bound - lo) * min(max(frac, 0.0), 1.0)
+        lo = bound
+    return buckets[-1] if buckets else None
 
 
 class MetricsRegistry:

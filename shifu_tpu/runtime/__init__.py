@@ -1,3 +1,7 @@
 from .native_scorer import MODEL_BIN, NativeScorer, build_library, pack_native
+from .serve import (ModelRegistry, ScoringDaemon, ServeOverload,
+                    load_engine, serve_forever)
 
-__all__ = ["MODEL_BIN", "NativeScorer", "build_library", "pack_native"]
+__all__ = ["MODEL_BIN", "ModelRegistry", "NativeScorer", "ScoringDaemon",
+           "ServeOverload", "build_library", "load_engine", "pack_native",
+           "serve_forever"]

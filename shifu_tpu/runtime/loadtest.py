@@ -1,0 +1,370 @@
+"""Open-loop load harness for the scoring plane (docs/SERVING.md).
+
+OPEN-loop, not closed-loop: request arrival times are drawn up front from
+a Poisson process at the offered rate and each request is charged from its
+SCHEDULED arrival — a server (or sender) falling behind cannot slow the
+arrival process down and thereby hide queueing delay, the
+coordinated-omission failure mode that makes closed-loop "benchmarks"
+report fantasy p99s.  (The ROADMAP's serving bench axis asks for exactly
+this arrival model.)
+
+Two modes:
+
+- **in-process** (`export_dir=` / `daemon=`): drives a ScoringDaemon
+  directly through `submit(need_future=False)`; completions flow back
+  through the daemon's `on_batch` hook (scores + scheduled arrivals +
+  done-stamp per dispatched batch), so the measured path is admission ->
+  micro-batch -> score -> completion with no per-request Future overhead.
+  This is the capacity-measurement mode (`serving_scores_per_sec` in
+  bench.py / tools/perf_gate.py).
+- **socket** (`connect=`): each sender owns a ServeClient connection and
+  round-trips single-row frames against a live `shifu-tpu serve` daemon —
+  the end-to-end-wire mode (rates bounded by the per-connection RTT;
+  raise `senders` for parallelism).
+
+Percentiles are exact (numpy over the recorded per-request latencies),
+not histogram estimates.  `find_capacity` ramps the offered rate to the
+highest one that still meets a p99 target.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..config.schema import ServingConfig
+from .serve import ScoringDaemon, ServeOverload
+
+
+def _poisson_schedule(rate: float, duration: float,
+                      rng: np.random.Generator) -> np.ndarray:
+    """Cumulative arrival offsets (seconds) of a Poisson process at
+    `rate` over `duration` — drawn ONCE, before any request is sent."""
+    n = max(1, int(rate * duration))
+    return np.cumsum(rng.exponential(1.0 / rate, size=n))
+
+
+def _make_rows(num_features: int, rng: np.random.Generator,
+               n_unique: int = 2048) -> np.ndarray:
+    return rng.standard_normal((n_unique, num_features)).astype(np.float32)
+
+
+def _percentiles(latencies: np.ndarray) -> dict:
+    if latencies.size == 0:
+        return {"p50_ms": None, "p99_ms": None, "max_ms": None}
+    p50, p99 = np.percentile(latencies, [50, 99])
+    return {"p50_ms": round(float(p50) * 1e3, 3),
+            "p99_ms": round(float(p99) * 1e3, 3),
+            "max_ms": round(float(latencies.max()) * 1e3, 3)}
+
+
+def run_loadtest(export_dir: Optional[str] = None, *,
+                 daemon: Optional[ScoringDaemon] = None,
+                 connect: Optional[str] = None,
+                 engine: str = "auto",
+                 rate: float = 50_000.0,
+                 duration: float = 5.0,
+                 senders: int = 2,
+                 seed: int = 0,
+                 config: Optional[ServingConfig] = None,
+                 drain_timeout: float = 30.0) -> dict:
+    """One open-loop run at a fixed offered rate; returns the report dict
+    (offered/achieved scores/s, exact p50/p99/max latency, reject/error
+    counts).  Exactly one of `export_dir` / `daemon` / `connect`."""
+    if connect is not None:
+        return _run_socket(connect, rate=rate, duration=duration,
+                           senders=senders, seed=seed)
+    own_daemon = daemon is None
+    if own_daemon:
+        if export_dir is None:
+            raise ValueError("need export_dir, daemon=, or connect=")
+        cfg = config or ServingConfig(engine=engine, report_every_s=0.0)
+        daemon = ScoringDaemon(export_dir, config=cfg).start()
+    try:
+        return _run_inproc(daemon, rate=rate, duration=duration,
+                           senders=senders, seed=seed,
+                           drain_timeout=drain_timeout)
+    finally:
+        if own_daemon:
+            daemon.stop()
+
+
+def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
+                senders: int, seed: int, drain_timeout: float) -> dict:
+    rng = np.random.default_rng(seed)
+    rows = _make_rows(daemon.num_features, rng)
+    n_unique = len(rows)
+    schedule = _poisson_schedule(rate, duration, rng)
+    n = len(schedule)
+
+    completed_batches: list = []   # [(arrivals_array, t_done)] — append is
+    #                                GIL-atomic, no lock on the hot path
+
+    def on_batch(_scores, arrivals, t_done):
+        completed_batches.append((arrivals, t_done))
+
+    prev_hook = daemon._on_batch
+    daemon._on_batch = on_batch
+    errors_at_start = daemon._snapshot()["errors"]  # the daemon counter
+    # is lifetime-cumulative; this run must only count its own
+    submitted = [0] * senders
+    rejected = [0] * senders
+    # pre-resolve each sender's (scheduled time, row) sequence OUTSIDE the
+    # timed region: the sender loop is harness overhead that shares the
+    # host with the daemon, so it must be as close to submit-only as
+    # Python allows (plain floats, no per-request numpy indexing)
+    row_views = list(rows)  # slice once; senders share the 1-D views
+    offsets = schedule.tolist()
+    per_sender = []
+    for s in range(senders):
+        idx = range(s, n, senders)  # thinned Poisson is still Poisson
+        per_sender.append([(offsets[k], row_views[k % n_unique])
+                           for k in idx])
+    # stamp the epoch AFTER the (slow) precompute: a t_start taken before
+    # it would put every sender behind schedule from the first request
+    t_start = time.perf_counter() + 0.02  # lead so senders start on time
+
+    def sender(s: int) -> None:
+        submit = daemon.submit
+        clock = time.perf_counter
+        sleep = time.sleep
+        epoch = t_start
+        n_sub = n_rej = 0
+        for off, row in per_sender[s]:
+            t_sched = epoch + off
+            dt = t_sched - clock()
+            if dt > 0:
+                # plain sleep, never a spin: a spinning sender burns the
+                # GIL the dispatch thread needs, which shows up as fake
+                # server latency.  Sub-ms oversleep lands the request a
+                # hair late and is charged to it honestly (latency runs
+                # from t_sched); behind schedule -> fire immediately,
+                # the open-loop contract.
+                sleep(dt)
+            try:
+                submit(row, t_arrival=t_sched, need_future=False)
+                n_sub += 1
+            except ServeOverload:
+                n_rej += 1
+            except RuntimeError:
+                break  # daemon stopped under us
+        submitted[s] = n_sub
+        rejected[s] = n_rej
+
+    threads = [threading.Thread(target=sender, args=(s,), daemon=True)
+               for s in range(senders)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + drain_timeout)
+    n_submitted = sum(submitted)
+    # drain: every admitted request resolves (errors land in daemon stats)
+    t_deadline = time.perf_counter() + drain_timeout
+    while time.perf_counter() < t_deadline:
+        done = sum(len(a) for a, _t in completed_batches)
+        errors = daemon._snapshot()["errors"] - errors_at_start
+        if done + errors >= n_submitted:
+            break
+        time.sleep(0.005)
+    daemon._on_batch = prev_hook
+
+    done_counts = [len(a) for a, _t in completed_batches]
+    n_completed = sum(done_counts)
+    latencies = (np.concatenate(
+        [t_done - arrivals for arrivals, t_done in completed_batches])
+        if completed_batches else np.empty(0))
+    # achieved rate over the span requests actually completed in
+    if completed_batches:
+        t_first = min(float(a.min()) for a, _t in completed_batches)
+        t_last = max(t for _a, t in completed_batches)
+        span = max(t_last - t_first, 1e-9)
+    else:
+        span = duration
+    snap = daemon._snapshot()
+    report = {
+        "mode": "inproc",
+        "offered_rate": round(rate, 1),
+        "duration_s": round(duration, 3),
+        "submitted": n_submitted,
+        "completed": n_completed,
+        "rejected": sum(rejected),
+        "errors": snap["errors"] - errors_at_start,
+        "achieved_scores_per_sec": round(n_completed / span, 1),
+        "batch_mean": round(n_completed / max(len(done_counts), 1), 1),
+        "senders": senders,
+        **_percentiles(latencies),
+    }
+    handle = daemon._registry.current(daemon.model_id)
+    if handle is not None:
+        report["engine"] = handle.engine_name
+    _journal(report)
+    return report
+
+
+def _run_socket(connect: str, *, rate: float, duration: float,
+                senders: int, seed: int) -> dict:
+    from . import serve_wire
+
+    host, _, port_s = connect.rpartition(":")
+    host, port = host or "127.0.0.1", int(port_s)
+    rng = np.random.default_rng(seed)
+    probe = serve_wire.ServeClient(host, port)
+    num_features = int(probe.stats()["num_features"])
+    probe.close()
+    rows = _make_rows(num_features, rng)
+    n_unique = len(rows)
+    schedule = _poisson_schedule(rate, duration, rng)
+    n = len(schedule)
+    lat_lists: list[list] = [[] for _ in range(senders)]
+    err_counts = [0] * senders
+    rej_counts = [0] * senders
+    t_start = time.perf_counter() + 0.05
+
+    def sender(s: int) -> None:
+        lats = lat_lists[s]
+        try:
+            # connect inside the accounting scope: a refused/reset
+            # connect must charge this sender's whole schedule as
+            # errors, not silently vanish with the thread
+            client = serve_wire.ServeClient(host, port)
+        except (ConnectionError, OSError):
+            err_counts[s] += len(range(s, n, senders))
+            return
+        try:
+            for k in range(s, n, senders):
+                t_sched = t_start + schedule[k]
+                dt = t_sched - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)  # see _run_inproc: never spin
+                try:
+                    client.score_rows(rows[k % n_unique][None, :])
+                    lats.append(time.perf_counter() - t_sched)
+                except serve_wire.WireOverload:
+                    rej_counts[s] += 1  # backpressure, like inproc mode
+                except serve_wire.WireError:
+                    err_counts[s] += 1  # per-request error frame: carry on
+                except (ConnectionError, OSError):
+                    # transport died (daemon restarted, socket reset):
+                    # charge every unsent request of this sender as an
+                    # error instead of silently abandoning the schedule
+                    err_counts[s] += 1 + len(range(k + senders, n,
+                                                   senders))
+                    return
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=sender, args=(s,), daemon=True)
+               for s in range(senders)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    span = max(time.perf_counter() - t0, 1e-9)
+    latencies = np.asarray([v for lats in lat_lists for v in lats])
+    report = {
+        "mode": "socket",
+        "target": f"{host}:{port}",
+        "offered_rate": round(rate, 1),
+        "duration_s": round(duration, 3),
+        "submitted": n,
+        "completed": int(latencies.size),
+        "rejected": sum(rej_counts),
+        "errors": sum(err_counts),
+        "achieved_scores_per_sec": round(latencies.size / span, 1),
+        "senders": senders,
+        **_percentiles(latencies),
+    }
+    _journal(report)
+    return report
+
+
+def find_capacity(export_dir: Optional[str] = None, *,
+                  daemon: Optional[ScoringDaemon] = None,
+                  engine: str = "auto",
+                  p99_target_ms: float = 10.0,
+                  start_rate: float = 25_000.0,
+                  max_steps: int = 7,
+                  step_duration: float = 1.0,
+                  senders: int = 2,
+                  config: Optional[ServingConfig] = None,
+                  seed: int = 0) -> dict:
+    """Ramp the offered rate (x2 per step) to the highest one that still
+    meets the p99 target AND keeps up with the offered load (achieved >=
+    85% of offered — an open-loop run that falls behind is saturated no
+    matter what its percentiles say).  Returns the best passing report
+    with the ramp attached."""
+    own_daemon = daemon is None
+    if own_daemon:
+        if export_dir is None:
+            raise ValueError("need export_dir or daemon=")
+        cfg = config or ServingConfig(engine=engine, report_every_s=0.0)
+        daemon = ScoringDaemon(export_dir, config=cfg).start()
+    best = None
+    ramp = []
+    try:
+        rate = start_rate
+        for _step in range(max_steps):
+            r = _run_inproc(daemon, rate=rate, duration=step_duration,
+                            senders=senders, seed=seed,
+                            drain_timeout=30.0)
+            ok = (r["p99_ms"] is not None
+                  and r["p99_ms"] <= p99_target_ms
+                  and r["achieved_scores_per_sec"] >= 0.85 * rate
+                  and r["rejected"] == 0)
+            ramp.append({"rate": round(rate, 1), "ok": ok,
+                         "achieved": r["achieved_scores_per_sec"],
+                         "p99_ms": r["p99_ms"]})
+            if ok:
+                best = r
+                rate *= 2
+            else:
+                break
+    finally:
+        if own_daemon:
+            daemon.stop()
+    out = dict(best) if best else {"p99_target_ms": p99_target_ms,
+                                   "capacity_scores_per_sec": None}
+    out["ramp"] = ramp
+    out["p99_target_ms"] = p99_target_ms
+    if best:
+        out["capacity_scores_per_sec"] = best["achieved_scores_per_sec"]
+    return out
+
+
+def render_report(report: dict) -> str:
+    """Human text for a loadtest / capacity report — the ONE renderer
+    `shifu-tpu loadtest` and tools/loadtest.py both print."""
+    lines = []
+    if "ramp" in report:
+        for step in report["ramp"]:
+            lines.append(f"  ramp {step['rate']:>12,.0f}/s -> achieved "
+                         f"{step['achieved']:>12,.1f}/s  "
+                         f"p99 {step['p99_ms']} ms  "
+                         f"{'ok' if step['ok'] else 'SATURATED'}")
+        cap = report.get("capacity_scores_per_sec")
+        lines.append(f"capacity: {cap:,.0f} scores/s at p99 <= "
+                     f"{report['p99_target_ms']} ms" if cap
+                     else "capacity: below the starting rate")
+    else:
+        lines.append(
+            f"loadtest [{report['mode']}]: offered "
+            f"{report['offered_rate']:,.0f}/s achieved "
+            f"{report['achieved_scores_per_sec']:,.0f} scores/s  "
+            f"p50 {report['p50_ms']} ms  p99 {report['p99_ms']} ms  "
+            f"(completed {report['completed']:,}, rejected "
+            f"{report.get('rejected', 0):,}, errors "
+            f"{report['errors']:,})")
+    return "\n".join(lines)
+
+
+def _journal(report: dict) -> None:
+    try:
+        from .. import obs
+        obs.event("loadtest_report", **report)
+        obs.flush()
+    except Exception:
+        pass

@@ -215,8 +215,15 @@ def build_library(out_dir: Optional[str] = None, force: bool = False) -> str:
                   out_dir=out_dir, force=force)
 
 
-class NativeScorer:
-    """ctypes wrapper over the C ABI; API-compatible with export.Scorer."""
+from ..export.scorer import BatchScorer
+
+
+class NativeScorer(BatchScorer):
+    """ctypes wrapper over the C ABI; API-compatible with export.Scorer
+    (rides the shared BatchScorer dispatch seam, so the serving daemon
+    wraps it like any other engine)."""
+
+    engine = "native"
 
     def __init__(self, export_dir: str, lib_path: Optional[str] = None):
         bin_path = os.path.join(export_dir, MODEL_BIN)
@@ -265,24 +272,25 @@ class NativeScorer:
         except Exception:
             return False
 
-    def compute_batch(self, rows: np.ndarray) -> np.ndarray:
+    def _as_batch(self, rows: np.ndarray) -> np.ndarray:
+        # contiguity is part of the C ABI (raw pointer + row stride)
         x = np.ascontiguousarray(rows, dtype=np.float32)
         if x.ndim == 1:
             x = x[None, :]
         if x.shape[1] != self.num_features:
             raise ValueError(f"expected {self.num_features} features, got {x.shape[1]}")
+        return x
+
+    def _score_batch(self, x: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x)  # seam callers may pass non-contiguous
         n = x.shape[0]
         out = np.empty((n, self.num_heads), dtype=np.float32)
-        import time
-        t0 = time.perf_counter()
         rc = self._lib.shifu_scorer_compute_batch(
             self._handle,
             x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         if rc != 0:
             raise RuntimeError(f"native scorer error code {rc}")
-        from ..export.scorer import observe_scoring
-        observe_scoring("native", n, time.perf_counter() - t0)
         return out
 
     def compute(self, row: Sequence[float]) -> float:

@@ -1,0 +1,727 @@
+"""Serving plane: persistent scorer daemon with adaptive micro-batching
+and multi-model hot-load (docs/SERVING.md).
+
+The production successor of the reference's row-at-a-time JNI scorer
+(shifu-tensorflow-eval TensorflowModel.java:52-109, one double[] per call):
+our library path tops out around ~68k single rows/s per process while the
+batched path does millions, so the serving throughput lever is coalescing
+single-row requests into batches under a latency budget — the core design
+of accelerator serving systems (PAPERS.md: TF-Serving lineage in
+arxiv 1605.08695; batching-under-deadline in the Gemma-on-TPU serving
+comparison, arxiv 2605.25645).
+
+Three pieces:
+
+- **ScoringDaemon** — admission queue + adaptive micro-batcher.  A request
+  is one feature row; the dispatch loop takes everything queued (up to
+  `max_batch`) when either the OLDEST request's latency budget expires or
+  the queue reaches `max_batch` — so batch size tracks queue depth under
+  load and a lone request never waits past the budget.  Static-shape
+  engines (jax / stablehlo) get batches padded up a power-of-two bucket
+  ladder so the jit cache stays bounded.
+- **ModelRegistry** — versioned hot-load/atomic-swap of export artifacts.
+  A swap loads AND warms the new scorer before it becomes visible, then
+  retires the old version once its in-flight batches drain — a failed or
+  chaos-injected load (`runtime.serve` probe site) keeps the previous
+  version serving; no request is ever dropped by a swap.
+- telemetry riding the existing obs stack: per-request latencies into the
+  shared `score_latency_seconds` schema (export/scorer.py), queue-depth /
+  batch-size instruments, and periodic `serving_report` journal events.
+
+The wire front-end (TCP framing over the cache-v2 int8 encoding) lives in
+runtime/serve_wire.py; `shifu-tpu serve` / `shifu-tpu loadtest` drive both.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..config.schema import ServingConfig
+
+CHAOS_SITE = "runtime.serve"
+
+
+class ServeOverload(RuntimeError):
+    """Admission queue at `serving.queue_limit` — backpressure to the
+    caller (retry / shed upstream), never an unbounded-latency queue."""
+
+
+def load_engine(export_dir: str, engine: str = "auto"):
+    """Build one scoring engine for an artifact — the tier ladder shared
+    by `shifu-tpu score/eval` (launcher/cli.py delegates here) and the
+    serving daemon's model loads: native (C++ op-list) / numpy (op-list
+    interpreter) / stablehlo (serialized compiled graph) / jax (model
+    rebuild) / auto (export.load_scorer's best-available order)."""
+    if engine == "native":
+        from .native_scorer import NativeScorer
+        return NativeScorer(export_dir)
+    if engine == "numpy":
+        from ..export.scorer import Scorer
+        sc = Scorer(export_dir)
+        if not sc.program:
+            raise ValueError(
+                "artifact has no op-list program (model_type="
+                f"{sc.topology.get('model_type')!r}); use --engine "
+                "stablehlo or jax")
+        return sc
+    if engine == "stablehlo":
+        from ..export.scorer import StableHloScorer
+        return StableHloScorer(export_dir)
+    if engine == "jax":
+        from ..export.scorer import JaxScorer
+        return JaxScorer(export_dir)
+    if engine == "auto":
+        from ..export import load_scorer
+        return load_scorer(export_dir)
+    raise ValueError(f"unknown scoring engine {engine!r}")
+
+
+def bucket_ladder(min_bucket: int, max_batch: int) -> tuple[int, ...]:
+    """The padded-shape ladder: min_bucket, 2x, 4x, ..., capped at
+    max_batch (always included) — at most log2(max/min)+1 shapes, which
+    is the bound on a static-shape engine's executable cache."""
+    sizes = []
+    b = max(1, int(min_bucket))
+    while b < max_batch:
+        sizes.append(b)
+        b *= 2
+    sizes.append(int(max_batch))
+    return tuple(sizes)
+
+
+def bucket_for(n: int, ladder: tuple[int, ...]) -> int:
+    for b in ladder:
+        if n <= b:
+            return b
+    return ladder[-1]
+
+
+class _ModelHandle:
+    """One loaded scorer version.  Refcounted: the dispatch loop holds an
+    acquire() across each batch, so a retired (swapped-out) version is
+    closed only after its last in-flight batch drains."""
+
+    __slots__ = ("scorer", "version", "export_dir", "engine_name",
+                 "model_id", "num_heads", "_refs", "_retired")
+
+    def __init__(self, scorer, version: int, export_dir: str,
+                 model_id: str, num_heads: Optional[int] = None):
+        self.scorer = scorer
+        self.version = version
+        self.export_dir = export_dir
+        self.engine_name = getattr(scorer, "engine",
+                                   type(scorer).__name__.lower())
+        self.model_id = model_id
+        self.num_heads = num_heads  # from the warm score; None unwarmed
+        self._refs = 0
+        self._retired = False
+
+
+class ModelRegistry:
+    """Versioned multi-model registry with atomic hot-swap.
+
+    `load()` is both initial load and swap: the new scorer is built and
+    WARMED (one-row score, so a jit engine's first live request never pays
+    the compile) before the pointer flips; the old version keeps serving
+    until that instant and is retired/closed after its in-flight batches
+    release.  Every load attempt passes the `runtime.serve` chaos probe —
+    an injected (or real) failure leaves the previous version installed
+    and is journaled as `model_swap_failed`."""
+
+    def __init__(self, loader: Optional[Callable] = None):
+        self._loader = loader or load_engine
+        self._lock = threading.RLock()
+        # serializes load(): two concurrent swaps of one model_id would
+        # otherwise both snapshot the same predecessor and the
+        # intermediate version would never retire (leaking its native
+        # handle).  A separate lock so a slow load/warm never blocks the
+        # hot acquire/release path.
+        self._load_lock = threading.Lock()
+        self._models: dict[str, _ModelHandle] = {}
+        self._next_version = 1
+        self._closed = False
+
+    def load(self, export_dir: str, engine: str = "auto",
+             model_id: str = "default", warm: bool = True) -> _ModelHandle:
+        """Load (or hot-swap) `model_id` from an export artifact; returns
+        the installed handle.  Raises on failure — the caller decides
+        whether that is fatal (initial load) or degraded (swap; the
+        previous version is still installed and serving).  Loads are
+        serialized per registry; the dispatch path is never blocked."""
+        from .. import chaos, obs
+
+        with self._load_lock:
+            return self._load_locked(export_dir, engine, model_id, warm,
+                                     chaos, obs)
+
+    def _load_locked(self, export_dir: str, engine: str, model_id: str,
+                     warm: bool, chaos, obs) -> _ModelHandle:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("model registry is closed (daemon "
+                                   "stopped) — swap refused")
+            old = self._models.get(model_id)
+        scorer = None
+        try:
+            chaos.maybe_fail(CHAOS_SITE, op="load", model=model_id,
+                             path=export_dir)
+            scorer = self._loader(export_dir, engine)
+            n_feat = int(getattr(scorer, "num_features", 0))
+            if old is not None and n_feat != getattr(
+                    old.scorer, "num_features", n_feat):
+                raise ValueError(
+                    f"hot-swap feature-width mismatch: current model has "
+                    f"{old.scorer.num_features} features, replacement has "
+                    f"{n_feat} — a swapped model must keep the wire schema")
+            n_heads = None
+            if warm and n_feat:
+                out = scorer.compute_batch(np.zeros((1, n_feat),
+                                                    np.float32))
+                n_heads = int(out.shape[1])
+                if old is not None and old.num_heads is not None \
+                        and n_heads != old.num_heads:
+                    raise ValueError(
+                        f"hot-swap head-count mismatch: current model "
+                        f"scores {old.num_heads} heads, replacement "
+                        f"scores {n_heads} — a swapped model must keep "
+                        "the response schema")
+        except Exception as e:
+            # the scorer may already be constructed (warm / width check
+            # failed after it) — free it, or repeated failed swaps leak
+            # one native engine handle per attempt
+            close = getattr(scorer, "close", None)
+            if callable(close):
+                try:
+                    close()
+                except Exception:
+                    pass
+            obs.counter("serve_swap_failed_total",
+                        "failed model hot-load attempts").inc(
+                model=model_id)
+            obs.event("model_swap_failed", model=model_id,
+                      path=export_dir, engine=engine,
+                      error=f"{type(e).__name__}: {e}"[:300],
+                      kept_version=old.version if old else None)
+            raise
+        with self._lock:
+            version = self._next_version
+            self._next_version += 1
+            handle = _ModelHandle(scorer, version, export_dir, model_id,
+                                  num_heads=n_heads)
+            self._models[model_id] = handle
+            if old is not None:
+                old._retired = True
+                self._maybe_close(old)
+        obs.counter("serve_swap_total", "model hot-loads installed").inc(
+            model=model_id)
+        obs.event("model_swap", model=model_id, version=version,
+                  old_version=old.version if old else None,
+                  path=export_dir, engine=handle.engine_name)
+        return handle
+
+    def acquire(self, model_id: str = "default") -> _ModelHandle:
+        with self._lock:
+            handle = self._models.get(model_id)
+            if handle is None:
+                raise KeyError(f"no model {model_id!r} loaded")
+            handle._refs += 1
+            return handle
+
+    def release(self, handle: _ModelHandle) -> None:
+        with self._lock:
+            handle._refs -= 1
+            self._maybe_close(handle)
+
+    def current(self, model_id: str = "default") -> Optional[_ModelHandle]:
+        with self._lock:
+            return self._models.get(model_id)
+
+    def close(self) -> None:
+        # _load_lock first: a hot-swap racing close() must either finish
+        # its install BEFORE the sweep (and be retired by it) or be
+        # refused by the closed flag — never install into a cleared
+        # registry, where its scorer would leak unclosed
+        with self._load_lock:
+            with self._lock:
+                self._closed = True
+                for handle in self._models.values():
+                    handle._retired = True
+                    self._maybe_close(handle)
+                self._models.clear()
+
+    def _maybe_close(self, handle: _ModelHandle) -> None:
+        # caller holds self._lock
+        if handle._retired and handle._refs <= 0:
+            close = getattr(handle.scorer, "close", None)
+            if callable(close):
+                try:
+                    close()
+                except Exception:
+                    pass
+            from .. import obs
+            obs.event("model_retired", model=handle.model_id,
+                      version=handle.version)
+
+
+class ScoringDaemon:
+    """The persistent scorer: admission queue, micro-batch dispatch,
+    hot-swappable model registry, lifecycle, telemetry.
+
+    In-process API (the wire server and tools/loadtest.py sit on top):
+
+    - `submit(row)` -> Future resolving to that row's (H,) score vector
+      (`need_future=False` skips the Future for fire-and-forget callers
+      that consume results through `on_batch` — the loadtest fast path).
+    - `score(row)` -> scores, synchronous single-request convenience.
+    - `score_batch(rows)` -> direct pass-through for already-batched
+      requests (no coalescing win to be had; still metered + versioned).
+    - `swap(export_dir)` -> degrade-safe hot-swap.
+    """
+
+    def __init__(self, export_dir: Optional[str] = None, *,
+                 config: Optional[ServingConfig] = None,
+                 registry: Optional[ModelRegistry] = None,
+                 loader: Optional[Callable] = None,
+                 model_id: str = "default",
+                 on_batch: Optional[Callable] = None):
+        self.config = config or ServingConfig()
+        self.config.validate()
+        self.model_id = model_id
+        # an injected registry is the CALLER's (it may back other
+        # daemons / models); only a registry we built is ours to close
+        self._owns_registry = registry is None
+        self._registry = registry or ModelRegistry(loader=loader)
+        if export_dir is not None:
+            self._registry.load(export_dir, engine=self.config.engine,
+                                model_id=model_id)
+        current = self._registry.current(model_id)
+        if current is None:
+            raise ValueError("ScoringDaemon needs an export_dir or a "
+                             "pre-loaded registry")
+        self.num_features = int(current.scorer.num_features)
+        self._row_shape = (self.num_features,)
+        self._on_batch = on_batch
+        self._ladder = bucket_ladder(self.config.min_batch_bucket,
+                                     self.config.max_batch)
+        self._budget_s = self.config.latency_budget_ms / 1000.0
+        # a plain Lock, not the Condition default RLock: submit() takes it
+        # once per request on the hot path and never recursively
+        self._cond = threading.Condition(threading.Lock())
+        self._queue: list = []          # [(row, t_arrival, future|None)]
+        self._running = False
+        self._accepting = False
+        self._threads: list[threading.Thread] = []
+        self._t_start = 0.0
+        # counters mutated under self._cond (cheap ints on the hot path;
+        # published to the obs registry by the reporter/stop)
+        self._requests = 0
+        self._rejected = 0
+        self._errors = 0
+        self._batches = 0
+        self._batch_rows = 0
+        self._direct_rows = 0
+        self._swaps_failed = 0
+        # per-daemon publish baselines: the obs counters are
+        # process-global and cumulative, so a second daemon in one
+        # process must add its OWN deltas, not diff against the
+        # predecessor's lifetime totals
+        self._published: dict[str, int] = {}
+        self._lat_baseline = None  # set at start(); see stats()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "ScoringDaemon":
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+            self._accepting = True
+            self._t_start = time.monotonic()
+        # baseline the (process-global, cumulative) latency histogram so
+        # stats()/serving_report percentiles cover THIS daemon's
+        # requests, not a predecessor's in the same process
+        self._lat_baseline = self._latency_counts()
+        for i in range(self.config.workers):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"serve-worker-{i}")
+            t.start()
+            self._threads.append(t)
+        if self.config.report_every_s > 0:
+            t = threading.Thread(target=self._reporter, daemon=True,
+                                 name="serve-reporter")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Drain-and-stop: admission closes immediately, queued requests
+        are still dispatched, workers exit once the queue is empty."""
+        with self._cond:
+            self._accepting = False
+            self._running = False
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        self._threads.clear()
+        # anything a timed-out worker left behind fails loudly
+        with self._cond:
+            leftovers, self._queue = self._queue, []
+        for _row, _t, fut in leftovers:
+            if fut is not None:
+                fut.set_exception(RuntimeError("serving daemon stopped"))
+        self._publish_metrics()
+        self._report(final=True)
+        if self._owns_registry:
+            self._registry.close()
+
+    def __enter__(self) -> "ScoringDaemon":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- request admission ---------------------------------------------
+
+    def submit(self, row, t_arrival: Optional[float] = None,
+               need_future: bool = True) -> Optional[Future]:
+        """Admit one feature row; returns a Future of its (H,) scores.
+
+        `t_arrival` (a time.perf_counter() timestamp) lets an open-loop
+        driver charge latency from the SCHEDULED arrival, so a sender
+        running behind cannot hide queueing delay (coordinated omission).
+        """
+        if getattr(row, "shape", None) != self._row_shape:
+            # coerce odd inputs up front: a malformed row must be rejected
+            # HERE, not poison a whole coalesced batch at dispatch
+            row = np.asarray(row, dtype=np.float32).ravel()
+            if row.shape != self._row_shape:
+                raise ValueError(f"expected {self.num_features} features, "
+                                 f"got {row.shape[0]}")
+        t = time.perf_counter() if t_arrival is None else t_arrival
+        fut = Future() if need_future else None
+        cond = self._cond
+        with cond:
+            if not self._accepting:
+                raise RuntimeError("serving daemon is not accepting "
+                                   "requests (not started or stopping)")
+            q = self._queue
+            if len(q) >= self.config.queue_limit:
+                self._rejected += 1
+                raise ServeOverload(
+                    f"admission queue at limit ({self.config.queue_limit} "
+                    "requests) — shed or retry")
+            q.append((row, t, fut))
+            n = len(q)
+            # wake the dispatcher only on the transitions that matter: an
+            # idle worker (empty -> 1) or a full batch; every other submit
+            # rides silently on the pending deadline
+            if n == 1 or n >= self.config.max_batch:
+                cond.notify()
+        return fut
+
+    def score(self, row, timeout: Optional[float] = None) -> np.ndarray:
+        """Synchronous single-request scoring through the batcher."""
+        fut = self.submit(row)
+        return fut.result(timeout=timeout)
+
+    def score_batch(self, rows: np.ndarray) -> np.ndarray:
+        """Already-batched requests bypass the coalescer (nothing to
+        gain) but still ride the versioned registry + telemetry seam."""
+        handle = self._registry.acquire(self.model_id)
+        try:
+            out = handle.scorer.compute_batch(rows)
+        except Exception:
+            # a failed batch frame is a scoring error like any other —
+            # serve_errors_total must not be micro-batch-path-only
+            r = np.asarray(rows)
+            with self._cond:
+                self._errors += int(r.shape[0]) if r.ndim > 1 else 1
+            raise
+        finally:
+            self._registry.release(handle)
+        with self._cond:
+            self._direct_rows += out.shape[0]
+        return out
+
+    # -- hot swap ------------------------------------------------------
+
+    def swap(self, export_dir: str, engine: Optional[str] = None) -> dict:
+        """Degrade-safe hot-swap: on ANY load failure the previous
+        version keeps serving and the error is reported, not raised —
+        in-flight and future requests are never dropped."""
+        try:
+            handle = self._registry.load(
+                export_dir, engine=engine or self.config.engine,
+                model_id=self.model_id)
+            return {"ok": True, "version": handle.version,
+                    "engine": handle.engine_name, "path": export_dir}
+        except Exception as e:
+            with self._cond:
+                self._swaps_failed += 1
+            kept = self._registry.current(self.model_id)
+            return {"ok": False,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                    "kept_version": kept.version if kept else None}
+
+    # -- dispatch loop -------------------------------------------------
+
+    def _worker(self) -> None:
+        cond = self._cond
+        cfg = self.config
+        while True:
+            with cond:
+                while not self._queue and self._running:
+                    cond.wait(0.05)
+                if not self._queue:
+                    return  # stopped and drained
+                # adaptive window: dispatch when the OLDEST request's
+                # budget expires or the queue reaches max_batch —
+                # queue-depth-driven batch sizing with a deadline floor
+                deadline = self._queue[0][1] + self._budget_s
+                while (self._running
+                       and len(self._queue) < cfg.max_batch):
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    cond.wait(remaining)
+                q = self._queue
+                if len(q) <= cfg.max_batch:
+                    batch = q          # swap, not slice: O(1), and a
+                    self._queue = []   # backlogged list never pays O(n)
+                else:                  # front-deletes per dispatch
+                    batch = q[:cfg.max_batch]
+                    del q[:cfg.max_batch]
+                if self._queue and self._running:
+                    cond.notify()  # another worker can start on the rest
+            if batch:
+                self._process(batch)
+
+    def _process(self, batch: list) -> None:
+        n = len(batch)
+        rows, arrival_ts, futures = zip(*batch)  # C-level unzip
+        x = np.stack(rows) if n > 1 else rows[0][None, :]
+        handle = self._registry.acquire(self.model_id)
+        err: Optional[Exception] = None
+        scores = None
+        try:
+            if getattr(handle.scorer, "static_shapes", False):
+                m = bucket_for(n, self._ladder)
+                if m != n:
+                    xp = np.zeros((m, self.num_features), np.float32)
+                    xp[:n] = x
+                    x = xp
+                # n_valid: pad rows must not count as scored traffic
+                scores = handle.scorer.compute_batch(x, n_valid=n)[:n]
+            else:
+                scores = handle.scorer.compute_batch(x)
+        except Exception as e:  # noqa: BLE001 — must resolve every future
+            err = e
+        finally:
+            self._registry.release(handle)
+        t_done = time.perf_counter()
+        if err is not None:
+            for fut in futures:
+                if fut is not None:
+                    fut.set_exception(err)
+            with self._cond:
+                self._errors += n
+            return
+        arrivals = np.asarray(arrival_ts, np.float64)
+        if any(f is not None for f in futures):
+            for fut, s in zip(futures, scores):
+                if fut is not None:
+                    fut.set_result(s)
+        latencies = t_done - arrivals
+        from ..export.scorer import observe_request_latencies
+        observe_request_latencies("serve", latencies)
+        with self._cond:
+            self._requests += n
+            self._batches += 1
+            self._batch_rows += n
+        if self._on_batch is not None:
+            try:
+                self._on_batch(scores, arrivals, t_done)
+            except Exception:
+                pass  # a driver's bookkeeping bug must not kill dispatch
+
+    # -- telemetry -----------------------------------------------------
+
+    def _snapshot(self) -> dict:
+        with self._cond:
+            return {"requests": self._requests,
+                    "rejected": self._rejected,
+                    "errors": self._errors,
+                    "batches": self._batches,
+                    "batch_rows": self._batch_rows,
+                    "direct_rows": self._direct_rows,
+                    "swaps_failed": self._swaps_failed,
+                    "queue_depth": len(self._queue)}
+
+    def _latency_counts(self):
+        from .. import obs
+        from ..export.scorer import SCORE_LATENCY_BUCKETS
+
+        hist = obs.histogram("score_latency_seconds",
+                             buckets=SCORE_LATENCY_BUCKETS)
+        return hist.counts(engine="serve")
+
+    def _latency_quantiles(self) -> tuple:
+        """(p50, p99) over THIS daemon's requests: the shared
+        `score_latency_seconds` schema is process-global and cumulative,
+        so difference against the start-time baseline."""
+        from ..export.scorer import SCORE_LATENCY_BUCKETS
+        from ..obs.metrics import quantile_from_counts
+
+        cur = self._latency_counts()
+        if cur is None:
+            return None, None
+        counts, _total, n = cur
+        base = getattr(self, "_lat_baseline", None)
+        if base is not None:
+            counts = [c - b for c, b in zip(counts, base[0])]
+            n -= base[2]
+        return (quantile_from_counts(SCORE_LATENCY_BUCKETS, counts, n,
+                                     0.50),
+                quantile_from_counts(SCORE_LATENCY_BUCKETS, counts, n,
+                                     0.99))
+
+    def stats(self) -> dict:
+        """Operator view: cumulative counters + histogram-estimated
+        latency percentiles (shared `score_latency_seconds` schema,
+        windowed to this daemon's lifetime)."""
+        snap = self._snapshot()
+        handle = self._registry.current(self.model_id)
+        p50, p99 = self._latency_quantiles()
+        uptime = (time.monotonic() - self._t_start) if self._t_start else 0
+        snap.update({
+            "model": self.model_id,
+            "version": handle.version if handle else None,
+            "engine": handle.engine_name if handle else None,
+            "export_dir": handle.export_dir if handle else None,
+            "num_features": self.num_features,
+            "batch_mean": round(snap["batch_rows"] / snap["batches"], 2)
+            if snap["batches"] else None,
+            "p50_ms": round(p50 * 1e3, 3) if p50 is not None else None,
+            "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
+            "uptime_s": round(uptime, 2),
+            "latency_budget_ms": self.config.latency_budget_ms,
+            "max_batch": self.config.max_batch,
+        })
+        return snap
+
+    def _publish_metrics(self) -> None:
+        """Hot-path counters (plain ints under the queue lock) into the
+        obs registry — called by the reporter cadence and stop()."""
+        from .. import obs
+
+        snap = self._snapshot()
+        obs.gauge("serve_queue_depth",
+                  "admission-queue depth after dispatch").set(
+            snap["queue_depth"])
+        for name, key, help_ in (
+                ("serve_requests_total", "requests",
+                 "single-row requests scored by the daemon"),
+                ("serve_rejected_total", "rejected",
+                 "requests rejected at the admission limit"),
+                ("serve_errors_total", "errors",
+                 "requests failed by a scoring error"),
+                ("serve_batches_total", "batches",
+                 "coalesced batches dispatched"),
+                ("serve_direct_rows_total", "direct_rows",
+                 "rows scored through the already-batched path")):
+            delta = snap[key] - self._published.get(key, 0)
+            if delta > 0:
+                obs.counter(name, help_).inc(delta)
+                self._published[key] = snap[key]
+
+    def _reporter(self) -> None:
+        last = self._snapshot()
+        last_t = time.monotonic()
+        while True:
+            t_next = last_t + self.config.report_every_s
+            while time.monotonic() < t_next:
+                if not self._running:
+                    return
+                time.sleep(0.1)
+            now = time.monotonic()
+            self._publish_metrics()
+            self._report(window=(last, now - last_t))
+            last = self._snapshot()
+            last_t = now
+
+    def _report(self, window=None, final: bool = False) -> None:
+        from .. import obs
+
+        snap = self.stats()
+        fields = dict(snap)
+        if window is not None:
+            prev, dt = window
+            fields["window_s"] = round(dt, 2)
+            fields["scores_per_sec"] = round(
+                (snap["requests"] - prev["requests"]) / max(dt, 1e-9), 1)
+        if final:
+            fields["final"] = True
+        obs.event("serving_report", **fields)
+        try:
+            obs.flush()
+        except Exception:
+            pass
+
+
+def serve_forever(export_dir: str, config: ServingConfig,
+                  echo=print, allow_swap: Optional[bool] = None) -> int:
+    """`shifu-tpu serve` body: daemon + wire server until SIGINT/SIGTERM.
+    Returns a process exit code."""
+    import signal
+
+    from . import serve_wire
+
+    daemon = ScoringDaemon(export_dir, config=config)
+    daemon.start()
+    try:
+        server = serve_wire.ServeServer(daemon, host=config.host,
+                                        port=config.port,
+                                        allow_swap=allow_swap)
+        server.start()
+    except OSError:
+        # bind failure (port in use): the daemon is already running —
+        # drain it so native handles close and the final report lands
+        daemon.stop()
+        raise
+    stop_evt = threading.Event()
+
+    def _stop(signum, _frame):
+        echo(f"serve: signal {signum} — draining")
+        stop_evt.set()
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, _stop)
+        except ValueError:
+            pass  # non-main thread (tests)
+    handle = daemon._registry.current(daemon.model_id)
+    echo(f"serve: model={export_dir} engine={handle.engine_name} "
+         f"features={daemon.num_features} on {server.host}:{server.port} "
+         f"(budget={config.latency_budget_ms}ms "
+         f"max_batch={config.max_batch})")
+    from .. import obs
+    obs.event("serve_start", path=export_dir, engine=handle.engine_name,
+              port=server.port, pid=os.getpid())
+    try:
+        stop_evt.wait()
+    except KeyboardInterrupt:
+        pass
+    server.close()
+    daemon.stop()
+    stats = daemon.stats()
+    echo("serve: stopped — " + json.dumps(
+        {k: stats[k] for k in ("requests", "rejected", "errors",
+                               "p50_ms", "p99_ms") if k in stats}))
+    return 0

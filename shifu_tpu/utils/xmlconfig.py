@@ -107,6 +107,21 @@ KEY_OBS_TRACE_TOP_K = "shifu.obs.trace-top-k"
 KEY_OBS_HBM_WATERMARKS = "shifu.obs.hbm-watermarks"
 KEY_OBS_ANOMALY_WINDOW = "shifu.obs.anomaly-window"
 KEY_OBS_ANOMALY_ZSCORE = "shifu.obs.anomaly-zscore"
+# serving plane (ServingConfig — runtime/serve.py, docs/SERVING.md):
+# the scoring daemon's engine tier, micro-batcher knobs (latency budget /
+# batch bounds / padded-bucket floor), admission limit, worker count,
+# report cadence, and the wire server's bind address.  Standalone config
+# (serving_config_from_conf), not a JobConfig overlay: serving is driven
+# from an export artifact, not a training job.
+KEY_SERVING_ENGINE = "shifu.serving.engine"
+KEY_SERVING_LATENCY_BUDGET_MS = "shifu.serving.latency-budget-ms"
+KEY_SERVING_MAX_BATCH = "shifu.serving.max-batch"
+KEY_SERVING_MIN_BATCH_BUCKET = "shifu.serving.min-batch-bucket"
+KEY_SERVING_QUEUE_LIMIT = "shifu.serving.queue-limit"
+KEY_SERVING_WORKERS = "shifu.serving.workers"
+KEY_SERVING_REPORT_EVERY_S = "shifu.serving.report-every-s"
+KEY_SERVING_PORT = "shifu.serving.port"
+KEY_SERVING_HOST = "shifu.serving.host"
 
 
 def parse_sharding_rules(value: str) -> tuple:
@@ -186,6 +201,37 @@ def write_configuration_xml(config: Mapping[str, str], path: str) -> None:
     and localized into every container, TensorflowClient.java:389-403)."""
     with open(path, "wb") as f:
         f.write(configuration_xml_bytes(config))
+
+
+def serving_config_from_conf(conf: Mapping[str, str], base: Any = None) -> Any:
+    """ServingConfig from `shifu.serving.*` keys over `base` (default: the
+    dataclass defaults) — the serving-plane sibling of apply_to_job, used
+    by `shifu-tpu serve` with CLI flags layered on top."""
+    import dataclasses
+
+    from ..config.schema import ServingConfig
+
+    base = base or ServingConfig()
+    kw: dict[str, Any] = {}
+    if KEY_SERVING_ENGINE in conf:
+        kw["engine"] = conf[KEY_SERVING_ENGINE].strip().lower()
+    if KEY_SERVING_LATENCY_BUDGET_MS in conf:
+        kw["latency_budget_ms"] = float(conf[KEY_SERVING_LATENCY_BUDGET_MS])
+    if KEY_SERVING_MAX_BATCH in conf:
+        kw["max_batch"] = int(conf[KEY_SERVING_MAX_BATCH])
+    if KEY_SERVING_MIN_BATCH_BUCKET in conf:
+        kw["min_batch_bucket"] = int(conf[KEY_SERVING_MIN_BATCH_BUCKET])
+    if KEY_SERVING_QUEUE_LIMIT in conf:
+        kw["queue_limit"] = int(conf[KEY_SERVING_QUEUE_LIMIT])
+    if KEY_SERVING_WORKERS in conf:
+        kw["workers"] = int(conf[KEY_SERVING_WORKERS])
+    if KEY_SERVING_REPORT_EVERY_S in conf:
+        kw["report_every_s"] = float(conf[KEY_SERVING_REPORT_EVERY_S])
+    if KEY_SERVING_PORT in conf:
+        kw["port"] = int(conf[KEY_SERVING_PORT])
+    if KEY_SERVING_HOST in conf:
+        kw["host"] = conf[KEY_SERVING_HOST].strip()
+    return dataclasses.replace(base, **kw) if kw else base
 
 
 def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:

@@ -308,13 +308,14 @@ def test_status_quick_summary_carries_goodput(tmp_path, monkeypatch):
 
 
 def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7,
-              cold=300.0, hbm=1 << 30):
+              cold=300.0, hbm=1 << 30, serving=250_000.0):
     return {"value": value, "unit": "samples/sec/chip",
             "goodput": {"goodput_fraction_mean": goodput_frac},
             "xla_compiles": {"total": compiles},
             "e2e_cached_disk_fraction_of_ceiling": ceiling,
             "e2e_cold_disk_samples_per_sec_per_chip": cold,
-            "device_hbm_peak_bytes": hbm}
+            "device_hbm_peak_bytes": hbm,
+            "serving_scores_per_sec": serving}
 
 
 @pytest.mark.perf
@@ -371,13 +372,24 @@ def test_perf_gate_fails_each_axis():
     # ...allocator wobble inside the factor passes
     r = perf_gate.run_gate(_artifact(hbm=int(1.2 * (1 << 30))), base)
     assert r["verdict"] == "PASS"
+    # serving-plane collapse (below the 0.3x --serving-drop default): the
+    # micro-batching daemon re-serialized (ISSUE 7)
+    r = perf_gate.run_gate(_artifact(serving=50_000.0), base)
+    assert r["verdict"] == "REGRESSION"
+    assert [c for c in r["checks"]
+            if c["name"] == "serving_scores_per_sec"][0]["status"] \
+        == "REGRESSION"
+    # ...a within-noise serving dip passes
+    r = perf_gate.run_gate(_artifact(serving=120_000.0), base)
+    assert r["verdict"] == "PASS"
     # missing fields on either side SKIP, never fail — an artifact that
     # predates the device flight recorder (no device_hbm_peak_bytes)
     # still gates the axes it carries
     r = perf_gate.run_gate({"value": 100.0}, base)
     assert r["verdict"] == "PASS"
     assert [c["status"] for c in r["checks"]] == ["OK", "SKIP", "SKIP",
-                                                  "SKIP", "SKIP", "SKIP"]
+                                                  "SKIP", "SKIP", "SKIP",
+                                                  "SKIP"]
 
 
 @pytest.mark.perf
@@ -416,7 +428,7 @@ def test_perf_gate_cli_pass_fail_and_check_only(tmp_path):
     fresh_bad = tmp_path / "fresh_bad.json"
     fresh_bad.write_text(json.dumps(
         _artifact(value=10.0, goodput_frac=0.1, compiles=100, ceiling=0.1,
-                  cold=10.0, hbm=8 << 30)))
+                  cold=10.0, hbm=8 << 30, serving=10_000.0)))
 
     def run(*args):
         return subprocess.run([sys.executable, gate, *args],

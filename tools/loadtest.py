@@ -1,0 +1,87 @@
+"""Open-loop (Poisson-arrival) load harness for the serving plane —
+standalone spelling of `shifu-tpu loadtest` (docs/SERVING.md).
+
+Drives either an in-process ScoringDaemon built from an export artifact
+(`--model`, the capacity-measurement mode) or a running `shifu-tpu serve`
+daemon over the wire (`--connect host:port`), and reports scores/s plus
+EXACT p50/p99 latency charged from each request's scheduled Poisson
+arrival (open-loop: a saturated server cannot slow the arrival process
+down and hide its queueing delay).
+
+Usage:
+    python tools/loadtest.py --model <export_dir> --rate 200000 --duration 5
+    python tools/loadtest.py --model <export_dir> --capacity   # rate ramp
+    python tools/loadtest.py --connect 127.0.0.1:8571 --rate 2000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="loadtest",
+        description="open-loop Poisson load harness for the scoring "
+                    "daemon; reports scores/s and p50/p99 latency")
+    p.add_argument("--model", default=None, help="export artifact dir "
+                   "(in-process mode)")
+    p.add_argument("--connect", default=None,
+                   help="host:port of a running daemon (socket mode)")
+    p.add_argument("--rate", type=float, default=50_000,
+                   help="offered requests/s (Poisson; default 50000)")
+    p.add_argument("--duration", type=float, default=5.0,
+                   help="seconds of offered load (default 5)")
+    p.add_argument("--engine", default="auto",
+                   choices=["auto", "native", "numpy", "stablehlo", "jax"])
+    p.add_argument("--senders", type=int, default=2,
+                   help="sender threads striping the arrival stream")
+    p.add_argument("--budget-ms", type=float, default=0,
+                   help="daemon latency budget (in-process mode)")
+    p.add_argument("--capacity", action="store_true",
+                   help="ramp the rate to the highest one meeting the "
+                        "p99 target instead of one fixed-rate run")
+    p.add_argument("--p99-target-ms", type=float, default=10.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    if bool(args.model) == bool(args.connect):
+        p.error("exactly one of --model / --connect")
+
+    from shifu_tpu.config.schema import ServingConfig
+    from shifu_tpu.runtime import loadtest as lt
+
+    config = None
+    if args.budget_ms:
+        config = ServingConfig(engine=args.engine,
+                               latency_budget_ms=args.budget_ms,
+                               report_every_s=0.0)
+    if args.capacity:
+        if not args.model:
+            p.error("--capacity needs --model (in-process mode)")
+        report = lt.find_capacity(args.model, engine=args.engine,
+                                  p99_target_ms=args.p99_target_ms,
+                                  senders=args.senders, config=config,
+                                  seed=args.seed)
+    else:
+        report = lt.run_loadtest(args.model, connect=args.connect,
+                                 engine=args.engine, rate=args.rate,
+                                 duration=args.duration,
+                                 senders=args.senders, config=config,
+                                 seed=args.seed)
+    print(json.dumps(report) if args.json else lt.render_report(report))
+    ok = (report.get("capacity_scores_per_sec")
+          or report.get("completed", 0))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

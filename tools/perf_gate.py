@@ -3,7 +3,7 @@ BENCH baseline and exit nonzero on regression.
 
 The repo's first *enforceable* perf trajectory (ISSUE 3): every round the
 driver captures a `BENCH_r*.json`; this gate compares a freshly produced
-`bench_full.json` against the newest of those baselines on six axes —
+`bench_full.json` against the newest of those baselines on seven axes —
 
 - **throughput / step time**: the headline resident-tier
   samples/sec/chip (`value`) must not fall below
@@ -35,6 +35,12 @@ driver captures a `BENCH_r*.json`; this gate compares a freshly produced
   --hbm-factor` (default 1.5) — a memory-footprint explosion is a
   capacity regression (the next batch-size bump OOMs) even when
   throughput survives it.
+- **serving throughput**: `serving_scores_per_sec` (the scoring
+  daemon's open-loop loadtest capacity at its p99 target, ISSUE 7 —
+  bench.py's serving rollup) must not fall below `--serving-drop`
+  (ratio, default 0.3) of the baseline: the guard on the
+  micro-batching serving plane (a re-serialized dispatch loop, a lost
+  batcher, a per-request lock would all collapse it).
 
 Checks whose fields are missing on either side are SKIPPED (pre-ledger
 baselines carry no goodput/compile fields; pre-flight-recorder ones no
@@ -127,7 +133,8 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
              compile_factor: float = 2.0,
              e2e_ceiling_drop: float = 0.2,
              cold_drop: float = 0.3,
-             hbm_factor: float = 1.5) -> dict:
+             hbm_factor: float = 1.5,
+             serving_drop: float = 0.3) -> dict:
     """The comparison itself (pure — unit-tested on synthetic pairs).
     Returns {"checks": [...], "verdict": "PASS"|"REGRESSION"}."""
     checks: list[dict] = []
@@ -205,6 +212,19 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
         limit = bh * hbm_factor
         check("device_hbm_peak_bytes", fh, bh, fh <= limit, round(limit, 1))
 
+    # serving throughput: the daemon's loadtest capacity (scores/s at the
+    # p99 target, open-loop — ISSUE 7).  Ratio-style like the headline
+    # and cold axes: the shared host's absolute numbers swing with
+    # co-tenant load.  SKIP when either side predates the serving plane.
+    fsv = _num(fresh, "serving_scores_per_sec")
+    bsv = _num(baseline, "serving_scores_per_sec")
+    if fsv is None or bsv is None or bsv <= 0:
+        check("serving_scores_per_sec", fsv, bsv, None, None)
+    else:
+        limit = bsv * serving_drop
+        check("serving_scores_per_sec", fsv, bsv, fsv >= limit,
+              round(limit, 1))
+
     regressed = [c for c in checks if c["status"] == "REGRESSION"]
     return {"checks": checks,
             "verdict": "REGRESSION" if regressed else "PASS"}
@@ -252,6 +272,11 @@ def main(argv=None) -> int:
                    help="fresh device_hbm_peak_bytes must be <= baseline * "
                         "this factor (the flight recorder's watermark, "
                         "ISSUE 6; SKIP when either side lacks the field)")
+    p.add_argument("--serving-drop", type=float, default=0.3,
+                   help="fresh serving_scores_per_sec must be >= baseline "
+                        "* this fraction (the scoring daemon's loadtest "
+                        "capacity, ISSUE 7; SKIP when either side lacks "
+                        "the field)")
     p.add_argument("--check-only", action="store_true",
                    help="tier-1 mode: missing/corrupt artifacts degrade to "
                         "a journaled warning and exit 0")
@@ -293,7 +318,8 @@ def main(argv=None) -> int:
                       compile_factor=args.compile_factor,
                       e2e_ceiling_drop=args.e2e_ceiling_drop,
                       cold_drop=args.cold_drop,
-                      hbm_factor=args.hbm_factor)
+                      hbm_factor=args.hbm_factor,
+                      serving_drop=args.serving_drop)
     report["fresh"] = args.fresh
     report["baseline"] = baseline_path
     _journal("perf_gate", verdict=report["verdict"],
