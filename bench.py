@@ -1025,6 +1025,12 @@ def main() -> None:
                 extras["serving_p99_ms"] = cap.get("p99_ms")
                 extras["serving_batch_mean"] = cap.get("batch_mean")
                 extras["serving_engine"] = cap.get("engine")
+                # per-stage lifecycle decomposition of the capacity run
+                # (obs/slo.py STAGES): which stage the p99 lives in —
+                # the artifact-level answer to "where does latency go
+                # as rate climbs" (docs/SERVING.md telemetry)
+                if cap.get("stages"):
+                    extras["serving_stage_breakdown"] = cap["stages"]
         except Exception as e:
             extras["serving_error"] = str(e)[:200]
     except Exception:

@@ -315,6 +315,12 @@ def _inject(site: str, spec: FaultSpec, call_n: int,
     if spec.action == "hang":
         while True:
             time.sleep(3600)
+    if spec.action == "delay":
+        # a slowdown, not a failure: the probe returns normally after the
+        # sleep — latency monitors (serving SLO burn rates, the flight
+        # recorder's anomaly z-score) are what a delay drill exercises
+        time.sleep(spec.delay_s)
+        return
     if spec.action == "corrupt":
         path = ctx.get("path")
         if path:

@@ -36,7 +36,9 @@ Actions: ``raise`` (a ChaosError, an OSError subclass — exercises retry and
 fallback paths), ``exit`` (os._exit(exit_code) — a hard crash), ``hang``
 (stall forever — exercises liveness monitors), ``corrupt`` (flip bytes in
 the file tree the probe passes as ``path`` context — exercises checkpoint
-digest verification).
+digest verification), ``delay`` (sleep ``delay_s`` seconds then continue —
+a SLOWDOWN, not a failure: exercises latency monitors like the serving SLO
+engine's burn-rate alerting at the `runtime.serve.dispatch` probe).
 
 The legacy SHIFU_TPU_FAULT_* / SHIFU_TPU_HANG_EPOCH env hooks synthesize an
 equivalent plan (`plan_from_legacy_env`), so pre-chaos drills keep working
@@ -53,7 +55,7 @@ from typing import Mapping, Optional
 ENV_CHAOS_PLAN = "SHIFU_TPU_CHAOS_PLAN"
 ENV_CHAOS_STATE = "SHIFU_TPU_CHAOS_STATE"
 
-ACTIONS = ("raise", "exit", "hang", "corrupt")
+ACTIONS = ("raise", "exit", "hang", "corrupt", "delay")
 SCOPES = ("process", "job")
 
 
@@ -76,6 +78,7 @@ class FaultSpec:
     max_times: int = 0        # stop after M injections; 0 = unlimited
     scope: str = "process"
     exit_code: int = 17
+    delay_s: float = 0.1      # sleep length of the `delay` action
     message: str = ""         # echoed on injection ({site}/{epoch}/{rank}
                               # format fields available)
 
@@ -98,7 +101,8 @@ class FaultSpec:
         for field, cast in (("at_call", int), ("every", int),
                             ("at_epoch", int), ("before_epoch", int),
                             ("rank", int), ("max_times", int),
-                            ("exit_code", int), ("prob", float)):
+                            ("exit_code", int), ("prob", float),
+                            ("delay_s", float)):
             try:
                 coerced[field] = cast(getattr(self, field))
             except (TypeError, ValueError):
@@ -112,6 +116,9 @@ class FaultSpec:
         if not (0.0 <= spec.prob <= 1.0):
             raise ChaosPlanError(
                 f"fault {self.site!r}: prob must be in [0, 1]")
+        if spec.delay_s < 0:
+            raise ChaosPlanError(
+                f"fault {self.site!r}: delay_s must be >= 0")
         if (spec.at_call <= 0 and spec.every <= 0 and spec.at_epoch < 0
                 and spec.before_epoch < 0 and spec.prob <= 0.0):
             raise ChaosPlanError(

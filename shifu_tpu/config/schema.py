@@ -557,6 +557,27 @@ class ServingConfig:
     port: int = 8571
     # bind host for the wire server
     host: str = "127.0.0.1"
+    # per-request lifecycle tracing (obs/slo.py, docs/OBSERVABILITY.md
+    # "Serving SLO engine"): journal one sampled `request_trace` event —
+    # the admission/queue/coalesce/dispatch/device/reply span chain whose
+    # stage durations sum to the end-to-end latency — for every Nth
+    # admitted request (deterministic 1-in-N).  0 disables sampling; the
+    # per-stage `serve_stage_seconds` histograms stay on regardless.
+    trace_sample: int = 0
+    # serving SLO objectives (`shifu.serving.slo.*` XML keys); 0 disables
+    # each.  p99 target in ms — pick a value on the latency bucket grid
+    # (1/2.5/5/10/25...) so the violation count is bucket-exact; error
+    # rate and availability are fractions (e.g. 0.001 / 0.999).
+    slo_p99_ms: float = 0.0
+    slo_error_rate: float = 0.0
+    slo_availability: float = 0.0
+    # multiwindow burn-rate alerting: both the fast and the slow trailing
+    # window must burn the objective's budget at >= slo_burn_threshold x
+    # the sustainable rate to fire ONE `slo_alert`; the alert latches
+    # until the fast window is healthy again (burn < 1), then resolves.
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 300.0
+    slo_burn_threshold: float = 2.0
 
     def validate(self) -> None:
         if self.engine not in ("auto", "native", "numpy", "stablehlo",
@@ -581,6 +602,27 @@ class ServingConfig:
             raise ConfigError("serving.report_every_s must be >= 0")
         if not (0 <= self.port <= 65535):
             raise ConfigError(f"serving.port out of range: {self.port}")
+        if self.trace_sample < 0:
+            raise ConfigError("serving.trace_sample must be >= 0 "
+                              f"(0 = off, N = 1-in-N): {self.trace_sample}")
+        if self.slo_p99_ms < 0:
+            raise ConfigError(
+                f"serving.slo.p99-ms must be >= 0: {self.slo_p99_ms}")
+        if not (0 <= self.slo_error_rate < 1):
+            raise ConfigError("serving.slo.error-rate must be in [0, 1): "
+                              f"{self.slo_error_rate}")
+        if not (0 <= self.slo_availability < 1):
+            raise ConfigError("serving.slo.availability must be in [0, 1): "
+                              f"{self.slo_availability}")
+        if self.slo_fast_window_s <= 0 \
+                or self.slo_slow_window_s < self.slo_fast_window_s:
+            raise ConfigError(
+                "serving SLO windows need 0 < slo_fast_window_s <= "
+                f"slo_slow_window_s: {self.slo_fast_window_s}/"
+                f"{self.slo_slow_window_s}")
+        if self.slo_burn_threshold < 1:
+            raise ConfigError("serving.slo.burn-threshold must be >= 1: "
+                              f"{self.slo_burn_threshold}")
 
 
 # ---------------------------------------------------------------------------

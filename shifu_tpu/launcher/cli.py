@@ -142,6 +142,25 @@ def build_parser() -> argparse.ArgumentParser:
                          "(local or gs:// hdfs:// URI)")
     tr.add_argument("--json", action="store_true",
                     help="machine-readable trace dict instead of text")
+    tp = sub.add_parser(
+        "top", help="live streaming view of a job or serving daemon — "
+                    "rate/p50/p99, queue depth, lifecycle stage breakdown "
+                    "(queue/coalesce/dispatch/device), active SLO alerts; "
+                    "pass several dirs for a multi-daemon fleet rollup "
+                    "(journal/scrape tail only — no jax import; "
+                    "docs/OBSERVABILITY.md 'Serving SLO engine')")
+    tp.add_argument("job_dirs", nargs="+",
+                    help="job dir(s), telemetry dir(s), or journal.jsonl "
+                         "path(s) — N dirs render the fleet rollup "
+                         "(obs/aggregate.serving_rollup)")
+    tp.add_argument("--once", action="store_true",
+                    help="render one frame and exit (scripting / CI)")
+    tp.add_argument("--json", action="store_true",
+                    help="machine-readable frame(s): one JSON dict per "
+                         "frame (JSONL when streaming)")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds for the streaming view "
+                         "(default 2)")
     ch = sub.add_parser(
         "cache", help="inspect the columnar data cache: list entries "
                       "(tier/version/bytes/source) and prune superseded, "
@@ -1092,6 +1111,49 @@ def run_trace(args) -> int:
     return EXIT_OK
 
 
+def run_top(args) -> int:
+    """`shifu-tpu top <dir> [...]`: the live operator view of the serving
+    and device planes joined — rate / p50 / p99 / queue depth, the
+    per-request lifecycle stage breakdown (where a p99 excursion's time
+    actually goes), and active SLO burn-rate alerts; a train job dir
+    renders epoch progress + goodput instead.  Journal/scrape-file reads
+    only — safe to point at a LIVE daemon from any machine that can read
+    the dir, and never imports jax."""
+    from ..obs import aggregate as obs_aggregate
+    from ..obs import render as obs_render
+
+    def frame() -> tuple:
+        if len(args.job_dirs) > 1:
+            rollup = obs_aggregate.serving_rollup(args.job_dirs)
+            return rollup, obs_render.render_top_fleet_text(rollup)
+        summary = obs_render.top_summary(args.job_dirs[0])
+        if summary is None:
+            return None, None
+        return summary, obs_render.render_top_text(summary)
+
+    try:
+        while True:
+            data, text = frame()
+            if data is None:
+                print(f"no telemetry journal found under "
+                      f"{args.job_dirs[0]} (expected <dir>/telemetry/"
+                      f"journal.jsonl — a `shifu-tpu serve`/train job "
+                      f"writes one)", file=sys.stderr, flush=True)
+                return EXIT_FAIL
+            if args.json:
+                print(json.dumps(data), flush=True)
+            else:
+                if not args.once:
+                    # clear + home: a terminal frame, not a scrolling log
+                    print("\x1b[2J\x1b[H", end="")
+                print(text, flush=True)
+            if args.once:
+                return EXIT_OK
+            time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        return EXIT_OK
+
+
 def run_cache(args) -> int:
     """`shifu-tpu cache <dir>`: the operator view of the columnar cache —
     every artifact classified (raw / projected / consolidated dataset,
@@ -1720,6 +1782,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.command == "trace":
         # likewise journal reads only — no jax import
         return run_trace(args)
+    if args.command == "top":
+        # likewise journal/scrape tail only — no jax import, safe to
+        # point at a live daemon from any machine
+        return run_top(args)
     if args.command == "chaos-verify":
         # likewise journal/plan reads only — no jax import
         return run_chaos_verify(args)

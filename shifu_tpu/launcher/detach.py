@@ -221,6 +221,9 @@ def _telemetry_quick_summary(jpath: str) -> Optional[dict]:
     last_kind = None
     goodput = None
     hbm = None
+    serving = None
+    slo_firing: dict = {}
+    slo_seen: set = set()
     for line in reversed(tail.splitlines()):
         try:
             rec = json.loads(line)
@@ -230,6 +233,24 @@ def _telemetry_quick_summary(jpath: str) -> Optional[dict]:
             continue
         if last_kind is None:
             last_kind = rec.get("kind")
+        if serving is None and rec.get("kind") == "serving_report":
+            # latest serving_report within the tail: the at-a-glance
+            # daemon health next to goodput (docs/SERVING.md telemetry)
+            serving = {"requests": rec.get("requests"),
+                       "scores_per_sec": rec.get("scores_per_sec"),
+                       "p99_ms": rec.get("p99_ms"),
+                       "queue_depth": rec.get("queue_depth"),
+                       "errors": rec.get("errors")}
+        if rec.get("kind") == "slo_alert":
+            # walk is newest-first: the FIRST state seen per objective is
+            # its current one — firing objectives are the active alerts
+            obj = str(rec.get("objective", "?"))
+            if obj not in slo_seen:
+                slo_seen.add(obj)
+                if rec.get("state") == "firing":
+                    slo_firing[obj] = {
+                        "burn_fast": rec.get("burn_fast"),
+                        "observed_p99_ms": rec.get("observed_p99_ms")}
         if goodput is None and rec.get("kind") == "goodput":
             # latest goodput ledger record within the tail window: the
             # at-a-glance "is the job actually stepping" numbers
@@ -252,6 +273,11 @@ def _telemetry_quick_summary(jpath: str) -> Optional[dict]:
         out["goodput"] = goodput
     if hbm is not None:
         out["hbm"] = hbm
+    if serving is not None:
+        out["serving"] = serving
+    if slo_seen:
+        out["slo"] = {"firing": sorted(slo_firing),
+                      "alerts": slo_firing}
     return out
 
 

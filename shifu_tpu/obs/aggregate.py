@@ -125,3 +125,57 @@ def epoch_skew(epoch: int, input_seconds: float, epoch_seconds: float,
         from . import _sinks
         _sinks.event("host_skew", epoch=epoch, hosts=rows)
     return rows
+
+
+# -- multi-daemon serving rollup (pod scale-out prep) ------------------------
+
+
+def serving_rollup(paths: list) -> dict:
+    """Join N serving telemetry dirs into one fleet view — journal/scrape
+    reads only (obs/render.top_summary per dir), no jax, no collectives:
+    the rollup runs on any machine that can read the dirs, the serving
+    analog of the training plane's host_skew table.
+
+    Returns {"daemons": [per-dir top summaries + "dir"],
+    "fleet": {daemons, scores_per_sec (sum of live rates), worst_p99_ms,
+    queue_depth (sum), active_alerts, firing (objective names)}} —
+    rendered by `shifu-tpu top <dir> <dir> ...`
+    (render.render_top_fleet_text)."""
+    from . import render
+
+    daemons: list[dict] = []
+    for p in paths:
+        s = render.top_summary(str(p))
+        if s is None:
+            s = {"dir": str(p), "error": "no telemetry journal"}
+        else:
+            s["dir"] = str(p)
+        daemons.append(s)
+    rates = []
+    p99s = []
+    queue = 0
+    active: list[dict] = []
+    firing: set = set()
+    for d in daemons:
+        sv = d.get("serving") or {}
+        if isinstance(sv.get("scores_per_sec"), (int, float)):
+            rates.append(sv["scores_per_sec"])
+        if isinstance(sv.get("p99_ms"), (int, float)):
+            p99s.append(sv["p99_ms"])
+        if isinstance(sv.get("queue_depth"), (int, float)):
+            queue += int(sv["queue_depth"])
+        for a in (d.get("slo") or {}).get("active") or []:
+            active.append(a)
+            if a.get("objective"):
+                firing.add(str(a["objective"]))
+    return {
+        "daemons": daemons,
+        "fleet": {
+            "daemons": len(daemons),
+            "scores_per_sec": round(sum(rates), 1) if rates else None,
+            "worst_p99_ms": max(p99s) if p99s else None,
+            "queue_depth": queue,
+            "active_alerts": len(active),
+            "firing": sorted(firing),
+        },
+    }

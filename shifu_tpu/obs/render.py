@@ -91,6 +91,57 @@ def parse_scrape_totals(text: str) -> dict[str, float]:
     return totals
 
 
+def parse_scrape_histograms(text: str) -> dict:
+    """Histogram series from Prometheus text: {metric_name: {label_key:
+    {"bounds": [...], "counts": [per-bucket incl +Inf], "sum", "count"}}}
+    where label_key is the sorted 'k=v;k=v' spelling WITHOUT `le`.  Enough
+    for stage/latency percentile math (`quantile_from_counts`) from the
+    scrape file alone — no live process needed."""
+    series: dict = {}
+    line_re = re.compile(
+        r"^([A-Za-z_:][A-Za-z0-9_:]*)_(bucket|sum|count)(\{.*\})?\s+(\S+)$")
+    pair_re = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+    for line in text.splitlines():
+        m = line_re.match(line)
+        if not m:
+            continue
+        name, part, labels_s, value_s = m.groups()
+        labels = dict(pair_re.findall(labels_s or ""))
+        le = labels.pop("le", None)
+        key = ";".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        try:
+            value = float(value_s)
+        except ValueError:
+            continue
+        s = series.setdefault(name, {}).setdefault(
+            key, {"le": {}, "sum": 0.0, "count": 0})
+        if part == "bucket" and le is not None:
+            bound = float("inf") if le == "+Inf" else float(le)
+            s["le"][bound] = value
+        elif part == "sum":
+            s["sum"] = value
+        elif part == "count":
+            s["count"] = int(value)
+    out: dict = {}
+    for name, by_key in series.items():
+        for key, s in by_key.items():
+            if not s["le"]:
+                continue  # a _sum/_count pair without buckets (summary)
+            bounds = sorted(b for b in s["le"] if b != float("inf"))
+            cum = [s["le"][b] for b in bounds]
+            # a series whose only bucket is +Inf (legal exposition) has
+            # no finite bounds: everything rides the +Inf count
+            counts = ([int(cum[0])] + [int(cum[i] - cum[i - 1])
+                                       for i in range(1, len(cum))]
+                      if cum else [])
+            counts.append(max(int(s["count"]) - int(cum[-1] if cum else 0),
+                              0))  # +Inf bucket
+            out.setdefault(name, {})[key] = {
+                "bounds": bounds, "counts": counts,
+                "sum": s["sum"], "count": s["count"]}
+    return out
+
+
 def _load_events(jpath: str) -> list[dict]:
     """One journal's events, with the supervisor's remote-dir sidecar
     journal merged when present (two writers on one remote object would
@@ -570,4 +621,322 @@ def render_trace_text(summary: dict) -> str:
     for f in summary.get("trace_fallbacks") or []:
         lines.append(f"trace fallback: epoch {f.get('epoch')} "
                      f"stage={f.get('stage')} error={f.get('error')}")
+    return "\n".join(lines)
+
+
+# -- `shifu-tpu top`: the live serving/train operator view -------------------
+
+# journal kinds that mark a telemetry dir as a serving daemon's (or a
+# loadtest run against one)
+_SERVING_KINDS = ("serve_start", "serving_report", "loadtest_report")
+
+# a `top` frame reads the journal TAIL, not the whole file: a long-lived
+# daemon's journal grows without bound, and a 2s-refresh streaming view
+# must not pay O(run-length) reads per frame.  4 MiB holds hours of
+# report-cadence events; everything a frame shows (latest report, alert
+# states newest-wins, scrape histograms) is tail-derivable.
+_TOP_TAIL_BYTES = 4 << 20
+
+
+def _load_events_tail(jpath: str, tail_bytes: int = _TOP_TAIL_BYTES
+                      ) -> tuple[list[dict], int, bool]:
+    """(events parsed from the journal's last `tail_bytes`, event count
+    of what was read, truncated?) — the bounded read behind `top`
+    frames: ONE seek + ONE tail-sized read, never a whole-file pass (a
+    2 GB journal must not be re-read every refresh).  Falls back to the
+    full read for remote paths (fsio reads are whole-object anyway)."""
+    import json as json_mod
+    try:
+        from ..data import fsio
+        remote = fsio.is_remote(jpath)
+    except Exception:
+        remote = False
+    if remote:
+        events = _load_events(jpath)
+        return events, len(events), False
+    try:
+        size = os.path.getsize(jpath)
+        with open(jpath, "rb") as f:
+            truncated = size > tail_bytes
+            if truncated:
+                f.seek(size - tail_bytes)
+            tail = f.read(tail_bytes)
+            if truncated:
+                # the window may open mid-line: drop the torn first line
+                nl = tail.find(b"\n")
+                tail = tail[nl + 1:] if nl >= 0 else b""
+    except OSError:
+        return [], 0, False
+    events = []
+    for line in tail.splitlines():
+        try:
+            rec = json_mod.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            events.append(rec)
+    return events, len(events), truncated
+
+
+def top_summary(path: str) -> Optional[dict]:
+    """One `shifu-tpu top` frame for a job/telemetry dir: journal tail +
+    scrape file ONLY (no jax import, bounded reads — safe to refresh
+    against a live long-lived daemon).
+
+    Serving dirs render rate / p50 / p99 / queue depth / batch shape, the
+    per-stage lifecycle breakdown (always-on `serve_stage_seconds`
+    histograms in the scrape file), active SLO alerts (firing `slo_alert`
+    events not yet resolved), and sampled `request_trace` / one-shot
+    `device_profile` counts.  Train dirs render epoch progress, goodput /
+    MFU, and the last event — ONE command tops both planes.  None when no
+    journal is found."""
+    jpath = find_journal(path)
+    if jpath is None:
+        return None
+    events, total_events, tail_only = _load_events_tail(jpath)
+    reports: list[dict] = []
+    alerts: list[dict] = []
+    epochs: list[dict] = []
+    goodput: Optional[dict] = None
+    serve_start: Optional[dict] = None
+    loadtests: list[dict] = []
+    traces = 0
+    slo_profiles = 0
+    mode = "train"
+    for rec in events:
+        kind = rec.get("kind")
+        if kind == "serving_report":
+            reports.append(rec)
+        elif kind == "slo_alert":
+            alerts.append(rec)
+        elif kind == "serve_start":
+            serve_start = rec
+        elif kind == "loadtest_report":
+            loadtests.append(rec)
+        elif kind == "request_trace":
+            traces += 1
+        elif kind == "device_profile" and rec.get("trigger") == "slo":
+            slo_profiles += 1
+        elif kind == "epoch":
+            epochs.append(rec)
+        elif kind == "goodput":
+            goodput = rec
+    if serve_start is not None or reports or loadtests:
+        mode = "serving"
+    out: dict = {"journal": jpath, "mode": mode, "events": total_events}
+    if tail_only:
+        out["events_tail_only"] = True  # counts cover the 4 MiB tail
+    if events:
+        out["last_event"] = {"kind": events[-1].get("kind"),
+                             "ts": events[-1].get("ts")}
+
+    scrape = _read_scrape(jpath)
+    if mode == "serving":
+        last = reports[-1] if reports else {}
+        if not last and loadtests:
+            # a loadtest-only dir (socket run's own telemetry): render
+            # the last run's achieved numbers in the serving frame
+            lt = loadtests[-1]
+            last = {"requests": lt.get("completed"),
+                    "rejected": lt.get("rejected"),
+                    "errors": lt.get("errors"),
+                    "p50_ms": lt.get("p50_ms"),
+                    "p99_ms": lt.get("p99_ms"),
+                    "engine": lt.get("engine"),
+                    "scores_per_sec": lt.get("achieved_scores_per_sec"),
+                    "stages": lt.get("stages")}
+        out["serving"] = {k: last.get(k) for k in
+                          ("requests", "rejected", "errors", "queue_depth",
+                           "batch_mean", "p50_ms", "p99_ms", "engine",
+                           "version", "model", "uptime_s", "scores_per_sec",
+                           "window_s")}
+        if out["serving"].get("scores_per_sec") is None and len(reports) >= 2:
+            # no windowed report (final-only journal): derive the rate
+            # from the last two reports' cumulative request counts
+            a, b = reports[-2], reports[-1]
+            try:
+                dt = float(b.get("ts", 0)) - float(a.get("ts", 0))
+                dr = int(b.get("requests", 0)) - int(a.get("requests", 0))
+                if dt > 0:
+                    out["serving"]["scores_per_sec"] = round(dr / dt, 1)
+            except (TypeError, ValueError):
+                pass
+        if serve_start is not None:
+            out["serving"]["path"] = serve_start.get("path")
+            out["serving"]["port"] = serve_start.get("port")
+        # stage decomposition from the scrape file's always-on histograms
+        if scrape:
+            out["stages"] = _stage_breakdown_from_scrape(scrape)
+        # the daemon's own lifetime-windowed view wins when present (a
+        # shared metrics dir can hold more than one daemon's histograms)
+        if last.get("stages"):
+            out["stages"] = last["stages"]
+        out["slo"] = _slo_state_from_alerts(alerts, last.get("slo"))
+        out["request_traces"] = traces
+        if slo_profiles:
+            out["slo_device_profiles"] = slo_profiles
+    else:
+        if epochs:
+            e = epochs[-1]
+            out["epoch"] = {k: e.get(k) for k in
+                            ("epoch", "train_error", "valid_error",
+                             "valid_auc", "epoch_time")}
+        if goodput is not None:
+            out["goodput"] = {k: goodput.get(k) for k in
+                              ("epoch", "goodput_fraction", "mfu")}
+    return out
+
+
+def _stage_breakdown_from_scrape(scrape_text: str) -> Optional[dict]:
+    """{stage: {mean_ms, p99_ms, count, share}} from the scrape file's
+    `serve_stage_seconds` histograms — same shape as loadtest/stats()
+    (the ONE decomposition helper, obs/slo.stage_stats)."""
+    from .slo import stage_stats
+
+    hists = parse_scrape_histograms(scrape_text).get("serve_stage_seconds")
+    if not hists:
+        return None
+    per_stage: dict = {}
+    for key, s in hists.items():
+        stage = dict(kv.split("=", 1) for kv in key.split(";")
+                     if "=" in kv).get("stage")
+        if not stage:
+            continue
+        per_stage[stage] = (s["bounds"], s["counts"], s["sum"], s["count"])
+    return stage_stats(per_stage) or None
+
+
+def _slo_state_from_alerts(alerts: list[dict],
+                           live_state: Optional[dict]) -> dict:
+    """Active (firing, not yet resolved) alerts from the journaled
+    `slo_alert` transitions, plus the last serving_report's live burn
+    snapshot when present."""
+    firing: dict[str, dict] = {}
+    for a in alerts:
+        obj = str(a.get("objective", "?"))
+        if a.get("state") == "firing":
+            firing[obj] = a
+        elif a.get("state") == "resolved":
+            firing.pop(obj, None)
+    out = {
+        "alerts_total": sum(1 for a in alerts
+                            if a.get("state") == "firing"),
+        "active": [
+            {k: a.get(k) for k in
+             ("objective", "burn_fast", "burn_slow", "observed_p99_ms",
+              "observed_error_rate", "observed_availability", "ts")}
+            for a in firing.values()],
+    }
+    if isinstance(live_state, dict):
+        out["burns"] = live_state.get("burns")
+        out["objectives"] = live_state.get("objectives")
+    return out
+
+
+def render_top_text(summary: dict) -> str:
+    """One `shifu-tpu top` frame as text."""
+    lines = [f"[{summary.get('mode')}] {summary['journal']} "
+             f"({summary.get('events')} events)"]
+    sv = summary.get("serving")
+    if sv:
+        rate = sv.get("scores_per_sec")
+        lines.append(
+            "rate "
+            + (f"{rate:,.0f}/s" if isinstance(rate, (int, float)) else "-")
+            + f"  p50 {sv.get('p50_ms')} ms  p99 {sv.get('p99_ms')} ms  "
+            f"queue {sv.get('queue_depth')}  batch {sv.get('batch_mean')}  "
+            f"engine {sv.get('engine')} v{sv.get('version')}")
+        lines.append(
+            f"requests {sv.get('requests')}  rejected {sv.get('rejected')}"
+            f"  errors {sv.get('errors')}  uptime {sv.get('uptime_s')}s")
+    stages = summary.get("stages")
+    if stages:
+        lines.append(f"  {'stage':<10} {'mean_ms':>9} {'p99_ms':>9} "
+                     f"{'share':>7}")
+        order = ("admission", "queue", "coalesce", "dispatch", "device",
+                 "reply")
+        for stage in order:
+            s = stages.get(stage)
+            if not s:
+                continue
+            share = s.get("share")
+            lines.append(
+                f"  {stage:<10} {s.get('mean_ms', '-'):>9} "
+                f"{(s.get('p99_ms') if s.get('p99_ms') is not None else '-'):>9} "
+                f"{(format(share, '.1%') if isinstance(share, (int, float)) else '-'):>7}")
+    slo = summary.get("slo")
+    if slo is not None:
+        active = slo.get("active") or []
+        if active:
+            for a in active:
+                obs_bits = [f"{k.replace('observed_', '')}="
+                            f"{a[k]}" for k in
+                            ("observed_p99_ms", "observed_error_rate",
+                             "observed_availability") if a.get(k) is not None]
+                lines.append(
+                    f"ALERT {a.get('objective')}: burn fast "
+                    f"{a.get('burn_fast')} / slow {a.get('burn_slow')}"
+                    + (f"  ({' '.join(obs_bits)})" if obs_bits else ""))
+        else:
+            objectives = slo.get("objectives")
+            lines.append("slo: ok"
+                         + (f" (objectives: "
+                            f"{', '.join(sorted(objectives))})"
+                            if objectives else
+                            f" ({slo.get('alerts_total', 0)} alert(s) "
+                            "this run)"))
+    if summary.get("request_traces"):
+        lines.append(f"sampled request traces: "
+                     f"{summary['request_traces']}"
+                     + (f"  slo device profiles: "
+                        f"{summary['slo_device_profiles']}"
+                        if summary.get("slo_device_profiles") else ""))
+    ep = summary.get("epoch")
+    if ep:
+        lines.append(
+            f"epoch {ep.get('epoch')}  train_err {ep.get('train_error')}  "
+            f"valid_err {ep.get('valid_error')}  auc {ep.get('valid_auc')}  "
+            f"epoch_s {ep.get('epoch_time')}")
+    gp = summary.get("goodput")
+    if gp:
+        frac = gp.get("goodput_fraction")
+        mfu = gp.get("mfu")
+        lines.append(
+            "goodput "
+            + (format(frac, ".1%") if isinstance(frac, (int, float))
+               else "-")
+            + ("  mfu " + format(mfu, ".4f")
+               if isinstance(mfu, (int, float)) else ""))
+    last = summary.get("last_event")
+    if last:
+        lines.append(f"last event: {last.get('kind')} at ts "
+                     f"{last.get('ts')}")
+    return "\n".join(lines)
+
+
+def render_top_fleet_text(rollup: dict) -> str:
+    """The multi-daemon `shifu-tpu top` frame (obs/aggregate.py
+    serving_rollup): fleet totals + one row per daemon."""
+    fleet = rollup.get("fleet") or {}
+    lines = [
+        f"fleet: {fleet.get('daemons')} daemon(s)  rate "
+        + (f"{fleet['scores_per_sec']:,.0f}/s"
+           if isinstance(fleet.get("scores_per_sec"), (int, float))
+           else "-")
+        + f"  worst p99 {fleet.get('worst_p99_ms')} ms  "
+        f"active alerts {fleet.get('active_alerts')}"]
+    lines.append(f"  {'daemon':<28} {'rate/s':>10} {'p99_ms':>8} "
+                 f"{'queue':>6} {'alerts':>7} {'slo':>8}")
+    for d in rollup.get("daemons") or []:
+        sv = d.get("serving") or {}
+        active = (d.get("slo") or {}).get("active") or []
+        rate = sv.get("scores_per_sec")
+        lines.append(
+            f"  {str(d.get('dir'))[-28:]:<28} "
+            + (f"{rate:>10,.0f}" if isinstance(rate, (int, float))
+               else f"{'-':>10}")
+            + f" {sv.get('p99_ms') if sv.get('p99_ms') is not None else '-':>8}"
+            f" {sv.get('queue_depth') if sv.get('queue_depth') is not None else '-':>6}"
+            f" {len(active):>7}"
+            f" {'FIRING' if active else 'ok':>8}")
     return "\n".join(lines)

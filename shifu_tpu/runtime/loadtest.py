@@ -110,6 +110,9 @@ def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
     daemon._on_batch = on_batch
     errors_at_start = daemon._snapshot()["errors"]  # the daemon counter
     # is lifetime-cumulative; this run must only count its own
+    stages_at_start = daemon.stage_counts()  # likewise the stage
+    # histograms: window them to THIS run so the decomposition shows
+    # where latency goes at THIS offered rate, not a ramp's mixture
     submitted = [0] * senders
     rejected = [0] * senders
     # pre-resolve each sender's (scheduled time, row) sequence OUTSIDE the
@@ -197,6 +200,12 @@ def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
         "senders": senders,
         **_percentiles(latencies),
     }
+    # per-stage latency decomposition of THIS run (queue / coalesce /
+    # dispatch / device / reply): where the end-to-end percentile's time
+    # went — the capacity-ramp readout that says WHAT saturates first
+    stages = daemon.stage_window(stages_at_start, daemon.stage_counts())
+    if stages:
+        report["stages"] = stages
     handle = daemon._registry.current(daemon.model_id)
     if handle is not None:
         report["engine"] = handle.engine_name
@@ -278,6 +287,19 @@ def _run_socket(connect: str, *, rate: float, duration: float,
         "senders": senders,
         **_percentiles(latencies),
     }
+    # the daemon's lifetime stage decomposition over the wire (STATS):
+    # not windowed to this run (the daemon may serve other traffic), but
+    # still names the stage a remote p99 excursion lives in
+    try:
+        probe = serve_wire.ServeClient(host, port)
+        stats = probe.stats()
+        probe.close()
+        if stats.get("stages"):
+            report["stages"] = stats["stages"]
+        if stats.get("slo"):
+            report["slo"] = stats["slo"]
+    except (ConnectionError, OSError, serve_wire.WireError):
+        pass
     _journal(report)
     return report
 
@@ -358,6 +380,12 @@ def render_report(report: dict) -> str:
             f"(completed {report['completed']:,}, rejected "
             f"{report.get('rejected', 0):,}, errors "
             f"{report['errors']:,})")
+    stages = report.get("stages")
+    if stages:
+        from ..obs.slo import STAGES
+        parts = [f"{s} {stages[s]['mean_ms']}/{stages[s]['p99_ms']}ms"
+                 for s in STAGES if s in stages]
+        lines.append("  stages (mean/p99): " + "  ".join(parts))
     return "\n".join(lines)
 
 

@@ -44,8 +44,15 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..config.schema import ServingConfig
+from ..obs import slo as slo_mod
 
 CHAOS_SITE = "runtime.serve"
+# the dispatch-path probe (distinct from the load/swap site above so a
+# swap-drill plan never perturbs live scoring): fired once per coalesced
+# batch between dequeue and engine compute — a `delay` action here models
+# a slow host/device and lands in the `dispatch` lifecycle stage, the
+# SLO drill's injection point (docs/ROBUSTNESS.md)
+CHAOS_DISPATCH_SITE = "runtime.serve.dispatch"
 
 
 class ServeOverload(RuntimeError):
@@ -314,7 +321,11 @@ class ScoringDaemon:
         # a plain Lock, not the Condition default RLock: submit() takes it
         # once per request on the hot path and never recursively
         self._cond = threading.Condition(threading.Lock())
-        self._queue: list = []          # [(row, t_arrival, future|None)]
+        # [(row, t_arrival, future|None, t_enqueued, trace_seq)] —
+        # t_enqueued splits sender lag (admission) from queue wait;
+        # trace_seq is the admitted-request ordinal for the sampled
+        # request_trace journal (0 = untraced)
+        self._queue: list = []
         self._running = False
         self._accepting = False
         self._threads: list[threading.Thread] = []
@@ -328,6 +339,13 @@ class ScoringDaemon:
         self._batch_rows = 0
         self._direct_rows = 0
         self._swaps_failed = 0
+        self._admitted = 0              # drives request_trace sampling
+        # SLO engine + the one-shot device-trace bridge (armed by a p99
+        # alert, captured around the next dispatch — trigger="slo")
+        objectives = slo_mod.SloObjectives.from_serving_config(self.config)
+        self._slo = (slo_mod.SloEngine(objectives)
+                     if objectives.enabled() else None)
+        self._trace_trigger = slo_mod.ServeTraceTrigger()
         # per-daemon publish baselines: the obs counters are
         # process-global and cumulative, so a second daemon in one
         # process must add its OWN deltas, not diff against the
@@ -348,6 +366,7 @@ class ScoringDaemon:
         # stats()/serving_report percentiles cover THIS daemon's
         # requests, not a predecessor's in the same process
         self._lat_baseline = self._latency_counts()
+        self._stage_baseline = self.stage_counts()
         for i in range(self.config.workers):
             t = threading.Thread(target=self._worker, daemon=True,
                                  name=f"serve-worker-{i}")
@@ -356,6 +375,11 @@ class ScoringDaemon:
         if self.config.report_every_s > 0:
             t = threading.Thread(target=self._reporter, daemon=True,
                                  name="serve-reporter")
+            t.start()
+            self._threads.append(t)
+        if self._slo is not None:
+            t = threading.Thread(target=self._slo_loop, daemon=True,
+                                 name="serve-slo")
             t.start()
             self._threads.append(t)
         return self
@@ -373,7 +397,7 @@ class ScoringDaemon:
         # anything a timed-out worker left behind fails loudly
         with self._cond:
             leftovers, self._queue = self._queue, []
-        for _row, _t, fut in leftovers:
+        for _row, _t, fut, _te, _ts in leftovers:
             if fut is not None:
                 fut.set_exception(RuntimeError("serving daemon stopped"))
         self._publish_metrics()
@@ -417,7 +441,14 @@ class ScoringDaemon:
                 raise ServeOverload(
                     f"admission queue at limit ({self.config.queue_limit} "
                     "requests) — shed or retry")
-            q.append((row, t, fut))
+            self._admitted += 1
+            sample = self.config.trace_sample
+            trace_seq = (self._admitted
+                         if sample > 0 and self._admitted % sample == 0
+                         else 0)
+            # the enqueue stamp closes the `admission` stage (validation +
+            # lock + append) and opens `queue`; one clock read per request
+            q.append((row, t, fut, time.perf_counter(), trace_seq))
             n = len(q)
             # wake the dispatcher only on the transitions that matter: an
             # idle worker (empty -> 1) or a full batch; every other submit
@@ -426,9 +457,13 @@ class ScoringDaemon:
                 cond.notify()
         return fut
 
-    def score(self, row, timeout: Optional[float] = None) -> np.ndarray:
-        """Synchronous single-request scoring through the batcher."""
-        fut = self.submit(row)
+    def score(self, row, timeout: Optional[float] = None,
+              t_arrival: Optional[float] = None) -> np.ndarray:
+        """Synchronous single-request scoring through the batcher.
+        `t_arrival` extends the lifecycle chain upstream: the wire server
+        passes the frame-read stamp so socket transfer/parse time rides
+        the admission stage instead of vanishing."""
+        fut = self.submit(row, t_arrival=t_arrival)
         return fut.result(timeout=timeout)
 
     def score_batch(self, rows: np.ndarray) -> np.ndarray:
@@ -481,6 +516,10 @@ class ScoringDaemon:
                     cond.wait(0.05)
                 if not self._queue:
                     return  # stopped and drained
+                # the coalesce window opens HERE: requests enqueued before
+                # this stamp were queue-waiting, later arrivals ride the
+                # window — the queue/coalesce split of the lifecycle chain
+                t_window = time.perf_counter()
                 # adaptive window: dispatch when the OLDEST request's
                 # budget expires or the queue reaches max_batch —
                 # queue-depth-driven batch sizing with a deadline floor
@@ -498,58 +537,137 @@ class ScoringDaemon:
                 else:                  # front-deletes per dispatch
                     batch = q[:cfg.max_batch]
                     del q[:cfg.max_batch]
+                t_take = time.perf_counter()
                 if self._queue and self._running:
                     cond.notify()  # another worker can start on the rest
             if batch:
-                self._process(batch)
+                self._process(batch, t_window, t_take)
 
-    def _process(self, batch: list) -> None:
+    def _process(self, batch: list, t_window: float, t_take: float) -> None:
         n = len(batch)
-        rows, arrival_ts, futures = zip(*batch)  # C-level unzip
+        rows, arrival_ts, futures, enq_ts, trace_seqs = zip(*batch)
         x = np.stack(rows) if n > 1 else rows[0][None, :]
         handle = self._registry.acquire(self.model_id)
         err: Optional[Exception] = None
         scores = None
+        padded = n
+        t_exec = t_take
         try:
+            from .. import chaos
             if getattr(handle.scorer, "static_shapes", False):
-                m = bucket_for(n, self._ladder)
-                if m != n:
-                    xp = np.zeros((m, self.num_features), np.float32)
+                padded = bucket_for(n, self._ladder)
+                if padded != n:
+                    xp = np.zeros((padded, self.num_features), np.float32)
                     xp[:n] = x
                     x = xp
-                # n_valid: pad rows must not count as scored traffic
-                scores = handle.scorer.compute_batch(x, n_valid=n)[:n]
+            # the dispatch probe sits between dequeue and compute, so an
+            # injected `delay` inflates exactly the `dispatch` stage — the
+            # SLO drill's slowdown point (docs/ROBUSTNESS.md)
+            chaos.maybe_fail(CHAOS_DISPATCH_SITE, rows=n)
+            t_exec = time.perf_counter()
+            if getattr(handle.scorer, "static_shapes", False):
+                def run(xx=x, nn=n):
+                    # n_valid: pad rows must not count as scored traffic
+                    return handle.scorer.compute_batch(xx, n_valid=nn)[:nn]
             else:
-                scores = handle.scorer.compute_batch(x)
+                def run(xx=x):
+                    return handle.scorer.compute_batch(xx)
+            if self._trace_trigger.armed:
+                # a p99 slo_alert armed the one-shot: this dispatch runs
+                # under a profiler window, journaled as device_profile
+                # trigger="slo" (obs/slo.ServeTraceTrigger)
+                scores = self._trace_trigger.capture(run)
+            else:
+                scores = run()
         except Exception as e:  # noqa: BLE001 — must resolve every future
             err = e
         finally:
             self._registry.release(handle)
         t_done = time.perf_counter()
+        arrivals = np.asarray(arrival_ts, np.float64)
         if err is not None:
             for fut in futures:
                 if fut is not None:
                     fut.set_exception(err)
             with self._cond:
                 self._errors += n
+            self._journal_traces(trace_seqs, arrivals, np.asarray(
+                enq_ts, np.float64), t_window, t_take, t_exec, t_done,
+                t_done, n, padded, handle,
+                error=f"{type(err).__name__}: {err}"[:200])
             return
-        arrivals = np.asarray(arrival_ts, np.float64)
         if any(f is not None for f in futures):
             for fut, s in zip(futures, scores):
                 if fut is not None:
                     fut.set_result(s)
-        latencies = t_done - arrivals
+        # e2e is charged through the reply: the response is DELIVERED
+        # (futures resolved), not merely computed — so the lifecycle
+        # stages sum exactly to the latency the histogram records
+        t_reply = time.perf_counter()
+        enqs = np.asarray(enq_ts, np.float64)
+        latencies = t_reply - arrivals
         from ..export.scorer import observe_request_latencies
         observe_request_latencies("serve", latencies)
+        # per-stage histograms (always-on): admission/queue/coalesce vary
+        # per request, dispatch/device/reply are batch-shared scalars
+        admission = np.clip(enqs - arrivals, 0.0, None)
+        queue = np.clip(t_window - enqs, 0.0, None)
+        coalesce = np.clip(t_take - np.maximum(enqs, t_window), 0.0, None)
+        dispatch_s = max(t_exec - t_take, 0.0)
+        device_s = max(t_done - t_exec, 0.0)
+        reply_s = max(t_reply - t_done, 0.0)
+        try:
+            slo_mod.observe_stage_seconds(
+                {"admission": admission, "queue": queue,
+                 "coalesce": coalesce, "dispatch": dispatch_s,
+                 "device": device_s, "reply": reply_s}, n)
+        except Exception:
+            pass  # telemetry must never fail the dispatch it measures
         with self._cond:
             self._requests += n
             self._batches += 1
             self._batch_rows += n
+        if any(trace_seqs):
+            self._journal_traces(trace_seqs, arrivals, enqs, t_window,
+                                 t_take, t_exec, t_done, t_reply, n,
+                                 padded, handle)
         if self._on_batch is not None:
             try:
                 self._on_batch(scores, arrivals, t_done)
             except Exception:
                 pass  # a driver's bookkeeping bug must not kill dispatch
+
+    def _journal_traces(self, trace_seqs, arrivals, enqs, t_window, t_take,
+                        t_exec, t_done, t_reply, n: int, padded: int,
+                        handle, error: Optional[str] = None) -> None:
+        """Journal one `request_trace` event per sampled request of this
+        batch: the full stage decomposition in ms, summing exactly to
+        e2e_ms (shared stamps — no gap, no overlap is possible)."""
+        from .. import obs
+
+        for i, seq in enumerate(trace_seqs):
+            if not seq:
+                continue
+            t_arr = float(arrivals[i])
+            t_enq = float(enqs[i])
+            fields = {
+                "seq": int(seq),
+                "admission_ms": round(max(t_enq - t_arr, 0.0) * 1e3, 4),
+                "queue_ms": round(max(t_window - t_enq, 0.0) * 1e3, 4),
+                "coalesce_ms": round(
+                    max(t_take - max(t_enq, t_window), 0.0) * 1e3, 4),
+                "dispatch_ms": round(max(t_exec - t_take, 0.0) * 1e3, 4),
+                "device_ms": round(max(t_done - t_exec, 0.0) * 1e3, 4),
+                "reply_ms": round(max(t_reply - t_done, 0.0) * 1e3, 4),
+                "e2e_ms": round(max(t_reply - t_arr, 0.0) * 1e3, 4),
+                "batch": n,
+                "padded": padded,
+                "engine": handle.engine_name,
+                "model_version": handle.version,
+            }
+            if error is not None:
+                fields["error"] = error
+            obs.event("request_trace", **fields)
 
     # -- telemetry -----------------------------------------------------
 
@@ -571,6 +689,39 @@ class ScoringDaemon:
         hist = obs.histogram("score_latency_seconds",
                              buckets=SCORE_LATENCY_BUCKETS)
         return hist.counts(engine="serve")
+
+    def stage_counts(self) -> dict:
+        """Per-stage snapshots of the process-global `serve_stage_seconds`
+        histogram: {stage: (counts, sum, n) | None} — callers window a
+        run (tools/loadtest.py) or the daemon lifetime (stats()) by
+        differencing two snapshots."""
+        from .. import obs
+        from ..export.scorer import SCORE_LATENCY_BUCKETS
+
+        hist = obs.histogram(slo_mod.STAGE_HISTOGRAM,
+                             buckets=SCORE_LATENCY_BUCKETS)
+        return {s: hist.counts(stage=s) for s in slo_mod.STAGES}
+
+    @staticmethod
+    def stage_window(baseline: dict, current: dict) -> dict:
+        """{stage: {"mean_ms", "p99_ms", "count", "share"}} between two
+        stage_counts() snapshots — the decomposition loadtest reports
+        and `shifu-tpu top` renders (one shape: slo.stage_stats)."""
+        from ..export.scorer import SCORE_LATENCY_BUCKETS
+
+        per_stage: dict = {}
+        for stage in slo_mod.STAGES:
+            cur = current.get(stage)
+            if cur is None:
+                continue
+            counts, total, n = cur
+            base = (baseline or {}).get(stage)
+            if base is not None:
+                counts = [c - b for c, b in zip(counts, base[0])]
+                total -= base[1]
+                n -= base[2]
+            per_stage[stage] = (SCORE_LATENCY_BUCKETS, counts, total, n)
+        return slo_mod.stage_stats(per_stage)
 
     def _latency_quantiles(self) -> tuple:
         """(p50, p99) over THIS daemon's requests: the shared
@@ -614,6 +765,21 @@ class ScoringDaemon:
             "latency_budget_ms": self.config.latency_budget_ms,
             "max_batch": self.config.max_batch,
         })
+        # lifecycle stage decomposition over this daemon's lifetime
+        # (histogram-windowed p99 + exact means) — the STATS answer a
+        # socket loadtest and `shifu-tpu top` read
+        try:
+            stages = self.stage_window(
+                getattr(self, "_stage_baseline", None) or {},
+                self.stage_counts())
+            if stages:
+                snap["stages"] = stages
+        except Exception:
+            pass
+        if self._slo is not None:
+            snap["slo"] = self._slo.state()
+        if self.config.trace_sample:
+            snap["trace_sample"] = self.config.trace_sample
         return snap
 
     def _publish_metrics(self) -> None:
@@ -640,6 +806,71 @@ class ScoringDaemon:
             if delta > 0:
                 obs.counter(name, help_).inc(delta)
                 self._published[key] = snap[key]
+
+    def _windowed_latency_counts(self) -> Optional[list]:
+        """This daemon's per-bucket latency counts (process-global series
+        minus the start() baseline) — the SLO engine's p99 feed."""
+        cur = self._latency_counts()
+        if cur is None:
+            return None
+        counts = list(cur[0])
+        base = getattr(self, "_lat_baseline", None)
+        if base is not None:
+            counts = [c - b for c, b in zip(counts, base[0])]
+        return counts
+
+    def _slo_loop(self) -> None:
+        """The SLO evaluation tick: feed cumulative counters into the
+        engine and journal every alert transition.  Tick = fast_window/5
+        (50ms floor, 1s cap) so a violation fires within ~one fast
+        window; a firing p99 alert arms the one-shot device trace."""
+        from .. import obs
+
+        eng = self._slo
+        tick = max(0.05, min(1.0, eng.obj.fast_window_s / 5.0))
+        while True:
+            t_next = time.monotonic() + tick
+            while time.monotonic() < t_next:
+                if not self._running:
+                    return
+                time.sleep(min(0.05, tick))
+            now = time.monotonic()
+            snap = self._snapshot()
+            try:
+                eng.observe(now, requests=snap["requests"],
+                            rejected=snap["rejected"],
+                            errors=snap["errors"],
+                            latency_counts=self._windowed_latency_counts())
+                events = eng.evaluate(now)
+            except Exception:
+                continue  # the SLO plane must never kill serving
+            for burn_obj, b in eng.state().get("burns", {}).items():
+                obs.gauge("slo_burn_rate",
+                          "burn rate of each serving SLO objective over "
+                          "the fast window").set(b["burn_fast"],
+                                                 objective=burn_obj)
+            for ev in events:
+                obs.counter(
+                    "slo_alerts_total",
+                    "serving SLO alert transitions journaled").inc(
+                        objective=ev["objective"], state=ev["state"])
+                obs.event("slo_alert", model=self.model_id, **ev)
+                if (ev["state"] == "firing"
+                        and ev["objective"] == slo_mod.OBJ_P99):
+                    # latency excursion -> kernel-level attribution: the
+                    # next dispatch runs under a one-shot trace window
+                    # (host-side engines journal the empty attribution
+                    # without paying a profiler window — slo.HOST_ENGINES)
+                    handle = self._registry.current(self.model_id)
+                    self._trace_trigger.arm(
+                        objective=ev["objective"],
+                        observed_p99_ms=ev.get("observed_p99_ms"),
+                        engine=handle.engine_name if handle else None)
+            if events:
+                try:
+                    obs.flush()
+                except Exception:
+                    pass
 
     def _reporter(self) -> None:
         last = self._snapshot()

@@ -227,13 +227,17 @@ class ServeServer:
                     except OSError:
                         pass
                     return  # framing lost — drop the connection
+                # arrival stamps at frame receipt: decode + admission ride
+                # the request's `admission` lifecycle stage (obs/slo.py)
+                # instead of vanishing between socket and daemon
+                t_arrival = time.perf_counter()
                 try:
-                    self._handle(conn, *frame)
+                    self._handle(conn, t_arrival, *frame)
                 except (ConnectionError, OSError):
                     return
 
-    def _handle(self, conn, op, dtype, n_rows, n_cols, scale, offset,
-                payload) -> None:
+    def _handle(self, conn, t_arrival, op, dtype, n_rows, n_cols, scale,
+                offset, payload) -> None:
         daemon = self.daemon
         if op == OP_PING:
             write_response(conn, 0)
@@ -264,7 +268,8 @@ class ServeServer:
             rows = decode_rows(payload, dtype, n_rows, n_cols, scale,
                                offset)
             if n_rows == 1:
-                scores = daemon.score(rows[0], timeout=self._timeout)
+                scores = daemon.score(rows[0], timeout=self._timeout,
+                                      t_arrival=t_arrival)
                 scores = np.asarray(scores)[None, :]
             else:
                 scores = daemon.score_batch(rows)

@@ -122,6 +122,17 @@ KEY_SERVING_WORKERS = "shifu.serving.workers"
 KEY_SERVING_REPORT_EVERY_S = "shifu.serving.report-every-s"
 KEY_SERVING_PORT = "shifu.serving.port"
 KEY_SERVING_HOST = "shifu.serving.host"
+# serving SLO engine (obs/slo.py, docs/OBSERVABILITY.md "Serving SLO
+# engine"): request_trace sampling stride (1-in-N, 0 off), the three
+# objectives (p99 ms / error-rate fraction / availability fraction, 0
+# disables each), and the multiwindow burn-rate knobs
+KEY_SERVING_TRACE_SAMPLE = "shifu.serving.trace-sample"
+KEY_SERVING_SLO_P99_MS = "shifu.serving.slo.p99-ms"
+KEY_SERVING_SLO_ERROR_RATE = "shifu.serving.slo.error-rate"
+KEY_SERVING_SLO_AVAILABILITY = "shifu.serving.slo.availability"
+KEY_SERVING_SLO_FAST_WINDOW_S = "shifu.serving.slo.fast-window-s"
+KEY_SERVING_SLO_SLOW_WINDOW_S = "shifu.serving.slo.slow-window-s"
+KEY_SERVING_SLO_BURN_THRESHOLD = "shifu.serving.slo.burn-threshold"
 
 
 def parse_sharding_rules(value: str) -> tuple:
@@ -231,6 +242,21 @@ def serving_config_from_conf(conf: Mapping[str, str], base: Any = None) -> Any:
         kw["port"] = int(conf[KEY_SERVING_PORT])
     if KEY_SERVING_HOST in conf:
         kw["host"] = conf[KEY_SERVING_HOST].strip()
+    if KEY_SERVING_TRACE_SAMPLE in conf:
+        kw["trace_sample"] = int(conf[KEY_SERVING_TRACE_SAMPLE])
+    if KEY_SERVING_SLO_P99_MS in conf:
+        kw["slo_p99_ms"] = float(conf[KEY_SERVING_SLO_P99_MS])
+    if KEY_SERVING_SLO_ERROR_RATE in conf:
+        kw["slo_error_rate"] = float(conf[KEY_SERVING_SLO_ERROR_RATE])
+    if KEY_SERVING_SLO_AVAILABILITY in conf:
+        kw["slo_availability"] = float(conf[KEY_SERVING_SLO_AVAILABILITY])
+    if KEY_SERVING_SLO_FAST_WINDOW_S in conf:
+        kw["slo_fast_window_s"] = float(conf[KEY_SERVING_SLO_FAST_WINDOW_S])
+    if KEY_SERVING_SLO_SLOW_WINDOW_S in conf:
+        kw["slo_slow_window_s"] = float(conf[KEY_SERVING_SLO_SLOW_WINDOW_S])
+    if KEY_SERVING_SLO_BURN_THRESHOLD in conf:
+        kw["slo_burn_threshold"] = float(
+            conf[KEY_SERVING_SLO_BURN_THRESHOLD])
     return dataclasses.replace(base, **kw) if kw else base
 
 

@@ -308,14 +308,16 @@ def test_status_quick_summary_carries_goodput(tmp_path, monkeypatch):
 
 
 def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7,
-              cold=300.0, hbm=1 << 30, serving=250_000.0):
+              cold=300.0, hbm=1 << 30, serving=250_000.0,
+              serving_p99=6.0):
     return {"value": value, "unit": "samples/sec/chip",
             "goodput": {"goodput_fraction_mean": goodput_frac},
             "xla_compiles": {"total": compiles},
             "e2e_cached_disk_fraction_of_ceiling": ceiling,
             "e2e_cold_disk_samples_per_sec_per_chip": cold,
             "device_hbm_peak_bytes": hbm,
-            "serving_scores_per_sec": serving}
+            "serving_scores_per_sec": serving,
+            "serving_p99_ms": serving_p99}
 
 
 @pytest.mark.perf
@@ -382,14 +384,21 @@ def test_perf_gate_fails_each_axis():
     # ...a within-noise serving dip passes
     r = perf_gate.run_gate(_artifact(serving=120_000.0), base)
     assert r["verdict"] == "PASS"
+    # serving p99 explosion (above the 3x --p99-factor default): a
+    # tail-latency regression even when capacity holds (ISSUE 8)
+    r = perf_gate.run_gate(_artifact(serving_p99=30.0), base)
+    assert r["verdict"] == "REGRESSION"
+    assert [c for c in r["checks"]
+            if c["name"] == "serving_p99_ms"][0]["status"] == "REGRESSION"
+    # ...shared-host p99 wobble inside the factor passes
+    r = perf_gate.run_gate(_artifact(serving_p99=12.0), base)
+    assert r["verdict"] == "PASS"
     # missing fields on either side SKIP, never fail — an artifact that
     # predates the device flight recorder (no device_hbm_peak_bytes)
     # still gates the axes it carries
     r = perf_gate.run_gate({"value": 100.0}, base)
     assert r["verdict"] == "PASS"
-    assert [c["status"] for c in r["checks"]] == ["OK", "SKIP", "SKIP",
-                                                  "SKIP", "SKIP", "SKIP",
-                                                  "SKIP"]
+    assert [c["status"] for c in r["checks"]] == ["OK"] + ["SKIP"] * 7
 
 
 @pytest.mark.perf
@@ -428,7 +437,8 @@ def test_perf_gate_cli_pass_fail_and_check_only(tmp_path):
     fresh_bad = tmp_path / "fresh_bad.json"
     fresh_bad.write_text(json.dumps(
         _artifact(value=10.0, goodput_frac=0.1, compiles=100, ceiling=0.1,
-                  cold=10.0, hbm=8 << 30, serving=10_000.0)))
+                  cold=10.0, hbm=8 << 30, serving=10_000.0,
+                  serving_p99=90.0)))
 
     def run(*args):
         return subprocess.run([sys.executable, gate, *args],

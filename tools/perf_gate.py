@@ -3,7 +3,7 @@ BENCH baseline and exit nonzero on regression.
 
 The repo's first *enforceable* perf trajectory (ISSUE 3): every round the
 driver captures a `BENCH_r*.json`; this gate compares a freshly produced
-`bench_full.json` against the newest of those baselines on seven axes —
+`bench_full.json` against the newest of those baselines on eight axes —
 
 - **throughput / step time**: the headline resident-tier
   samples/sec/chip (`value`) must not fall below
@@ -41,6 +41,13 @@ driver captures a `BENCH_r*.json`; this gate compares a freshly produced
   (ratio, default 0.3) of the baseline: the guard on the
   micro-batching serving plane (a re-serialized dispatch loop, a lost
   batcher, a per-request lock would all collapse it).
+- **serving p99 latency**: `serving_p99_ms` (the capacity run's exact
+  open-loop p99, ISSUE 8) must not exceed `baseline * --p99-factor`
+  (default 3.0) — the latency axis of the serving SLO: throughput can
+  survive a change that silently triples tail latency (a lost stage
+  overlap, a blocking journal write on the dispatch path), and p99 is
+  the serving figure of merit (arxiv 2605.25645).  Wide factor on
+  purpose: shared-host p99s swing with co-tenant load.
 
 Checks whose fields are missing on either side are SKIPPED (pre-ledger
 baselines carry no goodput/compile fields; pre-flight-recorder ones no
@@ -134,7 +141,8 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
              e2e_ceiling_drop: float = 0.2,
              cold_drop: float = 0.3,
              hbm_factor: float = 1.5,
-             serving_drop: float = 0.3) -> dict:
+             serving_drop: float = 0.3,
+             p99_factor: float = 3.0) -> dict:
     """The comparison itself (pure — unit-tested on synthetic pairs).
     Returns {"checks": [...], "verdict": "PASS"|"REGRESSION"}."""
     checks: list[dict] = []
@@ -225,6 +233,19 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
         check("serving_scores_per_sec", fsv, bsv, fsv >= limit,
               round(limit, 1))
 
+    # serving p99: the latency leg of the serving SLO (ISSUE 8).  Upper
+    # bound, factor-style: a p99 tripling is a tail-latency regression
+    # even when capacity holds (the stage histograms in the serving
+    # telemetry say WHICH stage ate it).  SKIP when either side predates
+    # the field or recorded a null p99 (capacity below the start rate).
+    fp = _num(fresh, "serving_p99_ms")
+    bp = _num(baseline, "serving_p99_ms")
+    if fp is None or bp is None or bp <= 0:
+        check("serving_p99_ms", fp, bp, None, None)
+    else:
+        limit = bp * p99_factor
+        check("serving_p99_ms", fp, bp, fp <= limit, round(limit, 2))
+
     regressed = [c for c in checks if c["status"] == "REGRESSION"]
     return {"checks": checks,
             "verdict": "REGRESSION" if regressed else "PASS"}
@@ -277,6 +298,10 @@ def main(argv=None) -> int:
                         "* this fraction (the scoring daemon's loadtest "
                         "capacity, ISSUE 7; SKIP when either side lacks "
                         "the field)")
+    p.add_argument("--p99-factor", type=float, default=3.0,
+                   help="fresh serving_p99_ms must be <= baseline * this "
+                        "factor (the serving SLO's latency axis, ISSUE 8; "
+                        "SKIP when either side lacks the field)")
     p.add_argument("--check-only", action="store_true",
                    help="tier-1 mode: missing/corrupt artifacts degrade to "
                         "a journaled warning and exit 0")
@@ -319,7 +344,8 @@ def main(argv=None) -> int:
                       e2e_ceiling_drop=args.e2e_ceiling_drop,
                       cold_drop=args.cold_drop,
                       hbm_factor=args.hbm_factor,
-                      serving_drop=args.serving_drop)
+                      serving_drop=args.serving_drop,
+                      p99_factor=args.p99_factor)
     report["fresh"] = args.fresh
     report["baseline"] = baseline_path
     _journal("perf_gate", verdict=report["verdict"],
