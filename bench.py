@@ -425,6 +425,59 @@ def _sparse_embed_ab(mesh, n_chips: int) -> dict:
     return out
 
 
+def _tiered_10m_rung(n_chips: int) -> dict:
+    """10M-vocab tiered-placement rung (ISSUE 10): the vocab no single
+    host (or the CPU tunnel) wants fully resident.  Builds an int8 cold
+    tier + hot HBM-candidate set (shifu_tpu/embed/tiering.TieredTable)
+    and measures the HOST plane — tiered lookup rows/s and the hot-tier
+    hit rate under zipf-skewed traffic (the id distribution tabular CTR
+    vocabs actually see).  Device work is deliberately absent: the
+    tier's job is to keep the cold tail OFF the step critical path, so
+    its figure of merit is the host fetch rate the feeder's prefetch
+    must hide.  Build memory stays bounded (streamed ~64 MB slices) —
+    the rung completing at all IS the capacity claim."""
+    if _past_deadline(0.6):
+        return {"ladder_embed_10mvocab_skipped": "soft deadline"}
+    import shutil
+    import tempfile
+
+    from shifu_tpu.embed import TieredTable
+
+    out = {}
+    v, d, nc, bs, steps = 10_000_000, 16, 1, 4096, 24
+    tmp = tempfile.mkdtemp(prefix="shifu_embed_10m_")
+    try:
+        # zeros page lazily; the cold store's I/O cost is content-blind
+        table = np.zeros((nc, v, d), np.float32)
+        t0 = time.perf_counter()
+        tiered = TieredTable.build(table, tmp, hot_rows=1 << 18,
+                                   tier_dtype="int8")
+        del table
+        out["ladder_embed_10mvocab_build_s"] = round(
+            time.perf_counter() - t0, 2)
+        rng = np.random.default_rng(11)
+        # zipf(1.1) truncated into the vocab: heavy head, 10M-long tail
+        ids = ((rng.zipf(1.1, size=(steps, bs, nc)) - 1) % v).astype(
+            np.int32)
+        tiered.lookup(ids[0])  # warm (page cache + prefetch dict)
+        t0 = time.perf_counter()
+        for s in range(1, steps):
+            tiered.lookup(ids[s])
+        dt = max(time.perf_counter() - t0, 1e-9)
+        rep = tiered.tier_report()
+        out["ladder_embed_10mvocab_rows_per_sec"] = round(
+            (steps - 1) * bs * nc / dt, 1)
+        out["ladder_embed_10mvocab_hit_rate"] = rep["hit_rate"]
+        out["ladder_embed_10mvocab_cold_mb"] = round(
+            rep["cold_bytes"] / 2**20, 2)
+        out["ladder_embed_10mvocab_cold_s"] = round(rep["cold_seconds"], 3)
+    except Exception as e:
+        out["ladder_embed_10mvocab_error"] = str(e)[:160]
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def _ladder_extras(mesh, n_chips: int, peak_tflops, peak_hbm=None) -> dict:
     """Device-resident train throughput + analytic MFU for BASELINE ladder
     rungs 2-5 (Wide&Deep, DeepFM w/ embeddings, multi-task, MoE,
@@ -475,6 +528,7 @@ def _ladder_extras(mesh, n_chips: int, peak_tflops, peak_hbm=None) -> dict:
     ]
     out = {}
     out.update(_sparse_embed_ab(mesh, n_chips))
+    out.update(_tiered_10m_rung(n_chips))
     rng = np.random.default_rng(7)
     for name, spec, bs, nb, n_feat, n_cat, vocab in rungs:
       try:
@@ -1398,6 +1452,8 @@ _HEADLINE_OPTIONAL = (
     "ladder_deepfm_100kvocab_hbm_roofline_fraction",
     "ladder_deepfm_4mvocab_samples_per_sec_per_chip",
     "ladder_deepfm_4mvocab_sparse_speedup",
+    "ladder_embed_10mvocab_rows_per_sec",
+    "ladder_embed_10mvocab_hit_rate",
     "ladder_wide_deep_1000col_samples_per_sec_per_chip",
     "ladder_wide_deep_1000col_hbm_roofline_fraction",
     "ladder_ft_transformer_samples_per_sec_per_chip",

@@ -517,6 +517,62 @@ class ObsConfig:
 
 
 # ---------------------------------------------------------------------------
+# Sparse embedding engine (shifu_tpu/embed/ — docs/EMBEDDING.md)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class EmbedConfig:
+    """Sparse embedding engine knobs (docs/EMBEDDING.md).  Rides on top of
+    train.sparse_embedding_update: dedup and sharding shape HOW the
+    rows-touched update runs; tiering governs where 10M+-vocab tables
+    live (hot rows in HBM, cold tail on a host memmap)."""
+
+    # per-batch unique-id compaction in the feeder placement stage:
+    # "auto" (default — engages whenever a sparse plan engages), "off".
+    # Ships (unique_ids, inverse) over H2D alongside features, so the
+    # update touches each row once; exact under duplicates by
+    # construction (tests/test_embed_engine.py pins bit-identity).
+    dedup: str = "auto"
+    # frequency-tiered table placement: "off" (default — the whole table
+    # is device-resident) or "host" (cold tail served from a host
+    # memmap; see embed/tiering.py).  Training-step residency swap is
+    # future work (ROADMAP); "host" today serves bench/feeder lookups.
+    tiering: str = "off"
+    # cold-tier storage dtype: "float32" (exact) or "int8" (4x smaller,
+    # rides the cache-v2 wire quantization grid — lossy, bench-only).
+    tier_dtype: str = "float32"
+    # hot-tier size: explicit row count, or 0 to derive from
+    # hot_fraction of the vocab.
+    hot_rows: int = 0
+    hot_fraction: float = 0.05
+    # where the cold-tier memmap + manifest land ("" = beside the job's
+    # cache dir; bench passes a tempdir).
+    cold_dir: str = ""
+    # overlap next-batch cold-row fetches with the device step
+    # (feeder-style background thread).
+    prefetch: bool = True
+
+    def validate(self) -> None:
+        if self.dedup not in ("auto", "off"):
+            raise ConfigError(
+                f"embed.dedup must be auto|off: {self.dedup!r}")
+        if self.tiering not in ("off", "host"):
+            raise ConfigError(
+                f"embed.tiering must be off|host: {self.tiering!r}")
+        if self.tier_dtype not in ("float32", "int8"):
+            raise ConfigError(
+                f"embed.tier_dtype must be float32|int8: "
+                f"{self.tier_dtype!r}")
+        if self.hot_rows < 0:
+            raise ConfigError(f"embed.hot_rows must be >= 0: "
+                              f"{self.hot_rows}")
+        if not (0.0 < self.hot_fraction <= 1.0):
+            raise ConfigError(
+                f"embed.hot_fraction must be in (0, 1]: "
+                f"{self.hot_fraction}")
+
+
+# ---------------------------------------------------------------------------
 # Serving plane (runtime/serve.py — docs/SERVING.md)
 # ---------------------------------------------------------------------------
 
@@ -748,6 +804,7 @@ class JobConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    embed: EmbedConfig = field(default_factory=EmbedConfig)
 
     def validate(self) -> "JobConfig":
         self.schema.validate()
@@ -756,6 +813,7 @@ class JobConfig:
         self.train.validate()
         self.runtime.mesh.validate()
         self.obs.validate()
+        self.embed.validate()
         if self.train.bagging_sample_rate < 1.0 and self.data.out_of_core:
             # subsampling fancy-indexes the dataset, which would materialize
             # memmap-backed out-of-core shards into RAM

@@ -1,0 +1,26 @@
+"""Sparse embedding engine (docs/EMBEDDING.md).
+
+Four cooperating legs replace "gather + dense-or-scatter optimizer" for
+large-vocabulary tables:
+
+- kernels: fused rows-touched update + scalar-prefetch lookup
+  (ops/pallas_embedding — kept there with the other hot-op kernels);
+- dedup:   per-batch unique-id compaction in the feeder (`dedup`);
+- shard:   vocab-sharded tables, shard-local updates (`shard`);
+- tiering: hot rows resident, cold tail on a host memmap (`tiering`).
+
+train/sparse_embed.py is the policy layer that wires these into the step;
+this package holds the mechanisms.
+"""
+
+from .dedup import (INVERSE_KEY, UNIQUE_KEY, attach_dedup, dedup_ids,
+                    dedup_lookup, host_ids)
+from .shard import (VOCAB_SHARD_RULES, assert_vocab_sharded,
+                    make_sharded_rows_update)
+from .tiering import TieredTable
+
+__all__ = [
+    "INVERSE_KEY", "UNIQUE_KEY", "attach_dedup", "dedup_ids",
+    "dedup_lookup", "host_ids", "VOCAB_SHARD_RULES",
+    "assert_vocab_sharded", "make_sharded_rows_update", "TieredTable",
+]

@@ -1,0 +1,147 @@
+"""Per-batch unique-id compaction (the sparse embedding engine's dedup leg).
+
+Tabular batches are duplicate-heavy — a 4k-row batch over a zipf-skewed
+vocab touches far fewer distinct rows than it has cells — yet the raw-id
+update path gathers/scatters one row per CELL.  This module compacts each
+host batch to its per-field unique-id set in the feeder placement stage
+(`attach_dedup` composes in front of the wire cast, so it runs inside the
+producer thread, off the step critical path) and ships
+`(embed_unique, embed_inverse)` over H2D alongside the batch.  The update
+then touches each distinct row exactly ONCE — which is also what licenses
+the fused Pallas rows-update kernel, whose DMA write-back has no
+deterministic duplicate resolution (ops/pallas_embedding contract).
+
+Exactness: the backward already SUMS duplicate rows' gradients
+(segment-sum / one-hot matmul), so the dense (Nc, V, D) grad row for id i
+equals the sum over every cell holding i; applying it once at i is
+bit-identical to the raw path's `.at[].set` writing the same value once
+per duplicate (tests/test_embed_engine.py pins bit-identity).
+
+Shapes stay static across batches: the unique array is padded with the
+SENTINEL id `vocab` (one past the last row) to a fixed capacity (the batch
+size), so jit never recompiles on the per-batch unique count — sentinel
+rows gather-clamp garbage and their scatter DROPS, on both the XLA
+reference and the kernel's `pl.when` skip.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+# batch keys the feeder attaches (train/step.make_apply_gradients consumes
+# embed_unique; embed_inverse rides along for lookup-side dedup consumers)
+UNIQUE_KEY = "embed_unique"
+INVERSE_KEY = "embed_inverse"
+
+
+def host_ids(features: np.ndarray, layout) -> np.ndarray:
+    """(B, F) float feature matrix -> (B, Nc) clipped int32 ids, replicating
+    models/embedding.split_features EXACTLY (cast then per-field clip into
+    [0, vocab)) so the dedup'd touched-row set equals the forward's."""
+    raw = features[:, np.asarray(layout.categorical_positions, np.int64)]
+    ids = raw.astype(np.int32)
+    vocab = np.asarray(layout.vocab_sizes, np.int32)
+    return np.clip(ids, 0, vocab - 1)
+
+
+def dedup_ids(ids: np.ndarray, sentinel: int,
+              capacity: Optional[int] = None
+              ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-field unique compaction of a (B, Nc) id batch.
+
+    Returns (unique (capacity, Nc) int32 — tail padded with `sentinel`,
+    inverse (B, Nc) int32 — ids[b, f] == unique[inverse[b, f], f],
+    counts (Nc,) int64 — distinct ids per field).  capacity defaults to B
+    (np.unique can never exceed it), keeping device shapes static.
+    """
+    b, nc = ids.shape
+    if capacity is None:
+        capacity = b
+    unique = np.full((capacity, nc), sentinel, np.int32)
+    inverse = np.empty((b, nc), np.int32)
+    counts = np.empty((nc,), np.int64)
+    for f in range(nc):
+        u, inv = np.unique(ids[:, f], return_inverse=True)
+        if u.size > capacity:
+            raise ValueError(
+                f"dedup capacity {capacity} < {u.size} distinct ids "
+                f"(field {f})")
+        unique[:u.size, f] = u
+        inverse[:, f] = inv
+        counts[f] = u.size
+    return unique, inverse, counts
+
+
+def attach_dedup(layout, sentinel: int, *,
+                 report_every: int = 256,
+                 tiered=None) -> Callable[[dict], dict]:
+    """Host-side batch transform for the feeder placement stage: adds
+    UNIQUE_KEY/INVERSE_KEY to each batch dict (leaves batches without a
+    'features' matrix untouched).  Composes IN FRONT of the wire cast —
+    dedup reads the decoded f32 features (categorical jobs always ride the
+    f32 wire).  Emits an `embed_dedup_report` journal event every
+    `report_every` batches (mean rows touched vs raw cells — the number
+    the update-path win scales with).  When a TieredTable is supplied its
+    next-batch cold-row prefetch is kicked here, overlapping the host
+    fetch with the device step."""
+    state = {"batches": 0, "unique": 0, "cells": 0}
+
+    def transform(batch: dict) -> dict:
+        feats = batch.get("features")
+        if feats is None or getattr(feats, "ndim", 0) != 2:
+            return batch
+        ids = host_ids(np.asarray(feats), layout)
+        unique, inverse, counts = dedup_ids(ids, sentinel)
+        if tiered is not None:
+            tiered.prefetch(unique)
+        out = dict(batch)
+        out[UNIQUE_KEY] = unique
+        out[INVERSE_KEY] = inverse
+        state["batches"] += 1
+        state["unique"] += int(counts.sum())
+        state["cells"] += int(ids.size)
+        if state["batches"] % report_every == 0:
+            _report(state)
+        return out
+
+    def finalize() -> None:
+        """Flush the tail report: a run shorter than `report_every` batches
+        (most CLI jobs' last partial window) would otherwise journal no
+        `embed_dedup_report` at all — the train loop calls this at teardown."""
+        if state["batches"] and state["batches"] % report_every != 0:
+            _report(state)
+
+    transform.dedup_state = state  # introspectable for tests/loop teardown
+    transform.finalize = finalize
+    return transform
+
+
+def _report(state: dict) -> None:
+    from .. import obs
+    cells = max(state["cells"], 1)
+    obs.event("embed_dedup_report",
+              batches=state["batches"],
+              rows_touched=state["unique"],
+              raw_cells=state["cells"],
+              dedup_ratio=round(state["unique"] / cells, 4))
+    obs.gauge("embed_dedup_ratio",
+              "touched unique rows / raw id cells (lower = more "
+              "duplicate-heavy batches, bigger sparse-update win)"
+              ).set(state["unique"] / cells)
+
+
+def dedup_lookup(table, unique, inverse, use_pallas: Optional[bool] = None):
+    """Device-side lookup through the compacted ids: gather the unique rows
+    once, then expand back to (B, Nc, D) with the inverse map.  Forward is
+    bit-identical to the raw-id gather (same rows, same values); the
+    backward reassociates the duplicate-row gradient sum (take_along_axis'
+    scatter-add vs segment-sum order), so grads match to float tolerance,
+    not bitwise — tests pin both."""
+    import jax.numpy as jnp
+
+    from ..ops.pallas_embedding import embedding_lookup
+
+    rows = embedding_lookup(table, unique, use_pallas)       # (U, Nc, D)
+    return jnp.take_along_axis(rows, inverse[:, :, None], axis=0)

@@ -65,7 +65,10 @@ def split_features(features: jax.Array, layout: FieldLayout
     """(B, F) float -> (numeric (B, Nn) float, categorical ids (B, Nc) int32).
 
     Ids clip into [0, vocab): out-of-range/unseen ids land in the last bucket,
-    matching Shifu's unseen-category bin behavior."""
+    matching Shifu's unseen-category bin behavior.  embed/dedup.host_ids is
+    the host-side (numpy) replica of this extraction — the feeder's
+    unique-id compaction must yield EXACTLY the forward's touched-row set,
+    so any change here must land there too."""
     num = features[:, jnp.array(layout.numeric_positions, dtype=jnp.int32)] \
         if layout.num_numeric else jnp.zeros((features.shape[0], 0), features.dtype)
     if layout.num_categorical:

@@ -270,6 +270,10 @@ def profile_summary(path: str) -> Optional[dict]:
     hbm_last: Optional[dict] = None
     anomalies = 0
     trace_fallbacks = 0
+    tier_last: Optional[dict] = None
+    tier_reports = 0
+    dedup_last: Optional[dict] = None
+    offload_fallbacks = 0
     recovery = {"restore_s": 0.0, "restores": 0, "fallbacks": 0,
                 "cache_fallbacks": 0, "preemption_graces": 0, "resumes": 0}
     for rec in events:
@@ -336,6 +340,13 @@ def profile_summary(path: str) -> Optional[dict]:
             anomalies += 1
         elif kind == "trace_fallback":
             trace_fallbacks += 1
+        elif kind == "embed_tier_report":
+            tier_last = rec
+            tier_reports += 1
+        elif kind == "embed_dedup_report":
+            dedup_last = rec
+        elif kind == "embed_offload_fallback":
+            offload_fallbacks += 1
 
     totals: dict[str, float] = {}
     fracs, mfus = [], []
@@ -399,6 +410,24 @@ def profile_summary(path: str) -> Optional[dict]:
     if trace_fallbacks:
         device["trace_fallbacks"] = trace_fallbacks
     out["device"] = device or None
+    # sparse embedding engine rollup (docs/EMBEDDING.md): the last tier
+    # report (hot/cold traffic split), the last dedup report (rows
+    # touched vs raw id cells), and how many cold reads hit the
+    # journaled fallback chain
+    embed: dict = {}
+    if tier_last is not None:
+        embed["tier_reports"] = tier_reports
+        embed["tier"] = {k: tier_last.get(k) for k in
+                         ("hit_rate", "hot_rows", "vocab", "lookups",
+                          "hits", "misses", "cold_bytes", "cold_seconds",
+                          "prefetch_hits", "fallbacks")}
+    if dedup_last is not None:
+        embed["dedup"] = {k: dedup_last.get(k) for k in
+                          ("batches", "rows_touched", "raw_cells",
+                           "dedup_ratio")}
+    if offload_fallbacks:
+        embed["offload_fallbacks"] = offload_fallbacks
+    out["embed"] = embed or None
     return out
 
 
@@ -505,6 +534,35 @@ def render_profile_text(summary: dict) -> str:
                 + (f" ({frac:.1%} of window)"
                    if isinstance(frac, (int, float)) else "")
                 + (f" [{k['bound']}-bound]" if k.get("bound") else ""))
+    embed = summary.get("embed") or {}
+    if embed:
+        tier = embed.get("tier") or {}
+        if tier:
+            hr = tier.get("hit_rate")
+            cb = tier.get("cold_bytes")
+            lines.append(
+                "embed tier: hit rate "
+                + (format(hr, ".1%") if isinstance(hr, (int, float))
+                   else "-")
+                + f" ({tier.get('hot_rows')} hot rows of "
+                f"{tier.get('vocab')} vocab), cold "
+                + (f"{cb / 1e6:.1f} MB" if isinstance(cb, (int, float))
+                   else "-")
+                + f" in {tier.get('cold_seconds')}s host reads"
+                + (f", {tier.get('prefetch_hits')} prefetch hit(s)"
+                   if tier.get("prefetch_hits") else ""))
+        dd = embed.get("dedup") or {}
+        if dd:
+            dr = dd.get("dedup_ratio")
+            lines.append(
+                f"embed dedup: {dd.get('rows_touched')} rows touched / "
+                f"{dd.get('raw_cells')} raw id cells over "
+                f"{dd.get('batches')} batch(es)"
+                + (f" ({dr:.1%} of cells)"
+                   if isinstance(dr, (int, float)) else ""))
+        if embed.get("offload_fallbacks"):
+            lines.append(f"embed offload: {embed['offload_fallbacks']} "
+                         "cold-read fault(s) served by the fallback chain")
     rec = summary.get("recovery") or {}
     if any(rec.get(k) for k in ("restores", "fallbacks",
                                 "preemption_graces", "resumes")):
@@ -702,6 +760,8 @@ def top_summary(path: str) -> Optional[dict]:
     loadtests: list[dict] = []
     traces = 0
     slo_profiles = 0
+    tier_last: Optional[dict] = None
+    dedup_last: Optional[dict] = None
     mode = "train"
     for rec in events:
         kind = rec.get("kind")
@@ -721,6 +781,10 @@ def top_summary(path: str) -> Optional[dict]:
             epochs.append(rec)
         elif kind == "goodput":
             goodput = rec
+        elif kind == "embed_tier_report":
+            tier_last = rec
+        elif kind == "embed_dedup_report":
+            dedup_last = rec
     if serve_start is not None or reports or loadtests:
         mode = "serving"
     out: dict = {"journal": jpath, "mode": mode, "events": total_events}
@@ -784,6 +848,17 @@ def top_summary(path: str) -> Optional[dict]:
         if goodput is not None:
             out["goodput"] = {k: goodput.get(k) for k in
                               ("epoch", "goodput_fraction", "mfu")}
+        # sparse embedding engine: the live tier/dedup story from the
+        # journal tail (docs/EMBEDDING.md)
+        embed: dict = {}
+        if tier_last is not None:
+            embed.update({k: tier_last.get(k) for k in
+                          ("hit_rate", "hot_rows", "vocab", "cold_bytes",
+                           "fallbacks")})
+        if dedup_last is not None:
+            embed["dedup_ratio"] = dedup_last.get("dedup_ratio")
+        if embed:
+            out["embed"] = embed
     return out
 
 
@@ -907,6 +982,26 @@ def render_top_text(summary: dict) -> str:
                else "-")
             + ("  mfu " + format(mfu, ".4f")
                if isinstance(mfu, (int, float)) else ""))
+    em = summary.get("embed")
+    if em:
+        hr = em.get("hit_rate")
+        dr = em.get("dedup_ratio")
+        cb = em.get("cold_bytes")
+        bits = []
+        if hr is not None:
+            bits.append("tier hit "
+                        + (format(hr, ".1%")
+                           if isinstance(hr, (int, float)) else str(hr))
+                        + f" ({em.get('hot_rows')}/{em.get('vocab')} hot)")
+        if isinstance(cb, (int, float)) and cb:
+            bits.append(f"cold {cb / 1e6:.1f} MB")
+        if em.get("fallbacks"):
+            bits.append(f"{em['fallbacks']} offload fallback(s)")
+        if dr is not None:
+            bits.append("dedup "
+                        + (format(dr, ".1%")
+                           if isinstance(dr, (int, float)) else str(dr)))
+        lines.append("embed: " + "  ".join(bits))
     last = summary.get("last_event")
     if last:
         lines.append(f"last event: {last.get('kind')} at ts "

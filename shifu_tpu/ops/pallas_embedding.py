@@ -326,3 +326,229 @@ def _bwd(use_pallas, res, g):
 
 
 embedding_lookup.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused rows-touched optimizer update (the sparse embedding engine's update
+# leg, shifu_tpu/embed/).  One pass per touched row: DMA the row (params +
+# adadelta moment slots) HBM->VMEM, apply the update rule on the VPU, and
+# DMA the new row back to the SAME HBM buffer (input_output_aliases) — no
+# XLA scatter, no dense (Nc, V, D) read-modify-write.  Ids arrive as a
+# scalar-prefetch argument like the lookup kernel's; out-of-range ids (the
+# dedup sentinel V pads unique-id batches to a static size) are skipped via
+# pl.when, matching the XLA reference's scatter-drop semantics.
+#
+# CALLER CONTRACT: within one call the in-range ids must be unique per
+# field (the engine's host-side dedup guarantees it) — duplicate rows in
+# one grid step would race their write-back DMAs, where the XLA `.at[].set`
+# reference resolves duplicates deterministically.
+
+# TF 1.4 Adadelta constants — must match train/optimizers.py and
+# train/sparse_embed.py (the exactness pins compare all three).
+_ADADELTA_RHO = 0.95
+_ADADELTA_EPS = 1e-8
+
+
+def rows_update_reference(table: jax.Array, slots, g_rows: jax.Array,
+                          ids: jax.Array, rule: str, lr):
+    """XLA reference rows-touched update (the exactness baseline the fused
+    kernel is pinned against, and the fallback where it cannot run).
+
+    table (Nc, V, D); slots = (accu, delta_accu) f32 for adadelta, () for
+    sgd; g_rows (U, Nc, D) per-touched-row gradients; ids (U, Nc) int32.
+    Out-of-range ids (>= V — the dedup sentinel) gather clamped garbage and
+    their scatter DROPS (JAX default), so padded entries are no-ops.
+    Returns (new_table, new_slots); math in f32, stored in table.dtype.
+    """
+    nc, v, _d = table.shape
+    lr = jnp.asarray(lr, jnp.float32)
+    if rule == "sgd":
+        parts = []
+        for f in range(nc):
+            i_f = ids[:, f]
+            p_rows = table[f, i_f].astype(jnp.float32)
+            g_f = g_rows[:, f].astype(jnp.float32)
+            parts.append(table[f].at[i_f].set(
+                (p_rows - lr * g_f).astype(table.dtype)))
+        return jnp.stack(parts), slots
+    accu, delta = slots
+    t_parts, a_parts, d_parts = [], [], []
+    for f in range(nc):
+        i_f = ids[:, f]
+        g_f = g_rows[:, f].astype(jnp.float32)
+        a_rows = accu[f, i_f]
+        d_rows = delta[f, i_f]
+        p_rows = table[f, i_f].astype(jnp.float32)
+        new_a = _ADADELTA_RHO * a_rows + (1.0 - _ADADELTA_RHO) * g_f * g_f
+        upd = g_f * jnp.sqrt(d_rows + _ADADELTA_EPS) \
+            / jnp.sqrt(new_a + _ADADELTA_EPS)
+        new_d = _ADADELTA_RHO * d_rows + (1.0 - _ADADELTA_RHO) * upd * upd
+        t_parts.append(table[f].at[i_f].set(
+            (p_rows - lr * upd).astype(table.dtype)))
+        a_parts.append(accu[f].at[i_f].set(new_a))
+        d_parts.append(delta[f].at[i_f].set(new_d))
+    return jnp.stack(t_parts), (jnp.stack(a_parts), jnp.stack(d_parts))
+
+
+def _make_rows_update_kernel(nc: int, rows_per_step: int, vocab: int,
+                             rule: str):
+    """Kernel body: per (row, field) — predicated on the id being in range
+    — DMA the touched table row (and moment rows) into VMEM scratch, apply
+    the rule as one vector op over the whole scratch block, and DMA the new
+    rows back.  Reads all complete before any write starts (the id sets of
+    one grid step are unique, and grid steps run sequentially)."""
+    adadelta = rule == "adadelta"
+
+    def kernel(ids_ref, lr_ref, g_ref, *refs):
+        if adadelta:
+            (table_ref, accu_ref, delta_ref, table_out, accu_out, delta_out,
+             t_s, a_s, d_s, sems) = refs
+            ins = ((table_ref, t_s, 0), (accu_ref, a_s, 1),
+                   (delta_ref, d_s, 2))
+            outs = ((t_s, table_out, 0), (a_s, accu_out, 1),
+                    (d_s, delta_out, 2))
+        else:
+            table_ref, table_out, t_s, sems = refs
+            ins = ((table_ref, t_s, 0),)
+            outs = ((t_s, table_out, 0),)
+        i = pl.program_id(0)
+
+        def each_valid(fn):
+            for r in range(rows_per_step):
+                u = i * rows_per_step + r
+                for f in range(nc):
+                    idx = ids_ref[u, f]
+                    valid = (idx >= 0) & (idx < vocab)
+
+                    @pl.when(valid)
+                    def _(r=r, f=f, idx=idx):
+                        fn(r, f, idx)
+
+        # phase 1: start every in-range row read (params + slots)
+        each_valid(lambda r, f, idx: [
+            pltpu.make_async_copy(src.at[f, idx], dst.at[r, f],
+                                  sems.at[k, r, f]).start()
+            for src, dst, k in ins])
+        # phase 2: drain the reads (same descriptors — wait on the sems)
+        each_valid(lambda r, f, idx: [
+            pltpu.make_async_copy(src.at[f, idx], dst.at[r, f],
+                                  sems.at[k, r, f]).wait()
+            for src, dst, k in ins])
+        # phase 3: the rule, one vector op over the scratch block (invalid
+        # slots compute garbage that phase 4 never writes back)
+        lr = lr_ref[0, 0]
+        g = g_ref[...].astype(jnp.float32)
+        if adadelta:
+            a = a_s[...]
+            d = d_s[...]
+            new_a = _ADADELTA_RHO * a + (1.0 - _ADADELTA_RHO) * g * g
+            upd = g * jnp.sqrt(d + _ADADELTA_EPS) \
+                / jnp.sqrt(new_a + _ADADELTA_EPS)
+            d_s[...] = _ADADELTA_RHO * d + (1.0 - _ADADELTA_RHO) * upd * upd
+            a_s[...] = new_a
+            t_s[...] = t_s[...] - lr * upd
+        else:
+            t_s[...] = t_s[...] - lr * g
+        # phase 4/5: write the new rows back to the aliased HBM buffers
+        each_valid(lambda r, f, idx: [
+            pltpu.make_async_copy(src.at[r, f], dst.at[f, idx],
+                                  sems.at[k, r, f]).start()
+            for src, dst, k in outs])
+        each_valid(lambda r, f, idx: [
+            pltpu.make_async_copy(src.at[r, f], dst.at[f, idx],
+                                  sems.at[k, r, f]).wait()
+            for src, dst, k in outs])
+
+    return kernel
+
+
+def _pallas_rows_update(table, slots, g_rows, ids, rule, lr,
+                        interpret: bool, rows_per_step: int = 8):
+    nc, vocab, dim = table.shape
+    u = ids.shape[0]
+    while u % rows_per_step != 0:
+        rows_per_step //= 2  # degrade gracefully for odd unique counts
+    adadelta = rule == "adadelta"
+    n_bufs = 3 if adadelta else 1
+    lr_arr = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+
+    row_block = pl.BlockSpec((rows_per_step, nc, dim),
+                             lambda i, ids_ref: (i, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,               # ids (SMEM)
+        grid=(u // rows_per_step,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i, ids_ref: (0, 0),
+                         memory_space=pltpu.SMEM),          # lr
+            row_block,                                      # g_rows (VMEM)
+        ] + [pl.BlockSpec(memory_space=pl.ANY)] * n_bufs,   # table (+slots)
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_bufs,
+        scratch_shapes=[
+            pltpu.VMEM((rows_per_step, nc, dim), jnp.float32)
+        ] * n_bufs + [pltpu.SemaphoreType.DMA((n_bufs, rows_per_step, nc))],
+    )
+    out_shape = [jax.ShapeDtypeStruct(table.shape, table.dtype)]
+    operands = [ids.astype(jnp.int32), lr_arr,
+                g_rows.astype(jnp.float32), table]
+    if adadelta:
+        accu, delta = slots
+        operands += [accu, delta]
+        out_shape += [jax.ShapeDtypeStruct(accu.shape, accu.dtype),
+                      jax.ShapeDtypeStruct(delta.shape, delta.dtype)]
+    # alias table (+slots) inputs onto the outputs: the update is in-place,
+    # so steady-state table traffic is touched-rows only.  Operand indices
+    # count every pallas_call argument incl. the scalar-prefetch ids.
+    aliases = {3 + k: k for k in range(n_bufs)}
+    outs = pl.pallas_call(
+        _make_rows_update_kernel(nc, rows_per_step, vocab, rule),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(*operands)
+    if adadelta:
+        return outs[0], (outs[1], outs[2])
+    return outs[0], slots
+
+
+def fused_update_available(dim: int) -> bool:
+    """True where the fused rows-touched update kernel can actually run:
+    any CPU/interpret context with the TPU pallas namespace present, or a
+    real TPU with a 128-lane-aligned embedding dim (the same Mosaic DMA
+    constraint as the lookup kernel — a narrower HBM row cannot be sliced).
+    train/sparse_embed.py's auto gate keys off this."""
+    if pltpu is None:
+        return False
+    if jax.default_backend() == "tpu":
+        return dim % 128 == 0
+    return True
+
+
+def fused_rows_update(table: jax.Array, slots, g_rows: jax.Array,
+                      ids: jax.Array, rule: str, lr,
+                      use_pallas: Optional[bool] = None):
+    """Rows-touched optimizer update: gather touched rows + apply the
+    Adadelta/SGD rule + scatter back, fused into one Pallas pass
+    (interpret mode off-TPU).  Falls back to `rows_update_reference` when
+    the kernel cannot run (no pltpu, unaligned D on real TPU, non-f32
+    table) or when use_pallas=False.  In-range ids must be unique per
+    field within a call (see the kernel contract above); out-of-range ids
+    (the dedup sentinel V) are skipped, matching the reference's
+    scatter-drop.  use_pallas=None auto-selects: the kernel wherever
+    `fused_update_available` holds AND the Pallas opt-in
+    (SHIFU_TPU_PALLAS) is set off-TPU."""
+    from .pallas_common import pallas_opt_in
+
+    if rule not in ("sgd", "adadelta"):
+        raise ValueError(f"fused_rows_update: unknown rule {rule!r}")
+    on_tpu = jax.default_backend() == "tpu"
+    if use_pallas is None:
+        use_pallas = fused_update_available(table.shape[-1]) and (
+            on_tpu or pallas_opt_in())
+    kernel_ok = (use_pallas and pltpu is not None
+                 and fused_update_available(table.shape[-1])
+                 and table.dtype == jnp.float32)
+    if not kernel_ok:
+        return rows_update_reference(table, slots, g_rows, ids, rule, lr)
+    return _pallas_rows_update(table, slots, g_rows, ids, rule, lr,
+                               interpret=not on_tpu)

@@ -133,6 +133,13 @@ def init_state(job: JobConfig, num_features: int,
                         f"sharding rule {pattern!r}: axis {axis!r} not in "
                         f"mesh axes {sorted(mesh.shape)}")
             rules += ((pattern, P(*axes)),)
+        if sparse_plan is not None and sparse_plan.shards > 1:
+            # sparse engine owns the tables: split the VOCAB axis (not the
+            # DEFAULT_RULES field axis) so the rows-touched update runs
+            # shard-local over V/shards rows per device (embed/shard);
+            # table_slots placement below follows the table's sharding
+            from ..embed.shard import VOCAB_SHARD_RULES
+            rules += tuple(VOCAB_SHARD_RULES)
         if job.runtime.mesh.model > 1:
             rules += tuple(shard_lib.DEFAULT_RULES)
             if job.model.model_type == "moe_mlp":
@@ -625,6 +632,21 @@ def train(job: JobConfig,
         if multihost:
             hb = itertools.islice(hb, steps_per_epoch)
         return hb
+
+    # sparse embedding engine: when a sparse plan engages and embed.dedup
+    # allows, the per-batch feeder compacts each batch's ids host-side
+    # (embed/dedup) and ships (embed_unique, embed_inverse) alongside the
+    # features — the step's rows-touched update then touches each row once,
+    # which also licenses the fused Pallas update kernel.  The scan tiers
+    # (staged/resident blocks) skip dedup; their batches fall back to
+    # raw-id extraction inside the sparse apply (docs/EMBEDDING.md).
+    _embed_dedup = None
+    if getattr(job, "embed", None) is not None and job.embed.dedup != "off":
+        from ..train import sparse_embed as _sparse_plan_lib
+        _dplan = _sparse_plan_lib.resolve_plan(job)
+        if _dplan is not None:
+            from ..embed.dedup import attach_dedup
+            _embed_dedup = attach_dedup(_dplan.layout, _dplan.max_vocab)
 
     def _feed_put_fn(shard_local, shard_global, cast):
         """Device placement for host arrays — blocks or batches, mesh or
@@ -1167,9 +1189,15 @@ def train(job: JobConfig,
                         # length is exactly why mid-epoch durability matters
                         maybe_midtrain_save(epoch)
             else:
+                bcast = wcast
+                if _embed_dedup is not None:
+                    # dedup BEFORE the wire cast: it reads decoded f32
+                    # features (categorical jobs ride the f32 wire anyway)
+                    bcast = (_embed_dedup if wcast is None else
+                             (lambda b, _c=wcast: _c(_embed_dedup(b))))
                 put_fn = _feed_put_fn(shard_lib.shard_batch,
                                       shard_lib.shard_batch_process_local,
-                                      wcast)
+                                      bcast)
                 if use_overlap:
                     if feeder is None:
                         feeder = pipe.EpochFeeder(
@@ -1419,6 +1447,10 @@ def train(job: JobConfig,
           # however the loop exits (done, early stop, SIGTERM drain, error):
           # abort the persistent feeder and free its run-ahead device blocks
           feeder.close()
+      if _embed_dedup is not None:
+          # flush the tail embed_dedup_report (runs shorter than the report
+          # cadence would otherwise never journal their dedup story)
+          _embed_dedup.finalize()
       if pending_thread is not None:
           # bounded-courtesy join only: if the loop is exiting with the
           # background retained-dataset assembly unconsumed (early stop,

@@ -26,6 +26,14 @@ per-row gradients (segment_sum / one-hot matmul), so every duplicate id
 gathers the same grad row, computes the same update, and the scatter
 writes the same value — `.at[].set` with duplicate indices is therefore
 deterministic.
+
+This module is the POLICY layer of the sparse embedding engine
+(shifu_tpu/embed/, docs/EMBEDDING.md): it decides when the plan engages
+and wires the engine's mechanisms into the step — the fused rows-touched
+Pallas kernel (ops/pallas_embedding.fused_rows_update) when the feeder's
+unique-id dedup vouches for duplicate-free ids, the vocab-sharded
+shard-local update (embed/shard) when the table lives split over the
+model mesh axis, and the per-field XLA reference otherwise.
 """
 
 from __future__ import annotations
@@ -38,22 +46,41 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config.schema import ConfigError, JobConfig
+from ..embed.dedup import UNIQUE_KEY
 
 # TF 1.4 Adadelta defaults, matching train/optimizers.py
 _RHO = 0.95
 _EPS = 1e-8
 
-# "auto" NEVER engages on this hardware generation — measured negative
-# result (docs/PERF.md "DeepFM rung"): the dense fused adadelta
+# "auto" engages only where the FUSED rows-update kernel can serve the
+# scatter (and the vocab is big enough that dense optimizer traffic
+# dominates).  The measured negative result for the XLA-scatter path
+# stands (docs/PERF.md "DeepFM rung"): the dense fused adadelta
 # elementwise runs at ~760M table-rows/s on a v5e while XLA:TPU scatters
 # run at ~30M rows/s AND degrade with table height, so the scatter-based
 # sparse path measured 0.2x dense at V=100k/B=32k and still 0.71x at
-# V=4M/B=4096 (vocab/batch ~1000x) — there is no in-HBM regime where it
-# wins without a hardware gather/scatter path (SparseCore).  The
-# capability stays behind an explicit "on" for the reference's
-# IndexedSlices lazy-update SEMANTICS (untouched rows see no decay),
-# not for speed; revisit the gate when a backend with fast scatter lands.
-_AUTO_ENGAGES = False
+# V=4M/B=4096 — there is no in-HBM regime where the SCATTER wins.  The
+# embed/ engine's kernel sidesteps it: touched rows move by per-row DMA
+# with the rule fused in, table traffic batch-proportional, duplicates
+# removed upstream by the feeder dedup.  Where the kernel cannot run
+# (no pltpu, TPU with an unaligned dim, no CPU opt-in), "auto" stays
+# off and "on" keeps the reference path for its IndexedSlices lazy-
+# update SEMANTICS (untouched rows see no decay), exactly as before.
+_AUTO_MIN_VOCAB = 100_000
+
+
+def _auto_engages(job: JobConfig) -> bool:
+    from ..models.embedding import field_layout
+    from ..ops.pallas_embedding import fused_update_available
+    from ..ops.pallas_common import pallas_opt_in
+    vocabs = field_layout(job.schema).vocab_sizes
+    if not vocabs or max(vocabs) < _AUTO_MIN_VOCAB:
+        return False
+    if not fused_update_available(job.model.embedding_dim):
+        return False
+    # off-TPU the kernel runs in interpret mode — correct but slow, so it
+    # stays behind the same explicit opt-in as every other Pallas kernel
+    return jax.default_backend() == "tpu" or pallas_opt_in()
 
 
 # model types that build stacked CategoricalEmbed tables the sparse rule
@@ -69,6 +96,7 @@ class SparseEmbedPlan:
     rule: str                    # "adadelta" | "sgd"
     learning_rate: Any           # float or optax schedule (fn of step)
     layout: Any                  # models.embedding.FieldLayout
+    shards: int = 1              # model-mesh vocab shards (1 = replicated)
 
     @property
     def num_categorical(self) -> int:
@@ -122,8 +150,15 @@ def resolve_plan(job: JobConfig) -> Optional[SparseEmbedPlan]:
         if job.train.local_sgd_window > 0:
             return "local-SGD replicas stack params on the data axis"
         if job.runtime.mesh.model > 1:
-            return ("the embedding table is model-axis sharded "
-                    "(vocab-sharded scatter stays on the dense path)")
+            # vocab-sharded tables (embed/shard): the padded max vocab
+            # must split evenly over the model axis — shard-local id
+            # routing is pure offset arithmetic over equal slices
+            from ..models.embedding import field_layout
+            v = max(field_layout(job.schema).vocab_sizes)
+            if v % job.runtime.mesh.model != 0:
+                return (f"vocab-sharded tables need max vocab ({v}) "
+                        f"divisible by the model axis "
+                        f"({job.runtime.mesh.model})")
         if job.model.pipeline_stages > 1:
             return "pipeline-stacked trunks reshape the param tree"
         return None
@@ -137,12 +172,13 @@ def resolve_plan(job: JobConfig) -> Optional[SparseEmbedPlan]:
         if why_not is not None:
             return None
 
-    if mode == "auto" and not _AUTO_ENGAGES:
+    if mode == "auto" and not _auto_engages(job):
         return None
     from ..models.embedding import field_layout
     from .optimizers import _learning_rate
     return SparseEmbedPlan(rule=rule, learning_rate=_learning_rate(opt),
-                           layout=field_layout(job.schema))
+                           layout=field_layout(job.schema),
+                           shards=max(job.runtime.mesh.model, 1))
 
 
 def dense_mask(params, plan: SparseEmbedPlan):
@@ -180,11 +216,17 @@ def extract_ids(features: jax.Array, plan: SparseEmbedPlan) -> jax.Array:
 
 
 def make_sparse_apply(job: JobConfig, mesh=None) -> Optional[Callable]:
-    """None, or fn(state, grads, features) -> new TrainState applying the
+    """None, or fn(state, grads, batch) -> new TrainState applying the
     masked dense transformation to non-table leaves and the sparse
-    rows-touched-only rule to the tables.  `features` is the (B, F)
-    DECODED feature matrix of the step's batch (categorical jobs always
-    ride the f32 wire — wire_mode refuses bf16/int8 for id columns)."""
+    rows-touched-only rule to the tables.  `batch` is the step's batch
+    dict (or the bare (B, F) DECODED feature matrix — categorical jobs
+    always ride the f32 wire, wire_mode refuses bf16/int8 for id
+    columns).  When the feeder attached the dedup keys (embed/dedup),
+    the update runs over the compacted unique-id set — which is also
+    what licenses the fused Pallas kernel (its DMA write-back has no
+    deterministic duplicate resolution); raw-id batches keep the XLA
+    reference.  Vocab-sharded plans (shards > 1) run the update
+    shard-locally under shard_map (embed/shard)."""
     import optax
 
     plan = resolve_plan(job)
@@ -194,47 +236,58 @@ def make_sparse_apply(job: JobConfig, mesh=None) -> Optional[Callable]:
     lr_of = (plan.learning_rate if callable(plan.learning_rate)
              else (lambda _step, _lr=plan.learning_rate: _lr))
     nc = plan.num_categorical
-    field_col = np.arange(nc, dtype=np.int32)[None, :]  # (1, Nc)
+    vocab = plan.max_vocab
+    embed_cfg = getattr(job, "embed", None)
+    dedup_on = embed_cfg is None or embed_cfg.dedup != "off"
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
         replicated = NamedSharding(mesh, PartitionSpec())
     else:
         replicated = None
 
-    def update_table(table, slots, g, ids, step):
-        # per-FIELD 2-D gathers/scatters (static unroll over Nc): the same
-        # per-table decomposition the backward's segment path prefers on
-        # TPU (ops/pallas_embedding._segment_grad)
-        lr = lr_of(step)
-        if rule == "sgd":
-            parts = []
-            for f in range(nc):
-                i_f = ids[:, f]
-                p_rows = table[f, i_f].astype(jnp.float32)
-                g_rows = g[f, i_f].astype(jnp.float32)
-                parts.append(table[f].at[i_f].set(
-                    (p_rows - lr * g_rows).astype(table.dtype)))
-            return jnp.stack(parts), slots
-        accu, delta = slots
-        t_parts, a_parts, d_parts = [], [], []
-        for f in range(nc):
-            i_f = ids[:, f]
-            g_rows = g[f, i_f].astype(jnp.float32)
-            a_rows = accu[f, i_f]
-            d_rows = delta[f, i_f]
-            p_rows = table[f, i_f].astype(jnp.float32)
-            new_a = _RHO * a_rows + (1.0 - _RHO) * g_rows * g_rows
-            upd = g_rows * jnp.sqrt(d_rows + _EPS) / jnp.sqrt(new_a + _EPS)
-            new_d = _RHO * d_rows + (1.0 - _RHO) * upd * upd
-            t_parts.append(table[f].at[i_f].set(
-                (p_rows - lr * upd).astype(table.dtype)))
-            a_parts.append(accu[f].at[i_f].set(new_a))
-            d_parts.append(delta[f].at[i_f].set(new_d))
-        return (jnp.stack(t_parts),
-                (jnp.stack(a_parts), jnp.stack(d_parts)))
+    from ..ops.pallas_embedding import fused_rows_update
+    sharded = {}
+    if plan.shards > 1:
+        if mesh is None:
+            raise ConfigError(
+                f"sparse plan wants {plan.shards} vocab shards but no "
+                "mesh was built")
+        from ..embed.shard import make_sharded_rows_update
+        for deduped in (False, True):
+            # the fused kernel's unique-id contract holds only for
+            # dedup'd batches; raw-id batches pin the reference path
+            sharded[deduped] = make_sharded_rows_update(
+                mesh, nc=nc, vocab=vocab, shards=plan.shards, rule=rule,
+                use_pallas=None if deduped else False)
 
-    def apply(state, grads, features):
-        ids = extract_ids(features, plan)
+    def update_table(table, slots, g, ids, step, deduped):
+        # rows-touched only: gather the touched rows' grads (per-FIELD
+        # 2-D gathers, the same decomposition the backward's segment path
+        # prefers on TPU), then one fused-or-reference rule application
+        # (ops/pallas_embedding) writes them back.  Dedup-sentinel ids
+        # (>= vocab) gather-clamp garbage and drop on the write.
+        lr = lr_of(step)
+        slots_t = slots if rule != "sgd" else ()
+        if plan.shards > 1:
+            t2, s2 = sharded[deduped](table, slots_t, g, ids, lr)
+        else:
+            g_rows = jnp.stack(
+                [g[f, ids[:, f]].astype(jnp.float32) for f in range(nc)],
+                axis=1)                                      # (U, Nc, D)
+            t2, s2 = fused_rows_update(table, slots_t, g_rows, ids, rule,
+                                       lr, None if deduped else False)
+        return t2, (s2 if rule != "sgd" else slots)
+
+    def apply(state, grads, batch):
+        if isinstance(batch, dict):
+            features = batch["features"]
+            unique = batch.get(UNIQUE_KEY) if dedup_on else None
+        else:
+            features, unique = batch, None
+        if unique is not None:
+            ids, deduped = unique, True
+        else:
+            ids, deduped = extract_ids(features, plan), False
         if replicated is not None:
             # ids replicated: under a data-sharded batch each device holds
             # its shard's ids, but every replica of the table must receive
@@ -256,7 +309,7 @@ def make_sparse_apply(job: JobConfig, mesh=None) -> Optional[Callable]:
         new_p, new_s = [], []
         for path, p, u, s in zip(paths, leaves_p, leaves_u, leaves_s):
             if _is_table_leaf(path, p, plan):
-                p2, s2 = update_table(p, s, u, ids, state.step)
+                p2, s2 = update_table(p, s, u, ids, state.step, deduped)
                 new_p.append(p2)
                 new_s.append(s2)
             else:

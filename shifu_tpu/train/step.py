@@ -114,7 +114,9 @@ def make_apply_gradients(job: JobConfig, mesh: Optional[Mesh] = None):
     sparse = make_sparse_apply(job, mesh)
     if sparse is None:
         return lambda st, grads, batch: st.apply_gradients(grads)
-    return lambda st, grads, batch: sparse(st, grads, batch["features"])
+    # the whole batch dict: the sparse apply reads features and, when the
+    # feeder attached them, the embed_unique compacted ids (embed/dedup)
+    return lambda st, grads, batch: sparse(st, grads, batch)
 
 
 def _input_donate_argnums(donate: bool, donate_batch: bool) -> tuple:

@@ -101,6 +101,13 @@ KEY_TRAIN_SPARSE_EMBED = "shifu.train.sparse-embedding-update"
 # "Device flight recorder"): trace-window schedule
 # (off/first/every:N/comma-list), capture dir, rollup size, HBM watermark
 # polling, and the anomaly detector's ring/threshold
+KEY_EMBED_DEDUP = "shifu.embed.dedup"
+KEY_EMBED_TIERING = "shifu.embed.tiering"
+KEY_EMBED_TIER_DTYPE = "shifu.embed.tier-dtype"
+KEY_EMBED_HOT_ROWS = "shifu.embed.hot-rows"
+KEY_EMBED_HOT_FRACTION = "shifu.embed.hot-fraction"
+KEY_EMBED_COLD_DIR = "shifu.embed.cold-dir"
+KEY_EMBED_PREFETCH = "shifu.embed.prefetch"
 KEY_OBS_TRACE_EPOCHS = "shifu.obs.trace-epochs"
 KEY_OBS_TRACE_DIR = "shifu.obs.trace-dir"
 KEY_OBS_TRACE_TOP_K = "shifu.obs.trace-top-k"
@@ -354,6 +361,21 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         obs_kw["anomaly_window"] = int(conf[KEY_OBS_ANOMALY_WINDOW])
     if KEY_OBS_ANOMALY_ZSCORE in conf:
         obs_kw["anomaly_zscore"] = float(conf[KEY_OBS_ANOMALY_ZSCORE])
+    embed_kw: dict[str, Any] = {}
+    if KEY_EMBED_DEDUP in conf:
+        embed_kw["dedup"] = conf[KEY_EMBED_DEDUP].strip().lower()
+    if KEY_EMBED_TIERING in conf:
+        embed_kw["tiering"] = conf[KEY_EMBED_TIERING].strip().lower()
+    if KEY_EMBED_TIER_DTYPE in conf:
+        embed_kw["tier_dtype"] = conf[KEY_EMBED_TIER_DTYPE].strip().lower()
+    if KEY_EMBED_HOT_ROWS in conf:
+        embed_kw["hot_rows"] = int(conf[KEY_EMBED_HOT_ROWS])
+    if KEY_EMBED_HOT_FRACTION in conf:
+        embed_kw["hot_fraction"] = float(conf[KEY_EMBED_HOT_FRACTION])
+    if KEY_EMBED_COLD_DIR in conf:
+        embed_kw["cold_dir"] = conf[KEY_EMBED_COLD_DIR]
+    if KEY_EMBED_PREFETCH in conf:
+        embed_kw["prefetch"] = parse_bool(conf[KEY_EMBED_PREFETCH])
     rt_kw: dict[str, Any] = {}
     if KEY_TIMEOUT in conf:
         # reference timeout is milliseconds (client-side kill,
@@ -396,14 +418,19 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
     if rt_kw:
         runtime = dataclasses.replace(runtime, **rt_kw)
 
+    extra_kw: dict[str, Any] = {}
     if obs_kw:
         # only touch `obs` when an obs key is actually set: job-shaped
         # stubs (and older serialized configs) without the field keep
         # working through the no-obs path
         from ..config.schema import ObsConfig
         base = getattr(job, "obs", None)
-        obs_cfg = (dataclasses.replace(base, **obs_kw)
-                   if base is not None else ObsConfig(**obs_kw))
-        return job.replace(train=train, data=data, runtime=runtime,
-                           obs=obs_cfg)
-    return job.replace(train=train, data=data, runtime=runtime)
+        extra_kw["obs"] = (dataclasses.replace(base, **obs_kw)
+                           if base is not None else ObsConfig(**obs_kw))
+    if embed_kw:
+        # same pattern for the sparse embedding engine's group
+        from ..config.schema import EmbedConfig
+        base = getattr(job, "embed", None)
+        extra_kw["embed"] = (dataclasses.replace(base, **embed_kw)
+                             if base is not None else EmbedConfig(**embed_kw))
+    return job.replace(train=train, data=data, runtime=runtime, **extra_kw)

@@ -1,0 +1,443 @@
+"""Sparse embedding engine (shifu_tpu/embed, docs/EMBEDDING.md): fused
+rows-update exactness, dedup bit-identity, vocab sharding parity on the
+CPU mesh, and the frequency-tiered host table with its chaos drill."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shifu_tpu import chaos, obs
+from shifu_tpu.chaos import plan as plan_mod
+from shifu_tpu.embed import (INVERSE_KEY, UNIQUE_KEY, TieredTable,
+                             assert_vocab_sharded, attach_dedup, dedup_ids,
+                             dedup_lookup, host_ids,
+                             make_sharded_rows_update)
+from shifu_tpu.ops.pallas_embedding import (embedding_lookup,
+                                            fused_rows_update,
+                                            fused_update_available,
+                                            rows_update_reference)
+
+NC = 3
+V = 64
+D = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_and_obs():
+    chaos.reset_for_tests()
+    obs.reset_for_tests()
+    yield
+    chaos.reset_for_tests()
+    obs.reset_for_tests()
+
+
+def _table(rng, nc=NC, v=V, d=D):
+    return jnp.asarray(rng.standard_normal((nc, v, d)).astype(np.float32))
+
+
+def _unique_ids(rng, u, nc=NC, v=V, pad=0):
+    """(u, nc) int32, unique in-range per field, last `pad` rows = the
+    sentinel v (the dedup padding the kernel must drop)."""
+    cols = [rng.choice(v, size=u - pad, replace=False) for _ in range(nc)]
+    ids = np.full((u, nc), v, np.int32)
+    for f in range(nc):
+        ids[:u - pad, f] = cols[f]
+    return jnp.asarray(ids)
+
+
+# --- fused kernel vs XLA reference (exactness pin) -------------------------
+
+def _run_both(rule, rng, pad=0):
+    table = _table(rng)
+    slots = ((jnp.zeros((NC, V, D), jnp.float32),
+              jnp.zeros((NC, V, D), jnp.float32))
+             if rule == "adadelta" else ())
+    g = jnp.asarray(rng.standard_normal((16, NC, D)).astype(np.float32))
+    ids = _unique_ids(rng, 16, pad=pad)
+    ref_t, ref_s = rows_update_reference(table, slots, g, ids, rule, 0.5)
+    fus_t, fus_s = fused_rows_update(table, slots, g, ids, rule, 0.5,
+                                     use_pallas=True)
+    return (ref_t, ref_s), (fus_t, fus_s), table, ids
+
+
+def test_fused_matches_reference_sgd():
+    """The fused Pallas update (interpret mode on CPU) reproduces the XLA
+    reference to float tolerance.  NOT bitwise: XLA fuses the rule's
+    multiply-adds differently in the two lowerings (FMA contraction),
+    ~2 ulp on touched rows — the tolerance pins that bound."""
+    assert fused_update_available(D)  # off-TPU: any D, interpret mode
+    rng = np.random.default_rng(0)
+    (ref_t, _), (fus_t, _), table, ids = _run_both("sgd", rng, pad=3)
+    np.testing.assert_allclose(np.asarray(fus_t), np.asarray(ref_t),
+                               rtol=1e-5, atol=1e-6)
+    # sentinel rows dropped + untouched rows bit-intact on BOTH paths
+    touched = np.zeros((NC, V), bool)
+    ids_np = np.asarray(ids)
+    for f in range(NC):
+        touched[f, ids_np[ids_np[:, f] < V, f]] = True
+    for out in (ref_t, fus_t):
+        assert np.array_equal(np.asarray(out)[~touched],
+                              np.asarray(table)[~touched])
+        assert not np.array_equal(np.asarray(out)[touched],
+                                  np.asarray(table)[touched])
+
+
+def test_fused_matches_reference_adadelta_first_step():
+    """First Adadelta step from zero slots: table AND both moment slots
+    agree with the reference (the moment math is inside the kernel)."""
+    rng = np.random.default_rng(1)
+    (ref_t, ref_s), (fus_t, fus_s), _, _ = _run_both("adadelta", rng, pad=2)
+    np.testing.assert_allclose(np.asarray(fus_t), np.asarray(ref_t),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(fus_s, ref_s):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# --- dedup ------------------------------------------------------------------
+
+def test_dedup_ids_compaction_and_inverse():
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, V, (32, NC)).astype(np.int32)
+    unique, inverse, counts = dedup_ids(ids, sentinel=V)
+    assert unique.shape == (32, NC) and inverse.shape == (32, NC)
+    for f in range(NC):
+        u = int(counts[f])
+        assert u == np.unique(ids[:, f]).size
+        assert np.all(unique[u:, f] == V)            # sentinel-padded tail
+        assert np.all(unique[inverse[:, f], f] == ids[:, f])  # reconstruct
+    with pytest.raises(ValueError, match="capacity"):
+        dedup_ids(ids, sentinel=V, capacity=2)
+
+
+def test_dedup_update_bit_identity():
+    """The engine's exactness claim: applying the dense grad's row ONCE at
+    each unique id is BIT-identical to the raw path writing the same row
+    once per duplicate cell — for both rules, params and slots."""
+    rng = np.random.default_rng(3)
+    raw = jnp.asarray(rng.integers(0, 8, (64, NC)).astype(np.int32))  # dups
+    dense_g = jnp.asarray(rng.standard_normal((NC, V, D)).astype(np.float32))
+
+    def gather(ids):
+        safe = jnp.clip(ids, 0, V - 1)
+        return jnp.stack([dense_g[f, safe[:, f]] for f in range(NC)], axis=1)
+
+    unique, _, _ = dedup_ids(np.asarray(raw), sentinel=V)
+    unique = jnp.asarray(unique)
+    for rule in ("sgd", "adadelta"):
+        table = _table(rng)
+        slots = ((jnp.zeros((NC, V, D), jnp.float32),
+                  jnp.zeros((NC, V, D), jnp.float32))
+                 if rule == "adadelta" else ())
+        t_raw, s_raw = rows_update_reference(table, slots, gather(raw),
+                                             raw, rule, 0.5)
+        t_ded, s_ded = rows_update_reference(table, slots, gather(unique),
+                                             unique, rule, 0.5)
+        assert np.array_equal(np.asarray(t_raw), np.asarray(t_ded)), rule
+        for a, b in zip(s_raw, s_ded):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), rule
+
+
+def test_dedup_lookup_forward_bit_parity_and_grads():
+    rng = np.random.default_rng(4)
+    table = _table(rng)
+    ids = jnp.asarray(rng.integers(0, V, (32, NC)).astype(np.int32))
+    unique, inverse, _ = dedup_ids(np.asarray(ids), sentinel=V)
+    direct = embedding_lookup(table, ids, use_pallas=False)
+    ded = dedup_lookup(table, jnp.asarray(unique), jnp.asarray(inverse),
+                       use_pallas=False)
+    assert np.array_equal(np.asarray(direct), np.asarray(ded))
+
+    w = jnp.asarray(rng.standard_normal(direct.shape).astype(np.float32))
+    g_direct = jax.grad(
+        lambda t: jnp.sum(embedding_lookup(t, ids, False) * w))(table)
+    g_ded = jax.grad(
+        lambda t: jnp.sum(dedup_lookup(t, jnp.asarray(unique),
+                                       jnp.asarray(inverse), False) * w)
+    )(table)
+    # backward reassociates the duplicate-row sum: tolerance, not bitwise
+    np.testing.assert_allclose(np.asarray(g_ded), np.asarray(g_direct),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_attach_dedup_transform_and_report(tmp_path):
+    from shifu_tpu.models.embedding import field_layout
+    from shifu_tpu.data import synthetic
+
+    obs.configure(str(tmp_path), flush_every=1)
+    schema = synthetic.make_schema(num_features=6, num_categorical=NC,
+                                   vocab_size=V)
+    layout = field_layout(schema)
+    rng = np.random.default_rng(5)
+    feats = rng.standard_normal((16, 6)).astype(np.float32)
+    feats[:, 6 - NC:] = rng.integers(0, V, (16, NC)).astype(np.float32)
+
+    transform = attach_dedup(layout, sentinel=V, report_every=2)
+    out = transform({"features": feats, "target": np.ones((16, 1))})
+    assert out[UNIQUE_KEY].shape == (16, NC)
+    assert out[INVERSE_KEY].shape == (16, NC)
+    ids = host_ids(feats, layout)
+    for f in range(NC):
+        assert np.all(out[UNIQUE_KEY][out[INVERSE_KEY][:, f], f]
+                      == ids[:, f])
+    # non-feature batches pass through untouched; second batch journals
+    assert transform({"meta": 1}) == {"meta": 1}
+    transform({"features": feats})
+    assert transform.dedup_state["batches"] == 2
+    obs.flush()
+    from shifu_tpu.obs import render
+    evs = render._load_events(render.find_journal(str(tmp_path)))
+    assert any(e.get("kind") == "embed_dedup_report" for e in evs)
+
+
+# --- vocab sharding (CPU mesh) ---------------------------------------------
+
+@pytest.mark.parametrize("rule", ["sgd", "adadelta"])
+def test_sharded_update_matches_replicated(eight_devices, rule):
+    """Vocab-sharded rows-update over the 8-device CPU mesh == the
+    replicated reference, and no device holds more than V/8 vocab rows."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from shifu_tpu.config import MeshConfig
+    from shifu_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(MeshConfig(data=1, model=8))
+    rng = np.random.default_rng(6)
+    table_h = np.asarray(_table(rng))
+    dense_g = rng.standard_normal((NC, V, D)).astype(np.float32)
+    raw = rng.integers(0, V, (24, NC)).astype(np.int32)
+    unique, _, _ = dedup_ids(raw, sentinel=V)
+
+    tspec = NamedSharding(mesh, P(None, "model", None))
+    rspec = NamedSharding(mesh, P())
+    table = jax.device_put(jnp.asarray(table_h), tspec)
+    assert_vocab_sharded(table, 8)
+    g = jax.device_put(jnp.asarray(dense_g), tspec)
+    ids = jax.device_put(jnp.asarray(unique), rspec)
+    slots_h = ((np.zeros((NC, V, D), np.float32),) * 2
+               if rule == "adadelta" else ())
+    slots = tuple(jax.device_put(jnp.asarray(s), tspec) for s in slots_h)
+
+    update = make_sharded_rows_update(mesh, nc=NC, vocab=V, shards=8,
+                                      rule=rule, use_pallas=False)
+    new_t, new_s = update(table, slots, g, ids, 0.5)
+    assert_vocab_sharded(new_t, 8)  # sharding preserved through the update
+
+    safe = np.clip(unique, 0, V - 1)
+    g_rows = jnp.asarray(np.stack(
+        [dense_g[f, safe[:, f]] for f in range(NC)], axis=1))
+    ref_t, ref_s = rows_update_reference(
+        jnp.asarray(table_h), tuple(jnp.asarray(s) for s in slots_h),
+        g_rows, jnp.asarray(unique), rule, 0.5)
+    np.testing.assert_allclose(np.asarray(new_t), np.asarray(ref_t),
+                               rtol=1e-6, atol=1e-7)
+    for a, b in zip(new_s, ref_s):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_sharded_update_rejects_indivisible_vocab():
+    with pytest.raises(ValueError, match="divisible"):
+        make_sharded_rows_update(None, nc=NC, vocab=50, shards=8,
+                                 rule="sgd")
+
+
+# --- frequency tiering ------------------------------------------------------
+
+def _tiered(tmp_path, rng, v=V, hot=16, dtype="float32", **kw):
+    table = rng.standard_normal((NC, v, D)).astype(np.float32)
+    tt = TieredTable.build(table, str(tmp_path), hot_rows=hot,
+                           tier_dtype=dtype, **kw)
+    return table, tt
+
+
+def test_tiered_lookup_f32_exact_hit_and_miss(tmp_path):
+    rng = np.random.default_rng(7)
+    table, tt = _tiered(tmp_path, rng)
+    ids = rng.integers(0, V, (40, NC)).astype(np.int32)
+    ids[0] = V  # dedup sentinel row -> zeros
+    got = tt.lookup(ids)
+    want = np.stack([table[f, np.clip(ids[:, f], 0, V - 1)]
+                     for f in range(NC)], axis=1)
+    want[0] = 0.0
+    assert np.array_equal(got, want)  # f32 tier is exact, hot AND cold
+    assert tt.stats["hits"] > 0 and tt.stats["misses"] > 0
+    assert tt.stats["cold_bytes"] > 0
+
+
+def test_tiered_lookup_int8_within_wire_tolerance(tmp_path):
+    rng = np.random.default_rng(8)
+    table, tt = _tiered(tmp_path, rng, dtype="int8")
+    ids = rng.integers(16, V, (32, NC)).astype(np.int32)  # all cold
+    got = tt.lookup(ids)
+    want = np.stack([table[f, ids[:, f]] for f in range(NC)], axis=1)
+    scale = float(tt.manifest["scale"])
+    assert np.max(np.abs(got - want)) <= scale / 2 + 1e-6
+    # hot rows stay exact f32 regardless of the cold dtype
+    hot = tt.lookup(np.zeros((4, NC), np.int32))
+    assert np.array_equal(hot, np.stack([table[f, [0, 0, 0, 0]]
+                                         for f in range(NC)], axis=1))
+
+
+def test_tiered_prefetch_serves_cold_rows(tmp_path):
+    rng = np.random.default_rng(9)
+    table, tt = _tiered(tmp_path, rng)
+    ids = rng.integers(16, V, (24, NC)).astype(np.int32)
+    tt.prefetch(ids).join()
+    got = tt.lookup(ids)
+    assert tt.stats["prefetch_hits"] > 0
+    want = np.stack([table[f, ids[:, f]] for f in range(NC)], axis=1)
+    assert np.array_equal(got, want)
+
+
+def test_embed_offload_chaos_drill(tmp_path):
+    """Cold-read fault at the embed.offload site: the lookup journals
+    `embed_offload_fallback`, serves identical rows through the fallback
+    chain, and the run continues — final values bit-equal the unfaulted
+    run (the ISSUE's acceptance drill)."""
+    obs.configure(str(tmp_path / "tele"), flush_every=1)
+    rng = np.random.default_rng(10)
+    table, tt = _tiered(tmp_path / "a", rng)
+    _, tt_clean = _tiered(tmp_path / "b",
+                          np.random.default_rng(10))
+    chaos.configure(plan_mod.parse_plan({"faults": [
+        {"site": "embed.offload", "at_call": 1, "max_times": 1}]}))
+    ids = rng.integers(16, V, (32, NC)).astype(np.int32)
+    got = tt.lookup(ids)
+    assert tt.stats["fallbacks"] == 1
+    assert np.array_equal(got, tt_clean.lookup(ids))  # identical metrics
+    rep = tt.tier_report()
+    assert rep["fallbacks"] == 1
+    obs.flush()
+    from shifu_tpu.obs import render
+    summary = render.profile_summary(str(tmp_path / "tele"))
+    assert summary["embed"]["offload_fallbacks"] == 1
+    assert summary["embed"]["tier"]["fallbacks"] == 1
+
+
+def test_tiered_10m_vocab_host_bounds(tmp_path):
+    """The 10M-vocab rung under host-memory bounds (ISSUE acceptance for
+    degraded rounds): int8 cold store on disk, a ~KB hot tier resident,
+    the f32 source table NOT retained."""
+    v, d = 10_000_000, 8
+    table = np.zeros((1, v, d), np.float32)  # calloc: pages lazily
+    tt = TieredTable.build(table, str(tmp_path), hot_rows=1024,
+                           tier_dtype="int8")
+    del table
+    assert tt._source is None                      # no f32 copy retained
+    assert tt.hot_rows.nbytes <= 1024 * d * 4      # hot tier ~32 KB
+    payload = os.path.join(tt.cold_dir, "table.bin")
+    assert os.path.getsize(payload) == v * d       # int8: 1 byte/elem
+    ids = np.array([[0], [1023], [1024], [9_999_999]], np.int32)
+    out = tt.lookup(ids)
+    assert out.shape == (4, 1, d) and np.all(out == 0.0)
+    assert tt.stats["hits"] == 2 and tt.stats["misses"] == 2
+
+
+# --- config / gating --------------------------------------------------------
+
+def test_embed_config_validate_and_xml_keys():
+    from shifu_tpu.config import ConfigError, EmbedConfig, JobConfig
+    from shifu_tpu.utils import xmlconfig
+
+    with pytest.raises(ConfigError, match="dedup"):
+        EmbedConfig(dedup="bogus").validate()
+    with pytest.raises(ConfigError, match="tier_dtype"):
+        EmbedConfig(tier_dtype="fp4").validate()
+    with pytest.raises(ConfigError, match="hot_fraction"):
+        EmbedConfig(hot_fraction=0.0).validate()
+
+    job = JobConfig()
+    out = xmlconfig.apply_to_job(job, {
+        "shifu.embed.dedup": "off",
+        "shifu.embed.tiering": "Host",
+        "shifu.embed.tier-dtype": "int8",
+        "shifu.embed.hot-rows": "4096",
+        "shifu.embed.hot-fraction": "0.1",
+        "shifu.embed.cold-dir": "/tmp/cold",
+        "shifu.embed.prefetch": "false",
+        "shifu.application.epochs": "7",
+    })
+    assert out.embed.dedup == "off"
+    assert out.embed.tiering == "host"
+    assert out.embed.tier_dtype == "int8"
+    assert out.embed.hot_rows == 4096
+    assert out.embed.hot_fraction == 0.1
+    assert out.embed.cold_dir == "/tmp/cold"
+    assert out.embed.prefetch is False
+    assert out.train.epochs == 7                   # other layers untouched
+    out.embed.validate()
+
+
+def test_auto_engage_follows_kernel_availability(monkeypatch):
+    """sparse_embedding_update="auto" engages at big vocab exactly when
+    the fused kernel can run: on CPU that's the Pallas opt-in (the scatter
+    negative result keeps plain auto off — see sparse_embed.py)."""
+    from shifu_tpu.config import (DataConfig, JobConfig, ModelSpec,
+                                  OptimizerConfig, TrainConfig)
+    from shifu_tpu.data import synthetic
+    from shifu_tpu.train import sparse_embed as se
+
+    schema = synthetic.make_schema(num_features=6, num_categorical=2,
+                                   vocab_size=200_000)
+    job = JobConfig(
+        schema=schema, data=DataConfig(batch_size=64),
+        model=ModelSpec(model_type="deepfm", hidden_nodes=(8,),
+                        activations=("relu",), embedding_dim=8,
+                        compute_dtype="float32"),
+        train=TrainConfig(epochs=1, loss="weighted_mse",
+                          optimizer=OptimizerConfig(name="adadelta",
+                                                    learning_rate=0.1),
+                          sparse_embedding_update="auto"),
+    ).validate()
+    monkeypatch.delenv("SHIFU_TPU_PALLAS", raising=False)
+    assert se.resolve_plan(job) is None
+    monkeypatch.setenv("SHIFU_TPU_PALLAS", "1")
+    plan = se.resolve_plan(job)
+    assert plan is not None and plan.rule == "adadelta"
+    # small vocab never auto-engages, opt-in or not
+    small = synthetic.make_schema(num_features=6, num_categorical=2,
+                                  vocab_size=100)
+    assert se.resolve_plan(job.replace(schema=small)) is None
+
+
+# --- loop integration -------------------------------------------------------
+
+def test_train_loop_dedup_matches_raw_path():
+    """End-to-end: a sparse="on" job trained with feeder dedup reaches
+    BIT-identical epoch metrics to the same job with embed.dedup="off"
+    (both sides run the XLA reference update on CPU)."""
+    import dataclasses
+
+    from shifu_tpu.config import (DataConfig, EmbedConfig, JobConfig,
+                                  ModelSpec, OptimizerConfig, TrainConfig)
+    from shifu_tpu.data import pipeline, reader, synthetic
+    from shifu_tpu.train import train
+
+    schema = synthetic.make_schema(num_features=8, num_categorical=NC,
+                                   vocab_size=V)
+    job = JobConfig(
+        schema=schema, data=DataConfig(batch_size=32),
+        model=ModelSpec(model_type="deepfm", hidden_nodes=(8,),
+                        activations=("relu",), embedding_dim=8,
+                        compute_dtype="float32"),
+        train=TrainConfig(epochs=2, loss="weighted_mse",
+                          optimizer=OptimizerConfig(name="adadelta",
+                                                    learning_rate=0.5),
+                          sparse_embedding_update="on"),
+    ).validate()
+    rows = synthetic.make_rows(256, schema, seed=11, noise=0.3)
+    cols = reader.project_columns(rows, schema)
+    ds = pipeline.TabularDataset(cols["features"], cols["target"],
+                                 cols["weight"])
+    train_ds, valid_ds = ds.take(np.arange(224)), ds.take(np.arange(224, 256))
+
+    r_dedup = train(job, train_ds, valid_ds, console=lambda s: None)
+    job_off = job.replace(embed=EmbedConfig(dedup="off"))
+    r_raw = train(job_off, train_ds, valid_ds, console=lambda s: None)
+    for a, b in zip(r_dedup.history, r_raw.history):
+        assert a.train_error == b.train_error
+        assert a.valid_error == b.valid_error

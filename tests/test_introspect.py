@@ -309,7 +309,7 @@ def test_status_quick_summary_carries_goodput(tmp_path, monkeypatch):
 
 def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7,
               cold=300.0, hbm=1 << 30, serving=250_000.0,
-              serving_p99=6.0):
+              serving_p99=6.0, sparse=1.3):
     return {"value": value, "unit": "samples/sec/chip",
             "goodput": {"goodput_fraction_mean": goodput_frac},
             "xla_compiles": {"total": compiles},
@@ -317,7 +317,8 @@ def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7,
             "e2e_cold_disk_samples_per_sec_per_chip": cold,
             "device_hbm_peak_bytes": hbm,
             "serving_scores_per_sec": serving,
-            "serving_p99_ms": serving_p99}
+            "serving_p99_ms": serving_p99,
+            "ladder_deepfm_4mvocab_sparse_speedup": sparse}
 
 
 @pytest.mark.perf
@@ -393,12 +394,27 @@ def test_perf_gate_fails_each_axis():
     # ...shared-host p99 wobble inside the factor passes
     r = perf_gate.run_gate(_artifact(serving_p99=12.0), base)
     assert r["verdict"] == "PASS"
+    # sparse-embed speedup below the 1.0 floor (ISSUE 10's engine A/B):
+    # the healthy baseline (1.3) ratchets the floor in
+    r = perf_gate.run_gate(_artifact(sparse=0.8), base)
+    assert r["verdict"] == "REGRESSION"
+    assert [c for c in r["checks"]
+            if c["name"] == "sparse_embed_speedup"][0]["status"] \
+        == "REGRESSION"
+    # ...above the floor passes even below the baseline (floor-style,
+    # not ratio-of-baseline)
+    r = perf_gate.run_gate(_artifact(sparse=1.05), base)
+    assert r["verdict"] == "PASS"
+    # ...and a pre-engine 0.7x baseline gates against ITSELF (the floor
+    # ratchets, it doesn't retroactively fail old scatter-path rounds)
+    r = perf_gate.run_gate(_artifact(sparse=0.7), _artifact(sparse=0.7))
+    assert r["verdict"] == "PASS"
     # missing fields on either side SKIP, never fail — an artifact that
     # predates the device flight recorder (no device_hbm_peak_bytes)
     # still gates the axes it carries
     r = perf_gate.run_gate({"value": 100.0}, base)
     assert r["verdict"] == "PASS"
-    assert [c["status"] for c in r["checks"]] == ["OK"] + ["SKIP"] * 7
+    assert [c["status"] for c in r["checks"]] == ["OK"] + ["SKIP"] * 8
 
 
 @pytest.mark.perf
@@ -438,7 +454,7 @@ def test_perf_gate_cli_pass_fail_and_check_only(tmp_path):
     fresh_bad.write_text(json.dumps(
         _artifact(value=10.0, goodput_frac=0.1, compiles=100, ceiling=0.1,
                   cold=10.0, hbm=8 << 30, serving=10_000.0,
-                  serving_p99=90.0)))
+                  serving_p99=90.0, sparse=0.5)))
 
     def run(*args):
         return subprocess.run([sys.executable, gate, *args],

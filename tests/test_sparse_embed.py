@@ -65,15 +65,21 @@ def _table_leaves(params):
 def test_plan_gating():
     # forced on: engages at any vocab
     assert se.resolve_plan(_job(sparse="on")) is not None
-    # auto NEVER engages on this hardware generation — measured negative
-    # result (sparse_embed._AUTO_ENGAGES): scatter-based updates lose to
-    # the fused dense elementwise at every in-HBM vocab/batch ratio
+    # auto: small vocabs stay dense (optimizer traffic doesn't dominate)
     assert se.resolve_plan(_job(sparse="auto")) is None
+    # auto at engine scale: gated on the fused update kernel being
+    # actually runnable — off-TPU that means the explicit Pallas opt-in
+    # (interpret mode), same as every other kernel; without it the dense
+    # path stands even at 100k vocab
     big = _job(sparse="auto")
     big_schema = synthetic.make_schema(num_features=F, num_categorical=NC,
                                        vocab_size=100_000)
     big = big.replace(schema=big_schema)
-    assert se.resolve_plan(big) is None
+    import os
+    if os.environ.get("SHIFU_TPU_PALLAS"):
+        assert se.resolve_plan(big) is not None
+    else:
+        assert se.resolve_plan(big) is None
     # off
     assert se.resolve_plan(_job(sparse="off")) is None
     # unsupported optimizer: on raises loudly
@@ -86,10 +92,14 @@ def test_plan_gating():
                                                 model_type="mlp"))
     with pytest.raises(ConfigError, match="stacked embedding"):
         se.resolve_plan(mlp)
-    # model-axis sharding keeps the dense path
-    assert se.resolve_plan(_job(sparse="auto", model_axis=2)) is None
-    with pytest.raises(ConfigError, match="model-axis"):
-        se.resolve_plan(_job(sparse="on", model_axis=2))
+    # model-axis sharding now ENGAGES, vocab-sharded (embed/shard), when
+    # the padded vocab splits evenly over the axis...
+    sharded = se.resolve_plan(_job(sparse="on", model_axis=2))
+    assert sharded is not None and sharded.shards == 2
+    # ...and raises with the divisibility blocker spelled out otherwise
+    odd = _job(sparse="on", model_axis=3)  # VOCAB=50 % 3 != 0
+    with pytest.raises(ConfigError, match="divisible"):
+        se.resolve_plan(odd)
     # numeric-only schema has nothing to update sparsely
     numeric = _job(sparse="on")
     numeric = numeric.replace(schema=synthetic.make_schema(num_features=F))

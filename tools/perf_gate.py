@@ -3,7 +3,7 @@ BENCH baseline and exit nonzero on regression.
 
 The repo's first *enforceable* perf trajectory (ISSUE 3): every round the
 driver captures a `BENCH_r*.json`; this gate compares a freshly produced
-`bench_full.json` against the newest of those baselines on eight axes —
+`bench_full.json` against the newest of those baselines on nine axes —
 
 - **throughput / step time**: the headline resident-tier
   samples/sec/chip (`value`) must not fall below
@@ -48,6 +48,12 @@ driver captures a `BENCH_r*.json`; this gate compares a freshly produced
   overlap, a blocking journal write on the dispatch path), and p99 is
   the serving figure of merit (arxiv 2605.25645).  Wide factor on
   purpose: shared-host p99s swing with co-tenant load.
+- **sparse-embed speedup**: `ladder_deepfm_4mvocab_sparse_speedup`
+  (the 4M-vocab DeepFM sparse-vs-dense A/B, ISSUE 10) must not fall
+  below `min(--sparse-floor, baseline)` — floor-style because the
+  field is already a same-run ratio: the engine's contract is "sparse
+  must not lose" (1.0), ratcheting in once a baseline reaches it while
+  pre-engine 0.7x baselines keep gating against themselves.
 
 Checks whose fields are missing on either side are SKIPPED (pre-ledger
 baselines carry no goodput/compile fields; pre-flight-recorder ones no
@@ -142,7 +148,8 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
              cold_drop: float = 0.3,
              hbm_factor: float = 1.5,
              serving_drop: float = 0.3,
-             p99_factor: float = 3.0) -> dict:
+             p99_factor: float = 3.0,
+             sparse_floor: float = 1.0) -> dict:
     """The comparison itself (pure — unit-tested on synthetic pairs).
     Returns {"checks": [...], "verdict": "PASS"|"REGRESSION"}."""
     checks: list[dict] = []
@@ -246,6 +253,23 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
         limit = bp * p99_factor
         check("serving_p99_ms", fp, bp, fp <= limit, round(limit, 2))
 
+    # sparse-embed speedup: the 4M-vocab DeepFM sparse-vs-dense A/B ratio
+    # (ISSUE 10's engine).  Floor-style, not ratio-of-baseline: the number
+    # IS already a ratio (tunnel-drift-immune), and the engine's contract
+    # is "sparse must not lose" (>= 1.0).  The floor ratchets in via
+    # min(floor, baseline): a pre-engine baseline that recorded the
+    # scatter path's 0.7x keeps passing against itself, while any round
+    # whose baseline reached the floor is held to it.  SKIP when either
+    # side predates the A/B.
+    fsp = _num(fresh, "ladder_deepfm_4mvocab_sparse_speedup")
+    bsp = _num(baseline, "ladder_deepfm_4mvocab_sparse_speedup")
+    if fsp is None or bsp is None or bsp <= 0:
+        check("sparse_embed_speedup", fsp, bsp, None, None)
+    else:
+        limit = min(sparse_floor, bsp)
+        check("sparse_embed_speedup", fsp, bsp, fsp >= limit,
+              round(limit, 2))
+
     regressed = [c for c in checks if c["status"] == "REGRESSION"]
     return {"checks": checks,
             "verdict": "REGRESSION" if regressed else "PASS"}
@@ -302,6 +326,11 @@ def main(argv=None) -> int:
                    help="fresh serving_p99_ms must be <= baseline * this "
                         "factor (the serving SLO's latency axis, ISSUE 8; "
                         "SKIP when either side lacks the field)")
+    p.add_argument("--sparse-floor", type=float, default=1.0,
+                   help="fresh ladder_deepfm_4mvocab_sparse_speedup must "
+                        "be >= min(this, baseline) (the sparse embedding "
+                        "engine's A/B, ISSUE 10; SKIP when either side "
+                        "lacks the field)")
     p.add_argument("--check-only", action="store_true",
                    help="tier-1 mode: missing/corrupt artifacts degrade to "
                         "a journaled warning and exit 0")
@@ -345,7 +374,8 @@ def main(argv=None) -> int:
                       cold_drop=args.cold_drop,
                       hbm_factor=args.hbm_factor,
                       serving_drop=args.serving_drop,
-                      p99_factor=args.p99_factor)
+                      p99_factor=args.p99_factor,
+                      sparse_floor=args.sparse_floor)
     report["fresh"] = args.fresh
     report["baseline"] = baseline_path
     _journal("perf_gate", verdict=report["verdict"],
