@@ -192,6 +192,15 @@ class DataConfig:
     # device step synthesizing ones (bit-identical losses).  "elide" forces
     # (non-unit weights raise); "float32" disables.
     wire_weight_mode: str = "auto"
+    # in-HBM format for the device-resident tier's feature blocks: "auto"
+    # keeps the wire format (no silent precision change), "wire" says the
+    # same explicitly, "int8" forces int8 residency — features quantize to
+    # the wire_params grid at tier build even when the per-batch wire is
+    # f32/bf16, quartering resident HBM vs f32 staging, with dequantization
+    # fused into the first-layer matmul where ops/pallas_int8_matmul is
+    # available (XLA decode otherwise).  Same categorical-free requirement
+    # as wire_dtype="int8" (JobConfig.validate enforces).
+    resident_format: str = "auto"
 
     def validate(self) -> None:
         if not (0.0 <= self.valid_ratio < 1.0):
@@ -225,6 +234,10 @@ class DataConfig:
             raise ConfigError(
                 f"wire_weight_mode must be auto/elide/float32: "
                 f"{self.wire_weight_mode!r}")
+        if self.resident_format not in ("auto", "wire", "int8"):
+            raise ConfigError(
+                f"resident_format must be auto/wire/int8: "
+                f"{self.resident_format!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -272,6 +285,14 @@ class ModelSpec:
     # effect when the training mesh has a `seq` axis of size > 1; flash is a
     # per-device kernel choice; scoring/export always runs local.
     attention_impl: str = "local"
+    # fused transformer block (ft_transformer): run each TransformerBlock's
+    # attention + FFN as one Pallas pass (ops/pallas_ft_block) when the
+    # feature-token count fits the kernel's shape class.  "auto" engages on
+    # TPU backends (or under SHIFU_TPU_PALLAS interpret opt-in), "on"
+    # forces (interpret mode off-TPU — the CI exactness path), "off"
+    # keeps the unfused module math.  Inapplicable shapes, train-time
+    # dropout, and ring/ulysses sequence parallelism always fall back.
+    fused_block: str = "auto"
     # pipeline parallelism (ft_transformer): split the transformer blocks
     # into this many stages over the mesh's `pipe` axis, GPipe-style
     # microbatch schedule (parallel/pipeline.py).  1 = off.  Training-time
@@ -306,6 +327,9 @@ class ModelSpec:
             raise ConfigError(
                 f"unknown attention_impl {self.attention_impl!r}; "
                 "expected local|ring|ulysses|flash")
+        if self.fused_block not in ("auto", "on", "off"):
+            raise ConfigError(
+                f"fused_block must be auto/on/off: {self.fused_block!r}")
         if self.model_type == "moe_mlp" and self.num_experts < 2:
             raise ConfigError("moe_mlp requires num_experts >= 2")
         if self.pipeline_stages < 1 or self.pipeline_microbatches < 0:
@@ -827,6 +851,13 @@ class JobConfig:
                 "wire_dtype=int8 requires a categorical-free feature matrix "
                 f"({len(self.schema.categorical_indices)} categorical "
                 "columns selected); use auto/bfloat16/float32")
+        if (self.data.resident_format == "int8"
+                and self.schema.categorical_indices):
+            # the resident tier shares the wire_params affine grid
+            raise ConfigError(
+                "resident_format=int8 requires a categorical-free feature "
+                f"matrix ({len(self.schema.categorical_indices)} categorical "
+                "columns selected); use auto/wire")
         return self
 
     # -- serialization ------------------------------------------------------

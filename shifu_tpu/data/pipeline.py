@@ -465,6 +465,23 @@ def wire_mode(schema: DataSchema, data: DataConfig,
     return mode
 
 
+def resident_feature_format(schema: DataSchema, data: DataConfig,
+                            model_compute_dtype: str) -> str:
+    """Resolved in-HBM feature format for the device-resident tier:
+    "float32", "bfloat16", or "int8".  "auto"/"wire" keep whatever format
+    the wire delivered (no silent precision change); "int8" forces the
+    wire_params grid at tier build even when the per-batch wire is wider —
+    quartering resident HBM vs f32 staging — with the dequant fused into
+    the first-layer matmul where ops/pallas_int8_matmul is engaged
+    (train/step.make_wire_decode's XLA op otherwise).  Categorical ids
+    cannot ride the affine grid, so such schemas degrade to the wire
+    format (mirror of wire_mode's guard; JobConfig.validate rejects the
+    config up front)."""
+    if data.resident_format == "int8" and not schema.categorical_indices:
+        return "int8"
+    return wire_mode(schema, data, model_compute_dtype)
+
+
 def wire_quantize(x: np.ndarray, scale: np.ndarray,
                   offset: np.ndarray) -> np.ndarray:
     """The ONE int8 wire encoder (grid contract single-sourced: callers at

@@ -9,7 +9,7 @@ kept in `param_dtype` (float32) and master-precision loss accumulation.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -18,10 +18,51 @@ import jax.numpy as jnp
 from ..config.schema import ModelSpec
 from ..ops.activations import get_activation
 from ..ops.initializers import bias_init, xavier_uniform
+from ..ops.pallas_int8_matmul import (fused_engaged as _int8_fused_engaged,
+                                      int8_matmul_dequant,
+                                      xla_reference as _int8_xla_reference)
 
 
 def dtype_of(name: str):
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}[name]
+
+
+class _WireDense(nn.Module):
+    """A Dense layer that consumes int8 wire features natively.
+
+    Declares the same `kernel`/`bias` params (names, shapes, init order) as
+    the nn.Dense that `ShifuDense` otherwise builds — checkpoints, exports,
+    and sharding rules see an identical tree — but routes int8 inputs
+    through `ops.pallas_int8_matmul.int8_matmul_dequant`, which applies the
+    static wire grid inside the matmul's tile load instead of dispatching a
+    separate dequant op.  Non-int8 inputs (the f32 init dummy, eval batches
+    that arrive decoded) take the ordinary promotion math unchanged.
+    """
+
+    features: int
+    wire: Tuple[Tuple[float, ...], Optional[Tuple[float, ...]]]
+    xavier_bias: bool = True
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        pdt = dtype_of(self.param_dtype)
+        cdt = dtype_of(self.compute_dtype)
+        kernel = self.param("kernel", xavier_uniform,
+                            (x.shape[-1], self.features), pdt)
+        bias = self.param("bias", bias_init(self.xavier_bias),
+                          (self.features,), pdt)
+        if x.dtype == jnp.int8:
+            scale = jnp.asarray(self.wire[0], jnp.float32)
+            offset = (None if self.wire[1] is None
+                      else jnp.asarray(self.wire[1], jnp.float32))
+            if _int8_fused_engaged(x.shape[-1], self.features):
+                return int8_matmul_dequant(x, kernel, bias, scale, offset,
+                                           compute_dtype=cdt)
+            return _int8_xla_reference(x, kernel, bias, scale, offset,
+                                       compute_dtype=cdt)
+        return x.astype(cdt) @ kernel.astype(cdt) + bias.astype(cdt)
 
 
 class ShifuDense(nn.Module):
@@ -30,16 +71,34 @@ class ShifuDense(nn.Module):
     xavier_bias: bool = True
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
+    # int8 wire grid (scale_tuple, offset_tuple_or_None) from
+    # data/pipeline.wire_params; set only on the FIRST layer of models fed
+    # wire-format features (train/loop.init_state) — the dense then accepts
+    # int8 inputs directly with dequantization fused into the matmul
+    wire: Optional[Tuple[Tuple[float, ...],
+                         Optional[Tuple[float, ...]]]] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        y = nn.Dense(
-            self.features,
-            kernel_init=xavier_uniform,
-            bias_init=bias_init(self.xavier_bias),
-            param_dtype=dtype_of(self.param_dtype),
-            dtype=dtype_of(self.compute_dtype),
-        )(x)
+        if self.wire is not None:
+            # name="Dense_0" pins the auto-name nn.Dense would get, so the
+            # param tree (and init RNG stream) is identical either way
+            y = _WireDense(
+                self.features,
+                wire=self.wire,
+                xavier_bias=self.xavier_bias,
+                param_dtype=self.param_dtype,
+                compute_dtype=self.compute_dtype,
+                name="Dense_0",
+            )(x)
+        else:
+            y = nn.Dense(
+                self.features,
+                kernel_init=xavier_uniform,
+                bias_init=bias_init(self.xavier_bias),
+                param_dtype=dtype_of(self.param_dtype),
+                dtype=dtype_of(self.compute_dtype),
+            )(x)
         if self.activation is not None:
             y = get_activation(self.activation)(y)
         return y
@@ -50,9 +109,14 @@ class MLPTrunk(nn.Module):
     ActivationFunc — reference: ssgd_monitor.py:93-110).  When
     `spec.dropout_rate > 0` (ModelConfig DropoutRate) each hidden layer's
     activation is followed by dropout, active only under `train=True` —
-    eval/export stay deterministic."""
+    eval/export stay deterministic.
+
+    `wire` (the int8 grid from data/pipeline.wire_params) attaches to layer
+    0 only: that is the one layer that ever sees wire-format inputs."""
 
     spec: ModelSpec
+    wire: Optional[Tuple[Tuple[float, ...],
+                         Optional[Tuple[float, ...]]]] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -63,6 +127,7 @@ class MLPTrunk(nn.Module):
                 xavier_bias=self.spec.xavier_bias_init,
                 param_dtype=self.spec.param_dtype,
                 compute_dtype=self.spec.compute_dtype,
+                wire=self.wire if i == 0 else None,
                 name=f"hidden_layer{i}",
             )(x)
             if self.spec.dropout_rate > 0:

@@ -31,6 +31,8 @@ from jax.nn import initializers as jinit
 from ..config.schema import ModelSpec
 from ..ops.attention import ring_attention, ulysses_attention
 from ..ops.pallas_attention import flash_attention
+from ..ops.pallas_ft_block import (fused_block_engaged,
+                                   fused_transformer_block)
 from ..ops.pallas_small_attention import small_token_attention
 from ..ops.initializers import xavier_uniform
 from ..parallel.mesh import PIPE_AXIS, SEQ_AXIS
@@ -51,6 +53,35 @@ def _pipe_parallel_size(mesh: Optional[Mesh]) -> int:
     return int(mesh.shape[PIPE_AXIS])
 
 
+class _LNParams(nn.Module):
+    """Param-holder twin of nn.LayerNorm: declares the identical
+    scale/bias leaves (names, shapes, f32, init fns) without running the
+    norm — the fused-block path reads them and normalizes in-kernel."""
+
+    dim: int
+
+    @nn.compact
+    def __call__(self):
+        return (self.param("scale", jinit.ones, (self.dim,), jnp.float32),
+                self.param("bias", jinit.zeros, (self.dim,), jnp.float32))
+
+
+class _DenseParams(nn.Module):
+    """Param-holder twin of the block's nn.Dense layers (xavier kernel,
+    zero bias) for the fused path; same tree, same init RNG draw."""
+
+    in_dim: int
+    out_dim: int
+    param_dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self):
+        pdt = dtype_of(self.param_dtype)
+        return (self.param("kernel", xavier_uniform,
+                           (self.in_dim, self.out_dim), pdt),
+                self.param("bias", jinit.zeros, (self.out_dim,), pdt))
+
+
 class TransformerBlock(nn.Module):
     spec: ModelSpec
     mesh: Optional[Mesh] = None  # enables ring/ulysses when it has a seq axis
@@ -63,6 +94,29 @@ class TransformerBlock(nn.Module):
         assert d % h == 0, "token_dim must divide num_attention_heads"
         dh = d // h
         b, s, _ = x.shape
+        n_sp = _seq_parallel_size(self.mesh)
+
+        if fused_block_engaged(self.spec, s, train=train,
+                               n_seq_parallel=n_sp):
+            # one Pallas pass for the whole block (ops/pallas_ft_block):
+            # param-holder children pin the exact tree of the unfused path
+            # — checkpoints and exports are interchangeable between modes
+            pdt = self.spec.param_dtype
+            r = self.spec.mlp_ratio
+            p = {}
+            p["ln_attn_scale"], p["ln_attn_bias"] = (
+                _LNParams(d, name="ln_attn")())
+            p["qkv_kernel"], p["qkv_bias"] = (
+                _DenseParams(d, 3 * d, pdt, name="qkv")())
+            p["proj_kernel"], p["proj_bias"] = (
+                _DenseParams(d, d, pdt, name="proj")())
+            p["ln_mlp_scale"], p["ln_mlp_bias"] = (
+                _LNParams(d, name="ln_mlp")())
+            p["mlp_in_kernel"], p["mlp_in_bias"] = (
+                _DenseParams(d, r * d, pdt, name="mlp_in")())
+            p["mlp_out_kernel"], p["mlp_out_bias"] = (
+                _DenseParams(r * d, d, pdt, name="mlp_out")())
+            return fused_transformer_block(x, p, self.spec)
 
         # pre-LN attention
         y = nn.LayerNorm(dtype=cdt, name="ln_attn")(x)
@@ -73,7 +127,6 @@ class TransformerBlock(nn.Module):
         q = q.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
         k = k.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
         v = v.reshape(b, s, h, dh).transpose(0, 2, 1, 3)
-        n_sp = _seq_parallel_size(self.mesh)
         if self.spec.attention_impl == "flash":
             # blockwise Pallas kernel (O(S) memory per device); orthogonal to
             # the mesh — with a seq axis use ring/ulysses instead
@@ -151,6 +204,11 @@ def _block_forward(p: dict, x: jax.Array, spec: ModelSpec) -> jax.Array:
     h = spec.num_attention_heads
     dh = d // h
     b, s, _ = x.shape
+
+    if fused_block_engaged(spec, s):
+        # the stacked/pipelined trunks carry the same stacked-name dict the
+        # fused kernel takes — route the whole block through one pass
+        return fused_transformer_block(x, p, spec)
 
     y = _layernorm(x, p["ln_attn_scale"], p["ln_attn_bias"], cdt)
     qkv = y @ p["qkv_kernel"].astype(cdt) + p["qkv_bias"].astype(cdt)

@@ -8,8 +8,11 @@ model emits logits (B, num_heads); sigmoid is applied by the loss and scorer.
 
 from __future__ import annotations
 
+from typing import Optional, Tuple
+
 import flax.linen as nn
 import jax
+import jax.numpy as jnp
 
 from ..config.schema import ModelSpec
 from .base import MLPTrunk, ScoringHead, dtype_of
@@ -17,9 +20,18 @@ from .base import MLPTrunk, ScoringHead, dtype_of
 
 class ShifuMLP(nn.Module):
     spec: ModelSpec
+    # int8 wire grid (data/pipeline.wire_params) when the training loop
+    # feeds wire-format features straight into the model; layer 0 then
+    # fuses the dequant into its matmul (models/base._WireDense)
+    wire: Optional[Tuple[Tuple[float, ...],
+                         Optional[Tuple[float, ...]]]] = None
 
     @nn.compact
     def __call__(self, features: jax.Array, *, train: bool = False) -> jax.Array:
-        x = features.astype(dtype_of(self.spec.compute_dtype))
-        x = MLPTrunk(spec=self.spec, name="trunk")(x, train=train)
+        if self.wire is not None and features.dtype == jnp.int8:
+            x = features  # layer 0 consumes the wire format natively
+        else:
+            x = features.astype(dtype_of(self.spec.compute_dtype))
+        x = MLPTrunk(spec=self.spec, wire=self.wire, name="trunk")(
+            x, train=train)
         return ScoringHead(spec=self.spec, name="head")(x)

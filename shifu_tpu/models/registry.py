@@ -22,25 +22,35 @@ def register(name: str):
     return deco
 
 
-def build_model(spec: ModelSpec, schema: DataSchema, mesh=None) -> nn.Module:
+def build_model(spec: ModelSpec, schema: DataSchema, mesh=None,
+                wire=None) -> nn.Module:
     """`mesh` (jax.sharding.Mesh) is forwarded to models that can exploit it
     (FT-Transformer sequence-parallel attention).  Every registered builder
     must accept (spec, schema, mesh=None) and may ignore the mesh.  Scoring/
     export paths pass no mesh and get the single-host local-attention
-    graph."""
+    graph.
+
+    `wire` is the int8 grid (scale_tuple, offset_tuple_or_None) from
+    data/pipeline.wire_params when the training loop feeds wire-format
+    int8 features into the model (train/step.wire_fused_into_model); the
+    MLP builder attaches it to layer 0 so dequantization fuses into the
+    first matmul.  Builders that never see wire inputs ignore it — the
+    param tree is unchanged either way."""
     try:
         builder = _BUILDERS[spec.model_type]
     except KeyError:
         raise KeyError(
             f"unknown model_type {spec.model_type!r}; available: {sorted(_BUILDERS)}") from None
+    if spec.model_type == "mlp" and wire is not None:
+        return builder(spec, schema, mesh=mesh, wire=wire)
     return builder(spec, schema, mesh=mesh)
 
 
 @register("mlp")
 def _build_mlp(spec: ModelSpec, schema: DataSchema,
-               mesh=None) -> nn.Module:
+               mesh=None, wire=None) -> nn.Module:
     from .mlp import ShifuMLP
-    return ShifuMLP(spec=spec)
+    return ShifuMLP(spec=spec, wire=wire)
 
 
 @register("wide_deep")

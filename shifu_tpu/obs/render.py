@@ -289,7 +289,8 @@ def profile_summary(path: str) -> Optional[dict]:
                                     "prefetch_depth", "input_exposed_s",
                                     "input_production_s", "input_hidden_s",
                                     "eval_s", "prefetched_chunks",
-                                    "overlap_efficiency", "order_digest")})
+                                    "overlap_efficiency", "order_digest",
+                                    "resident_format")})
         elif kind == "xla_compile":
             fn = str(rec.get("fn", "?"))
             c = compiles.setdefault(fn, {"compiles": 0, "compile_s": 0.0,
@@ -479,7 +480,9 @@ def render_profile_text(summary: dict) -> str:
             eeff = e.get("overlap_efficiency")
             lines.append(
                 f"  epoch {e.get('epoch')}: tier={e.get('tier')} "
-                f"depth={e.get('prefetch_depth')} "
+                + (f"[{e['resident_format']}] "
+                   if e.get("resident_format") else "")
+                + f"depth={e.get('prefetch_depth')} "
                 f"hidden={e.get('input_hidden_s')}s "
                 f"exposed={e.get('input_exposed_s')}s "
                 f"eval={e.get('eval_s')}s "

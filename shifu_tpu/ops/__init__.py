@@ -3,6 +3,8 @@ from .initializers import bias_init, xavier_bias, xavier_uniform
 from .losses import bce, get_loss, l2_penalty, multitask_loss, weighted_bce, weighted_mse
 from .metrics import auc, weighted_error
 from .pallas_attention import flash_attention
+from .pallas_ft_block import fused_block_engaged, fused_transformer_block
+from .pallas_int8_matmul import int8_matmul_dequant
 
 __all__ = [
     "get_activation",
@@ -19,4 +21,7 @@ __all__ = [
     "auc",
     "weighted_error",
     "flash_attention",
+    "fused_block_engaged",
+    "fused_transformer_block",
+    "int8_matmul_dequant",
 ]

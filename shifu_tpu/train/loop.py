@@ -90,7 +90,17 @@ def init_state(job: JobConfig, num_features: int,
                 f"batch_size ({bs}) must be divisible by pipeline "
                 f"microbatches ({n_micro}) x data axis ({n_data}); "
                 f"use a multiple of {n_micro * n_data}")
-    model = build_model(job.model, job.schema, mesh)
+    wire = None
+    from .step import wire_fused_into_model
+    if wire_fused_into_model(job):
+        # int8 features reach the model natively: attach the static wire
+        # grid so layer 0 fuses the dequant into its matmul
+        # (models/base._WireDense); param tree and init values are
+        # identical to the unfused build
+        scale, offset = pipe.wire_params(job.schema, job.data)
+        wire = (tuple(float(v) for v in scale),
+                tuple(float(v) for v in offset) if np.any(offset) else None)
+    model = build_model(job.model, job.schema, mesh, wire=wire)
     tx = build_optimizer(job.train.optimizer)
     rng = jax.random.PRNGKey(job.train.seed)
     # init batch must divide the data axis: a mesh-aware model (sequence-
@@ -754,10 +764,15 @@ def train(job: JobConfig,
         # rows_for_blocks prefix — a host deciding from its raw local shard
         # size could pick a different tier and deadlock the collectives
         feat_row_bytes = train_ds.features.nbytes // max(train_ds.num_rows, 1)
+        # the resident tier's budget check sizes against its IN-HBM format
+        # (resident_format=int8 quarters it even under a wider wire); for
+        # "auto"/"wire" this is exactly the wire mode as before
+        rfmt = pipe.resident_feature_format(job.schema, job.data,
+                                            job.model.compute_dtype)
         if train_ds.features.dtype == np.float32:
-            if wmode == "int8":
+            if rfmt == "int8":
                 feat_row_bytes //= 4  # int8 on device
-            elif wmode == "bfloat16":
+            elif rfmt == "bfloat16":
                 feat_row_bytes //= 2  # bf16 on device (loader may pre-cast)
         tgt_row_bytes = train_ds.target.nbytes // max(train_ds.num_rows, 1)
         if label_ok:
@@ -792,8 +807,20 @@ def train(job: JobConfig,
             host_blocks = {"features": stack(train_ds.features),
                            "target": stack(train_ds.target),
                            "weight": stack(train_ds.weight)}
+            raw_features = host_blocks["features"]
             if wcast is not None:
                 host_blocks = wcast(host_blocks)
+            if (rfmt == "int8"
+                    and host_blocks["features"].dtype != np.int8):
+                # forced int8 residency under a wider wire: quantize the
+                # stacked blocks once to the same static grid the int8
+                # wire uses — from the RAW features, not the wire-cast
+                # ones (a bf16 wire cast first would shift values across
+                # int8 buckets and break parity with the int8-wire run)
+                scale, offset = pipe.wire_params(job.schema, job.data)
+                host_blocks = dict(host_blocks)
+                host_blocks["features"] = pipe.wire_quantize(
+                    raw_features, scale, offset)
             if multihost:
                 resident_blocks = shard_lib.shard_blocks_process_local(
                     host_blocks, mesh)
@@ -1412,7 +1439,11 @@ def train(job: JobConfig,
                                      if feeder is not None else 0),
                   overlap_efficiency=(round(eff, 4) if eff is not None
                                       else None),
-                  order_digest=order_digest)
+                  order_digest=order_digest,
+                  resident_format=(
+                      pipe.resident_feature_format(
+                          job.schema, job.data, job.model.compute_dtype)
+                      if use_resident else None))
         hid_c = obs.counter("overlap_hidden_seconds_total",
                             "input seconds hidden behind device compute "
                             "by the overlap engine")

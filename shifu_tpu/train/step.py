@@ -27,17 +27,47 @@ from .train_state import TrainState
 Batch = dict[str, jax.Array]
 
 
+def wire_fused_into_model(job: JobConfig) -> bool:
+    """True when the model consumes int8 wire features NATIVELY — its first
+    layer applies the wire grid inside the matmul (models/base._WireDense
+    over ops/pallas_int8_matmul) — so the step builders must skip the
+    separate decode dispatch entirely.  Requires: int8 features actually
+    reach the device (int8 wire or an int8-resident tier), a model whose
+    first layer is the wire-capable dense (the MLP ladder), and the fused
+    kernel engaged on this platform/shape.  Anywhere this is False the
+    decode path runs exactly as before — the bit-identical fallback."""
+    from ..data import pipeline as pipe
+    from ..ops.pallas_int8_matmul import fused_engaged
+
+    if job.model.model_type != "mlp" or not job.model.hidden_nodes:
+        return False
+    cdt = job.model.compute_dtype
+    if (pipe.wire_mode(job.schema, job.data, cdt) != "int8"
+            and pipe.resident_feature_format(job.schema, job.data,
+                                             cdt) != "int8"):
+        return False
+    return fused_engaged(job.schema.feature_count, job.model.hidden_nodes[0])
+
+
 def make_wire_decode(job: JobConfig):
     """On-device inverse of the int8 wire quantization (x = q*scale +
     offset, computed in f32 before the model's own compute-dtype cast), or
-    None when the job's wire format is not int8.  The grid is the same
-    static per-column one the host encoded with (data/pipeline.wire_params),
-    so decode needs no data-dependent state — it closes over two (F,)
-    constants and fuses into the first layer's HLO."""
+    None when no int8 features ever reach the device (neither the wire nor
+    the resident tier's in-HBM format is int8) — composing an identity op
+    into every step just wastes a dispatch.  Also None when the model
+    consumes the wire natively (wire_fused_into_model): the first-layer
+    kernel applies the grid itself.  The grid is the same static per-column
+    one the host encoded with (data/pipeline.wire_params), so decode needs
+    no data-dependent state — it closes over two (F,) constants and fuses
+    into the first layer's HLO."""
     from ..data import pipeline as pipe
 
-    if pipe.wire_mode(job.schema, job.data,
-                      job.model.compute_dtype) != "int8":
+    cdt = job.model.compute_dtype
+    if (pipe.wire_mode(job.schema, job.data, cdt) != "int8"
+            and pipe.resident_feature_format(job.schema, job.data,
+                                             cdt) != "int8"):
+        return None
+    if wire_fused_into_model(job):
         return None
     scale, offset = pipe.wire_params(job.schema, job.data)
     s = jnp.asarray(scale)

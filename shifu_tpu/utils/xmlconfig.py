@@ -89,6 +89,13 @@ KEY_DATA_WIRE_INT8_CLIP = "shifu.data.wire-int8-clip"
 # auto/elide/float32 (DataConfig.wire_label_dtype / wire_weight_mode)
 KEY_DATA_WIRE_LABEL_DTYPE = "shifu.data.wire-label-dtype"
 KEY_DATA_WIRE_WEIGHT_MODE = "shifu.data.wire-weight-mode"
+# in-HBM format of the device-resident tier: auto / wire / int8
+# (DataConfig.resident_format; int8 quantizes resident feature blocks to
+# the wire_params grid — ops/pallas_int8_matmul fuses the dequant)
+KEY_DATA_RESIDENT_FORMAT = "shifu.data.resident-format"
+# fused transformer block for ft_transformer: auto / on / off
+# (ModelSpec.fused_block, ops/pallas_ft_block)
+KEY_MODEL_FUSED_BLOCK = "shifu.model.fused-block"
 # host-side input-feeder queue depth (DataConfig.prefetch_depth; 0 = auto —
 # resized per epoch from the goodput ledger's exposed-input measurement)
 KEY_DATA_PREFETCH_DEPTH = "shifu.data.prefetch-depth"
@@ -333,6 +340,11 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         data = dataclasses.replace(
             data,
             wire_weight_mode=conf[KEY_DATA_WIRE_WEIGHT_MODE].strip().lower())
+    if KEY_DATA_RESIDENT_FORMAT in conf:
+        import dataclasses
+        data = dataclasses.replace(
+            data,
+            resident_format=conf[KEY_DATA_RESIDENT_FORMAT].strip().lower())
     if KEY_DATA_PREFETCH_DEPTH in conf:
         import dataclasses
         data = dataclasses.replace(
@@ -419,6 +431,11 @@ def apply_to_job(job: Any, conf: Mapping[str, str]) -> Any:
         runtime = dataclasses.replace(runtime, **rt_kw)
 
     extra_kw: dict[str, Any] = {}
+    if KEY_MODEL_FUSED_BLOCK in conf:
+        import dataclasses
+        extra_kw["model"] = dataclasses.replace(
+            job.model,
+            fused_block=conf[KEY_MODEL_FUSED_BLOCK].strip().lower())
     if obs_kw:
         # only touch `obs` when an obs key is actually set: job-shaped
         # stubs (and older serialized configs) without the field keep

@@ -309,7 +309,7 @@ def test_status_quick_summary_carries_goodput(tmp_path, monkeypatch):
 
 def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7,
               cold=300.0, hbm=1 << 30, serving=250_000.0,
-              serving_p99=6.0, sparse=1.3):
+              serving_p99=6.0, sparse=1.3, ft_mfu=0.31):
     return {"value": value, "unit": "samples/sec/chip",
             "goodput": {"goodput_fraction_mean": goodput_frac},
             "xla_compiles": {"total": compiles},
@@ -318,7 +318,8 @@ def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7,
             "device_hbm_peak_bytes": hbm,
             "serving_scores_per_sec": serving,
             "serving_p99_ms": serving_p99,
-            "ladder_deepfm_4mvocab_sparse_speedup": sparse}
+            "ladder_deepfm_4mvocab_sparse_speedup": sparse,
+            "ft_transformer_mfu": ft_mfu}
 
 
 @pytest.mark.perf
@@ -409,12 +410,44 @@ def test_perf_gate_fails_each_axis():
     # ratchets, it doesn't retroactively fail old scatter-path rounds)
     r = perf_gate.run_gate(_artifact(sparse=0.7), _artifact(sparse=0.7))
     assert r["verdict"] == "PASS"
+    # FT-Transformer MFU collapse (below the 0.25 floor the fused block
+    # ratcheted in, ISSUE 11): fusion silently disengaged
+    r = perf_gate.run_gate(_artifact(ft_mfu=0.06), base)
+    assert r["verdict"] == "REGRESSION"
+    assert [c for c in r["checks"]
+            if c["name"] == "ft_transformer_mfu"][0]["status"] \
+        == "REGRESSION"
+    # ...above the floor passes even below the baseline (floor-style)
+    r = perf_gate.run_gate(_artifact(ft_mfu=0.27), base)
+    assert r["verdict"] == "PASS"
+    # ...and a pre-fusion 0.058 baseline gates against itself
+    r = perf_gate.run_gate(_artifact(ft_mfu=0.058),
+                           _artifact(ft_mfu=0.058))
+    assert r["verdict"] == "PASS"
+    # e2e ceiling ratchet floor (ISSUE 11): a healthy 0.7 baseline holds
+    # the limit at the 0.5 floor, so a bleed to 0.45 fails even though
+    # it is within the 0.2 absolute drop...
+    r = perf_gate.run_gate(_artifact(ceiling=0.45), base)
+    assert r["verdict"] == "REGRESSION"
+    assert [c for c in r["checks"]
+            if c["name"] == "e2e_ceiling_fraction"][0]["status"] \
+        == "REGRESSION"
+    # ...while a degraded-host baseline (bench.py preflight stamp) keeps
+    # the drop-only limit (0.6 - 0.2 = 0.4, floor NOT applied): its
+    # fraction was measured on broken hardware and doesn't set a floor
+    r = perf_gate.run_gate(
+        _artifact(ceiling=0.45),
+        {**_artifact(ceiling=0.6), "degraded_accelerator": True})
+    assert r["verdict"] == "PASS"
+    # ...the same 0.6 baseline WITHOUT the stamp holds the 0.5 floor
+    r = perf_gate.run_gate(_artifact(ceiling=0.45), _artifact(ceiling=0.6))
+    assert r["verdict"] == "REGRESSION"
     # missing fields on either side SKIP, never fail — an artifact that
     # predates the device flight recorder (no device_hbm_peak_bytes)
     # still gates the axes it carries
     r = perf_gate.run_gate({"value": 100.0}, base)
     assert r["verdict"] == "PASS"
-    assert [c["status"] for c in r["checks"]] == ["OK"] + ["SKIP"] * 8
+    assert [c["status"] for c in r["checks"]] == ["OK"] + ["SKIP"] * 9
 
 
 @pytest.mark.perf
@@ -454,7 +487,7 @@ def test_perf_gate_cli_pass_fail_and_check_only(tmp_path):
     fresh_bad.write_text(json.dumps(
         _artifact(value=10.0, goodput_frac=0.1, compiles=100, ceiling=0.1,
                   cold=10.0, hbm=8 << 30, serving=10_000.0,
-                  serving_p99=90.0, sparse=0.5)))
+                  serving_p99=90.0, sparse=0.5, ft_mfu=0.05)))
 
     def run(*args):
         return subprocess.run([sys.executable, gate, *args],

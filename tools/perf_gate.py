@@ -3,7 +3,7 @@ BENCH baseline and exit nonzero on regression.
 
 The repo's first *enforceable* perf trajectory (ISSUE 3): every round the
 driver captures a `BENCH_r*.json`; this gate compares a freshly produced
-`bench_full.json` against the newest of those baselines on nine axes —
+`bench_full.json` against the newest of those baselines on ten axes —
 
 - **throughput / step time**: the headline resident-tier
   samples/sec/chip (`value`) must not fall below
@@ -54,6 +54,21 @@ driver captures a `BENCH_r*.json`; this gate compares a freshly produced
   field is already a same-run ratio: the engine's contract is "sparse
   must not lose" (1.0), ratcheting in once a baseline reaches it while
   pre-engine 0.7x baselines keep gating against themselves.
+- **FT-Transformer MFU**: `ft_transformer_mfu` (the fused
+  attention+FFN block's rung on the model ladder, ISSUE 11 — the
+  roofline push's figure of merit) must not fall below
+  `min(--ft-mfu-floor, baseline)` — the same ratchet-floor style as
+  the sparse axis: MFU is normalized by the part's peak (tunnel-drift-
+  immune), pre-fusion 0.058 baselines keep gating against themselves,
+  and once a fused round lands the floor holds.
+
+The e2e ceiling axis additionally carries a ratchet FLOOR
+(`--e2e-ceiling-floor`, default 0.5): once a non-degraded baseline
+records a healthy overlap fraction, the limit is
+`max(baseline - drop, min(floor, baseline))` — an absolute-drop-only
+limit would let the fraction bleed 0.2 per round forever.  Baselines
+stamped `degraded_accelerator` (bench.py's preflight) skip the floor:
+their fractions were measured on broken hardware.
 
 Checks whose fields are missing on either side are SKIPPED (pre-ledger
 baselines carry no goodput/compile fields; pre-flight-recorder ones no
@@ -149,7 +164,9 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
              hbm_factor: float = 1.5,
              serving_drop: float = 0.3,
              p99_factor: float = 3.0,
-             sparse_floor: float = 1.0) -> dict:
+             sparse_floor: float = 1.0,
+             ft_mfu_floor: float = 0.25,
+             e2e_ceiling_floor: float = 0.5) -> dict:
     """The comparison itself (pure — unit-tested on synthetic pairs).
     Returns {"checks": [...], "verdict": "PASS"|"REGRESSION"}."""
     checks: list[dict] = []
@@ -197,6 +214,14 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
         check("e2e_ceiling_fraction", fe, be, None, None)
     else:
         limit = be - e2e_ceiling_drop
+        if not baseline.get("degraded_accelerator"):
+            # ratchet floor (ISSUE 11): drop-only limits compound — 0.2
+            # bled per round walks any fraction to zero in N rounds.  A
+            # healthy baseline at/above the floor is held to the floor;
+            # below it, to itself.  Degraded-host baselines (bench.py's
+            # preflight stamp) measured their fraction on broken
+            # hardware and don't get to set one.
+            limit = max(limit, min(e2e_ceiling_floor, be))
         check("e2e_ceiling_fraction", fe, be, fe >= limit, round(limit, 4))
 
     # cold-ingest throughput: the end-to-end cold-start rate (first train
@@ -270,6 +295,23 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
         check("sparse_embed_speedup", fsp, bsp, fsp >= limit,
               round(limit, 2))
 
+    # FT-Transformer MFU: the fused-block rung's model-flop utilization
+    # (ISSUE 11's roofline push).  Ratchet-floor like the sparse axis:
+    # MFU is peak-normalized (drift-immune), so min(floor, baseline)
+    # lets the unfused 0.058 era gate against itself while any round
+    # whose baseline reached the floor is held there — a silently
+    # disengaged fusion (lost gate, dead kill-switch default) collapses
+    # the number back to unfused and fails here.  SKIP when either side
+    # predates the field.
+    fft = _num(fresh, "ft_transformer_mfu")
+    bft = _num(baseline, "ft_transformer_mfu")
+    if fft is None or bft is None or bft <= 0:
+        check("ft_transformer_mfu", fft, bft, None, None)
+    else:
+        limit = min(ft_mfu_floor, bft)
+        check("ft_transformer_mfu", fft, bft, fft >= limit,
+              round(limit, 4))
+
     regressed = [c for c in checks if c["status"] == "REGRESSION"]
     return {"checks": checks,
             "verdict": "REGRESSION" if regressed else "PASS"}
@@ -331,6 +373,15 @@ def main(argv=None) -> int:
                         "be >= min(this, baseline) (the sparse embedding "
                         "engine's A/B, ISSUE 10; SKIP when either side "
                         "lacks the field)")
+    p.add_argument("--ft-mfu-floor", type=float, default=0.25,
+                   help="fresh ft_transformer_mfu must be >= min(this, "
+                        "baseline) (the fused attention+FFN block's rung, "
+                        "ISSUE 11; SKIP when either side lacks the field)")
+    p.add_argument("--e2e-ceiling-floor", type=float, default=0.5,
+                   help="ratchet floor on e2e_cached_disk_fraction_of_"
+                        "ceiling: a non-degraded baseline at/above this "
+                        "holds the limit at the floor instead of "
+                        "baseline - drop (drop-only limits compound)")
     p.add_argument("--check-only", action="store_true",
                    help="tier-1 mode: missing/corrupt artifacts degrade to "
                         "a journaled warning and exit 0")
@@ -375,7 +426,9 @@ def main(argv=None) -> int:
                       hbm_factor=args.hbm_factor,
                       serving_drop=args.serving_drop,
                       p99_factor=args.p99_factor,
-                      sparse_floor=args.sparse_floor)
+                      sparse_floor=args.sparse_floor,
+                      ft_mfu_floor=args.ft_mfu_floor,
+                      e2e_ceiling_floor=args.e2e_ceiling_floor)
     report["fresh"] = args.fresh
     report["baseline"] = baseline_path
     _journal("perf_gate", verdict=report["verdict"],
