@@ -1104,6 +1104,45 @@ def main() -> None:
                     extras["serving_stage_breakdown"] = cap["stages"]
         except Exception as e:
             extras["serving_error"] = str(e)[:200]
+
+        # fleet rollup (ISSUE 12): a 2-member in-proc fleet on the SAME
+        # artifact, driven through the router's wire face at 2x the
+        # single-daemon capacity just measured.  The ratio
+        # fleet scores/s / (n_daemons x single capacity) is the scaling
+        # efficiency tools/perf_gate.py gates (--fleet-eff-floor): a
+        # serialized router, a lost connection pool, or head-of-line
+        # blocking collapses it toward 1/n while the single-daemon axis
+        # stays green.  Skipped when the capacity probe above found no
+        # sustainable rate (no denominator).
+        try:
+            if extras.get("serving_scores_per_sec"):
+                from shifu_tpu.config.schema import FleetConfig
+                from shifu_tpu.config.schema import ServingConfig as _SCfg
+                from shifu_tpu.runtime import fleet as fleet_mod
+                from shifu_tpu.runtime.router import RouterServer
+
+                single = float(extras["serving_scores_per_sec"])
+                n_fleet = 2
+                mgr = fleet_mod.FleetManager(
+                    export_dir,
+                    fleet=FleetConfig(n_daemons=n_fleet, standbys=0),
+                    serving=_SCfg(engine="numpy",
+                                  report_every_s=0.0)).start()
+                try:
+                    with RouterServer(mgr.router, manager=mgr) as rs:
+                        frep = loadtest_mod.run_loadtest(
+                            connect=f"{rs.host}:{rs.port}",
+                            rate=n_fleet * single, duration=1.0,
+                            senders=2 * n_fleet, seed=0)
+                finally:
+                    mgr.stop()
+                ach = float(frep.get("achieved_scores_per_sec") or 0.0)
+                extras["fleet_n_daemons"] = n_fleet
+                extras["fleet_scores_per_sec"] = round(ach, 1)
+                extras["fleet_scaling_efficiency"] = round(
+                    ach / (n_fleet * single), 4)
+        except Exception as e:
+            extras["fleet_error"] = str(e)[:200]
     except Exception:
         pass
 
@@ -1483,6 +1522,8 @@ _HEADLINE_OPTIONAL = (
     "score_single_row_per_sec_native_median",
     "serving_scores_per_sec",
     "serving_p99_ms",
+    "fleet_scaling_efficiency",
+    "fleet_scores_per_sec",
     "parse_rows_per_sec",
     "per_batch_dispatch_samples_per_sec_per_chip",
     "device_hbm_peak_bytes",

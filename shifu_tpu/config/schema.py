@@ -705,6 +705,104 @@ class ServingConfig:
                               f"{self.slo_burn_threshold}")
 
 
+@dataclass(frozen=True)
+class FleetConfig:
+    """Knobs for the serving fleet (`shifu-tpu fleet`, runtime/fleet.py —
+    docs/SERVING.md "Fleet").
+
+    XML spelling `shifu.fleet.*` (utils/xmlconfig.fleet_config_from_conf)
+    layers under CLI flags exactly like ServingConfig does.  The fleet is
+    the successor of the reference AM's container supervision: N scoring
+    daemons + hot-standby backups, heartbeat membership, a routing
+    front-end, and burn-rate-driven scale decisions."""
+
+    # active scoring daemons the manager keeps in rotation
+    n_daemons: int = 2
+    # pre-warmed hot standbys (loaded on the current artifact, wire
+    # server bound, OUT of rotation) promoted on a member failure
+    standbys: int = 1
+    # heartbeat cadence: every member writes a lease this often; a lease
+    # older than heartbeat_every_s * heartbeat_misses marks the member
+    # DOWN and triggers failover
+    heartbeat_every_s: float = 0.5
+    heartbeat_misses: int = 3
+    # router: per-request round-trip timeout before the one hedged retry
+    # to a healthy peer, and the connect timeout for (re)building a
+    # member connection
+    route_timeout_ms: float = 1000.0
+    connect_timeout_ms: float = 250.0
+    # overload shedding: a primary whose fast-window slo_burn_rate is at
+    # or above this routes around to the least-burned member
+    shed_burn: float = 1.0
+    # decorrelated-jitter reconnect backoff bounds for a member the
+    # router observed failing (same shape as fsio's retry ladder)
+    backoff_base_ms: float = 50.0
+    backoff_cap_ms: float = 2000.0
+    # scale loop: 0 disables; both burn windows must agree (fast AND
+    # slow >= scale_up_burn on the worst member -> spawn; fast AND slow
+    # <= scale_down_burn on every member -> retire) with a cooldown
+    # between decisions
+    scale_every_s: float = 0.0
+    scale_up_burn: float = 2.0
+    scale_down_burn: float = 0.25
+    scale_cooldown_s: float = 30.0
+    min_daemons: int = 1
+    max_daemons: int = 8
+    # consistent-ring virtual nodes per member (per-model routing)
+    vnodes: int = 32
+
+    @property
+    def heartbeat_ttl_s(self) -> float:
+        """Lease freshness bound: miss this many beats -> DOWN."""
+        return self.heartbeat_every_s * self.heartbeat_misses
+
+    def validate(self) -> None:
+        if self.n_daemons < 1:
+            raise ConfigError(f"fleet.n-daemons must be >= 1: "
+                              f"{self.n_daemons}")
+        if self.standbys < 0:
+            raise ConfigError(f"fleet.standbys must be >= 0: "
+                              f"{self.standbys}")
+        if self.heartbeat_every_s <= 0:
+            raise ConfigError("fleet.heartbeat-every-s must be > 0: "
+                              f"{self.heartbeat_every_s}")
+        if self.heartbeat_misses < 1:
+            raise ConfigError("fleet.heartbeat-misses must be >= 1: "
+                              f"{self.heartbeat_misses}")
+        if self.route_timeout_ms <= 0 or self.connect_timeout_ms <= 0:
+            raise ConfigError("fleet.route-timeout-ms and "
+                              "connect-timeout-ms must be > 0")
+        if self.shed_burn <= 0:
+            raise ConfigError(f"fleet.shed-burn must be > 0: "
+                              f"{self.shed_burn}")
+        if self.backoff_base_ms <= 0 \
+                or self.backoff_cap_ms < self.backoff_base_ms:
+            raise ConfigError(
+                "fleet backoff needs 0 < backoff-base-ms <= "
+                f"backoff-cap-ms: {self.backoff_base_ms}/"
+                f"{self.backoff_cap_ms}")
+        if self.scale_every_s < 0 or self.scale_cooldown_s < 0:
+            raise ConfigError("fleet.scale-every-s and scale-cooldown-s "
+                              "must be >= 0")
+        if self.scale_down_burn < 0 \
+                or self.scale_up_burn <= self.scale_down_burn:
+            raise ConfigError(
+                "fleet scale thresholds need 0 <= scale-down-burn < "
+                f"scale-up-burn: {self.scale_down_burn}/"
+                f"{self.scale_up_burn}")
+        if not (1 <= self.min_daemons <= self.max_daemons):
+            raise ConfigError(
+                "fleet daemon bounds need 1 <= min-daemons <= "
+                f"max-daemons: {self.min_daemons}/{self.max_daemons}")
+        if not (self.min_daemons <= self.n_daemons <= self.max_daemons):
+            raise ConfigError(
+                f"fleet.n-daemons ({self.n_daemons}) must sit within "
+                f"[min-daemons, max-daemons] = [{self.min_daemons}, "
+                f"{self.max_daemons}]")
+        if self.vnodes < 1:
+            raise ConfigError(f"fleet.vnodes must be >= 1: {self.vnodes}")
+
+
 # ---------------------------------------------------------------------------
 # Runtime / parallelism
 # ---------------------------------------------------------------------------

@@ -158,6 +158,33 @@ _RETRY_BASE_S = 0.1
 _RETRY_CAP_S = 5.0
 
 
+def _retry_deadline_s() -> float:
+    """Total wall-clock budget for ONE remote call's whole retry ladder
+    (attempt time + backoff sleeps).  A persistent fault under a raised
+    SHIFU_TPU_FS_RETRIES is otherwise unbounded per call — N shards x an
+    unbounded ladder wedges job startup for hours.  0 disables the cap."""
+    import os
+    try:
+        return max(0.0, float(os.environ.get(
+            "SHIFU_TPU_FS_RETRY_DEADLINE_S", "60")))
+    except ValueError:
+        return 60.0
+
+
+def _journal_exhausted(op_name: str, elapsed_s: float, attempts: int,
+                       deadline_s: float, reason: str) -> None:
+    """`fsio_retry_exhausted` journal record: which op gave up, after how
+    long and how many tries — the forensic line that separates "the fault
+    outlived the budget" from "the budget was too small"."""
+    try:
+        from .. import obs
+        obs.event("fsio_retry_exhausted", op=op_name or "op",
+                  elapsed_s=round(elapsed_s, 3), attempts=attempts,
+                  deadline_s=round(deadline_s, 3), reason=reason)
+    except Exception:
+        pass
+
+
 def _retry_transient(op, classify=None, op_name: str = ""):
     """Run `op()` retrying transient remote errors with decorrelated-jitter
     backoff (sleep ~ U[base, 3*prev], capped — AWS architecture blog's
@@ -175,6 +202,8 @@ def _retry_transient(op, classify=None, op_name: str = ""):
     import time
 
     attempts = _retry_attempts()
+    deadline_s = _retry_deadline_s()
+    t0 = time.monotonic()
     sleep_s = _RETRY_BASE_S
     for attempt in range(attempts):
         try:
@@ -195,6 +224,19 @@ def _retry_transient(op, classify=None, op_name: str = ""):
                 raise
             if attempt == attempts - 1:
                 _count_terminal(op_name, "exhausted")
+                _journal_exhausted(op_name, time.monotonic() - t0,
+                                   attempt + 1, deadline_s, "attempts")
+                raise
+            sleep_s = min(_RETRY_CAP_S,
+                          random.uniform(_RETRY_BASE_S, sleep_s * 3))
+            # total-deadline cap on the ladder: if the next sleep would
+            # overrun the per-call budget, surface the real error NOW —
+            # retrying past the deadline only delays the same failure
+            elapsed = time.monotonic() - t0
+            if deadline_s > 0 and elapsed + sleep_s > deadline_s:
+                _count_terminal(op_name, "deadline")
+                _journal_exhausted(op_name, elapsed, attempt + 1,
+                                   deadline_s, "deadline")
                 raise
             try:
                 from .. import obs
@@ -203,8 +245,6 @@ def _retry_transient(op, classify=None, op_name: str = ""):
                     op=op_name or "op")
             except Exception:
                 pass
-            sleep_s = min(_RETRY_CAP_S,
-                          random.uniform(_RETRY_BASE_S, sleep_s * 3))
             time.sleep(sleep_s)
     raise AssertionError("unreachable")
 

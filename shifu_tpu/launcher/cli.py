@@ -161,6 +161,11 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("--interval", type=float, default=2.0,
                     help="refresh seconds for the streaming view "
                          "(default 2)")
+    tp.add_argument("--stale-after", type=float, default=None,
+                    help="mark a daemon DOWN when its freshest signal "
+                         "(fleet lease or journal tail) is older than "
+                         "this many seconds (default: each member's own "
+                         "lease ttl when present, else never)")
     ch = sub.add_parser(
         "cache", help="inspect the columnar data cache: list entries "
                       "(tier/version/bytes/source) and prune superseded, "
@@ -248,6 +253,62 @@ def build_parser() -> argparse.ArgumentParser:
                          "(hot-loads a filesystem path as the model — "
                          "loopback binds allow it by default; see the "
                          "trust model in docs/SERVING.md)")
+    sv.add_argument("--heartbeat-s", type=float, default=0.0,
+                    help="write a fleet membership lease into the metrics "
+                         "dir every N seconds (0 = off; a FleetManager in "
+                         "another process reads it — docs/SERVING.md "
+                         "'Fleet')")
+    sv.add_argument("--heartbeat-misses", type=int, default=3,
+                    help="missed beats before the fleet marks this "
+                         "daemon DOWN (rides in the lease; default 3)")
+
+    fl = sub.add_parser(
+        "fleet", help="run a fault-tolerant serving fleet: N scoring "
+                      "daemons + hot standbys under heartbeat "
+                      "supervision, a consistent-hash routing front-end "
+                      "with hedged retries and overload shedding, "
+                      "fleet-wide hot-swap, burn-rate scale loop "
+                      "(runtime/fleet.py, docs/SERVING.md 'Fleet')")
+    fl.add_argument("model", help="artifact dir (the export output)")
+    fl.add_argument("--n-daemons", type=int, default=0,
+                    help="fleet members (default: shifu.fleet.n-daemons "
+                         "/ 2)")
+    fl.add_argument("--standbys", type=int, default=-1,
+                    help="hot-standby daemons pre-warmed on the current "
+                         "artifact (default: shifu.fleet.standbys / 1)")
+    fl.add_argument("--heartbeat-s", type=float, default=0,
+                    help="membership lease cadence (default: "
+                         "shifu.fleet.heartbeat-every-s / 0.5)")
+    fl.add_argument("--heartbeat-misses", type=int, default=0,
+                    help="missed beats before failover (default: "
+                         "shifu.fleet.heartbeat-misses / 3)")
+    fl.add_argument("--port", type=int, default=8571,
+                    help="router front-end TCP port (0 = ephemeral, "
+                         "printed at startup; default 8571)")
+    fl.add_argument("--host", default="127.0.0.1",
+                    help="router bind host (default 127.0.0.1)")
+    fl.add_argument("--engine", default=None,
+                    choices=["auto", "native", "numpy", "stablehlo",
+                             "jax"],
+                    help="member scoring engine tier")
+    fl.add_argument("--budget-ms", type=float, default=0,
+                    help="member micro-batcher latency budget "
+                         "(default: shifu.serving.latency-budget-ms / 2)")
+    fl.add_argument("--workers", type=int, default=0,
+                    help="scoring worker threads per member")
+    fl.add_argument("--scale-every-s", type=float, default=-1,
+                    help="burn-rate scale-loop cadence, 0 disables "
+                         "(default: shifu.fleet.scale-every-s / 0)")
+    fl.add_argument("--root-dir", default=None,
+                    help="fleet state dir for member leases + telemetry "
+                         "(default: <model>/fleet)")
+    fl.add_argument("--globalconfig", default=None,
+                    help="Hadoop-style XML carrying shifu.fleet.* and "
+                         "shifu.serving.* keys (flags override)")
+    fl.add_argument("--chaos-plan", default=None,
+                    help="fault-injection plan (fleet.heartbeat / "
+                         "fleet.route / runtime.serve sites, "
+                         "docs/ROBUSTNESS.md)")
 
     lt = sub.add_parser(
         "loadtest", help="open-loop (Poisson-arrival) load harness for "
@@ -1122,11 +1183,15 @@ def run_top(args) -> int:
     from ..obs import aggregate as obs_aggregate
     from ..obs import render as obs_render
 
+    stale_after = getattr(args, "stale_after", None)
+
     def frame() -> tuple:
         if len(args.job_dirs) > 1:
-            rollup = obs_aggregate.serving_rollup(args.job_dirs)
+            rollup = obs_aggregate.serving_rollup(
+                args.job_dirs, stale_after_s=stale_after)
             return rollup, obs_render.render_top_fleet_text(rollup)
-        summary = obs_render.top_summary(args.job_dirs[0])
+        summary = obs_render.top_summary(args.job_dirs[0],
+                                         stale_after_s=stale_after)
         if summary is None:
             return None, None
         return summary, obs_render.render_top_text(summary)
@@ -1402,9 +1467,77 @@ def run_serve(args) -> int:
         rc = serve_forever(args.model, config,
                            echo=lambda s: print(s, flush=True),
                            allow_swap=(True if getattr(args, "allow_swap",
-                                                       False) else None))
+                                                       False) else None),
+                           heartbeat_every_s=getattr(args, "heartbeat_s",
+                                                     0.0) or 0.0,
+                           heartbeat_misses=getattr(args,
+                                                    "heartbeat_misses",
+                                                    3))
     except (ValueError, OSError, KeyError, RuntimeError) as e:
         print(f"serve: {e}", file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    obs.flush()
+    return rc
+
+
+def run_fleet(args) -> int:
+    """`shifu-tpu fleet <artifact>`: N scoring daemons + hot standbys
+    under heartbeat supervision behind a hedging router front-end
+    (runtime/fleet.py, runtime/router.py, docs/SERVING.md 'Fleet')."""
+    import dataclasses
+
+    from .. import chaos, obs
+    from ..config.schema import ConfigError, FleetConfig
+    from ..data import fsio
+    from ..utils import xmlconfig
+
+    if getattr(args, "chaos_plan", None):
+        try:
+            base = chaos.load_plan(args.chaos_plan.strip())
+            os.environ[chaos.ENV_CHAOS_PLAN] = base.to_json(indent=None)
+            chaos.reload_from_env()
+        except chaos.ChaosPlanError as e:
+            print(f"chaos plan: {e}", file=sys.stderr, flush=True)
+            return EXIT_FAIL
+    fleet_cfg = FleetConfig()
+    if getattr(args, "globalconfig", None):
+        conf = xmlconfig.parse_configuration_xml(args.globalconfig)
+        fleet_cfg = xmlconfig.fleet_config_from_conf(conf, fleet_cfg)
+    kw = {}
+    if args.n_daemons > 0:
+        kw["n_daemons"] = args.n_daemons
+    if args.standbys >= 0:
+        kw["standbys"] = args.standbys
+    if args.heartbeat_s > 0:
+        kw["heartbeat_every_s"] = args.heartbeat_s
+    if args.heartbeat_misses > 0:
+        kw["heartbeat_misses"] = args.heartbeat_misses
+    if args.scale_every_s >= 0:
+        kw["scale_every_s"] = args.scale_every_s
+    if kw:
+        fleet_cfg = dataclasses.replace(fleet_cfg, **kw)
+    try:
+        fleet_cfg.validate()
+        serving = _serving_config(args)
+    except (ConfigError, ValueError) as e:
+        print(f"fleet: {e}", file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    metrics_dir = obs.resolve_metrics_dir() \
+        or fsio.join(args.model, "telemetry")
+    try:
+        obs.configure(metrics_dir)
+    except Exception:
+        pass  # telemetry must never block serving
+    root_dir = getattr(args, "root_dir", None) \
+        or fsio.join(args.model, "fleet")
+    from ..runtime.fleet import fleet_forever
+    try:
+        rc = fleet_forever(args.model, fleet=fleet_cfg, serving=serving,
+                           router_host=args.host, router_port=args.port,
+                           root_dir=root_dir,
+                           echo=lambda s: print(s, flush=True))
+    except (ValueError, OSError, KeyError, RuntimeError) as e:
+        print(f"fleet: {e}", file=sys.stderr, flush=True)
         return EXIT_FAIL
     obs.flush()
     return rc
@@ -1735,7 +1868,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     _apply_platform_env()
     args = build_parser().parse_args(argv)
     if args.command in ("train", "score", "eval", "export", "serve",
-                        "loadtest"):
+                        "loadtest", "fleet"):
         # repeat compiles (supervisor restarts, re-runs of the same job)
         # deserialize from the persistent cache instead of recompiling.
         # Only for commands that compile: status/attach/kill/provision are
@@ -1765,6 +1898,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_score(args)
     if args.command == "serve":
         return run_serve(args)
+    if args.command == "fleet":
+        return run_fleet(args)
     if args.command == "loadtest":
         return run_loadtest(args)
     if args.command == "eval":

@@ -130,22 +130,31 @@ def epoch_skew(epoch: int, input_seconds: float, epoch_seconds: float,
 # -- multi-daemon serving rollup (pod scale-out prep) ------------------------
 
 
-def serving_rollup(paths: list) -> dict:
+def serving_rollup(paths: list,
+                   stale_after_s: Optional[float] = None) -> dict:
     """Join N serving telemetry dirs into one fleet view — journal/scrape
     reads only (obs/render.top_summary per dir), no jax, no collectives:
     the rollup runs on any machine that can read the dirs, the serving
     analog of the training plane's host_skew table.
 
+    A daemon whose freshest signal (fleet lease or journal tail) is
+    older than `stale_after_s` — or than its own lease ttl — is DOWN:
+    excluded from the live rate / p99 / queue / alert totals (its last
+    frame is history, not throughput) and counted in `fleet.down`.
+
     Returns {"daemons": [per-dir top summaries + "dir"],
-    "fleet": {daemons, scores_per_sec (sum of live rates), worst_p99_ms,
-    queue_depth (sum), active_alerts, firing (objective names)}} —
-    rendered by `shifu-tpu top <dir> <dir> ...`
-    (render.render_top_fleet_text)."""
+    "fleet": {daemons, down, scores_per_sec (sum of live rates),
+    worst_p99_ms, queue_depth (sum), active_alerts,
+    firing (objective names)}} — rendered by
+    `shifu-tpu top <dir> <dir> ...` (render.render_top_fleet_text)."""
     from . import render
 
     daemons: list[dict] = []
     for p in paths:
-        s = render.top_summary(str(p))
+        try:
+            s = render.top_summary(str(p), stale_after_s=stale_after_s)
+        except Exception as e:  # noqa: BLE001 — one bad dir, not the view
+            s = {"error": f"{type(e).__name__}: {e}"[:200]}
         if s is None:
             s = {"dir": str(p), "error": "no telemetry journal"}
         else:
@@ -154,9 +163,13 @@ def serving_rollup(paths: list) -> dict:
     rates = []
     p99s = []
     queue = 0
+    down = 0
     active: list[dict] = []
     firing: set = set()
     for d in daemons:
+        if d.get("down"):
+            down += 1
+            continue  # a dead member's last frame is not live capacity
         sv = d.get("serving") or {}
         if isinstance(sv.get("scores_per_sec"), (int, float)):
             rates.append(sv["scores_per_sec"])
@@ -172,6 +185,7 @@ def serving_rollup(paths: list) -> dict:
         "daemons": daemons,
         "fleet": {
             "daemons": len(daemons),
+            "down": down,
             "scores_per_sec": round(sum(rates), 1) if rates else None,
             "worst_p99_ms": max(p99s) if p99s else None,
             "queue_depth": queue,

@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import time
 from typing import Optional
 
 from . import _sinks, journal as journal_mod
@@ -739,7 +740,21 @@ def _load_events_tail(jpath: str, tail_bytes: int = _TOP_TAIL_BYTES
     return events, len(events), truncated
 
 
-def top_summary(path: str) -> Optional[dict]:
+def _read_lease_nearby(journal_path: str) -> Optional[dict]:
+    """The fleet membership lease (runtime/fleet.py `lease.json`) next to
+    a journal, tolerantly: torn/absent/garbage is None — the top frame
+    then falls back to journal-event freshness alone."""
+    try:
+        with open(os.path.join(os.path.dirname(journal_path),
+                               "lease.json")) as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def top_summary(path: str,
+                stale_after_s: Optional[float] = None) -> Optional[dict]:
     """One `shifu-tpu top` frame for a job/telemetry dir: journal tail +
     scrape file ONLY (no jax import, bounded reads — safe to refresh
     against a live long-lived daemon).
@@ -750,7 +765,13 @@ def top_summary(path: str) -> Optional[dict]:
     events not yet resolved), and sampled `request_trace` / one-shot
     `device_profile` counts.  Train dirs render epoch progress, goodput /
     MFU, and the last event — ONE command tops both planes.  None when no
-    journal is found."""
+    journal is found.
+
+    Staleness: a dir whose freshest signal (fleet lease beat or last
+    journal event) is older than `stale_after_s` — or than the lease's
+    own ttl when a lease is present — gets `down: True` + `stale_s`
+    instead of rendering its last report as live forever (a killed
+    daemon must READ as dead, not as its final healthy frame)."""
     jpath = find_journal(path)
     if jpath is None:
         return None
@@ -797,6 +818,28 @@ def top_summary(path: str) -> Optional[dict]:
         out["last_event"] = {"kind": events[-1].get("kind"),
                              "ts": events[-1].get("ts")}
 
+    # staleness verdict: freshest of (lease beat, last event) vs the
+    # caller's threshold or the lease's self-declared ttl
+    lease = _read_lease_nearby(jpath)
+    now = time.time()
+    freshest: Optional[float] = None
+    for ts in ((lease or {}).get("ts"),
+               (out.get("last_event") or {}).get("ts")):
+        if isinstance(ts, (int, float)):
+            freshest = ts if freshest is None else max(freshest, ts)
+    threshold = stale_after_s
+    if threshold is None and lease is not None \
+            and isinstance(lease.get("ttl_s"), (int, float)):
+        threshold = float(lease["ttl_s"])
+    if lease is not None:
+        out["lease"] = {"member": lease.get("member"),
+                        "ttl_s": lease.get("ttl_s")}
+    if threshold is not None and threshold > 0 and freshest is not None:
+        age = max(0.0, now - freshest)
+        if age > threshold:
+            out["down"] = True
+            out["stale_s"] = round(age, 1)
+
     scrape = _read_scrape(jpath)
     if mode == "serving":
         last = reports[-1] if reports else {}
@@ -832,8 +875,14 @@ def top_summary(path: str) -> Optional[dict]:
             out["serving"]["path"] = serve_start.get("path")
             out["serving"]["port"] = serve_start.get("port")
         # stage decomposition from the scrape file's always-on histograms
+        # — a corrupt/truncated scrape must degrade to no breakdown, not
+        # kill the whole frame (the journal half already parsed fine)
         if scrape:
-            out["stages"] = _stage_breakdown_from_scrape(scrape)
+            try:
+                out["stages"] = _stage_breakdown_from_scrape(scrape)
+            except Exception:
+                out["stages"] = None
+                out["scrape_error"] = True
         # the daemon's own lifetime-windowed view wins when present (a
         # shared metrics dir can hold more than one daemon's histograms)
         if last.get("stages"):
@@ -915,6 +964,9 @@ def render_top_text(summary: dict) -> str:
     """One `shifu-tpu top` frame as text."""
     lines = [f"[{summary.get('mode')}] {summary['journal']} "
              f"({summary.get('events')} events)"]
+    if summary.get("down"):
+        lines.append(f"DOWN — no heartbeat/journal activity for "
+                     f"{summary.get('stale_s')}s (showing last frame)")
     sv = summary.get("serving")
     if sv:
         rate = sv.get("scores_per_sec")
@@ -1016,8 +1068,11 @@ def render_top_fleet_text(rollup: dict) -> str:
     """The multi-daemon `shifu-tpu top` frame (obs/aggregate.py
     serving_rollup): fleet totals + one row per daemon."""
     fleet = rollup.get("fleet") or {}
+    down = fleet.get("down") or 0
     lines = [
-        f"fleet: {fleet.get('daemons')} daemon(s)  rate "
+        f"fleet: {fleet.get('daemons')} daemon(s)"
+        + (f" ({down} DOWN)" if down else "")
+        + "  rate "
         + (f"{fleet['scores_per_sec']:,.0f}/s"
            if isinstance(fleet.get("scores_per_sec"), (int, float))
            else "-")
@@ -1029,6 +1084,14 @@ def render_top_fleet_text(rollup: dict) -> str:
         sv = d.get("serving") or {}
         active = (d.get("slo") or {}).get("active") or []
         rate = sv.get("scores_per_sec")
+        if d.get("down"):
+            # the stale-frame fix: a dead member renders DOWN with its
+            # lease age, never its last healthy numbers as if live
+            lines.append(
+                f"  {str(d.get('dir'))[-28:]:<28} "
+                f"{'-':>10} {'-':>8} {'-':>6} {len(active):>7} "
+                f"{'DOWN':>8}  (stale {d.get('stale_s')}s)")
+            continue
         lines.append(
             f"  {str(d.get('dir'))[-28:]:<28} "
             + (f"{rate:>10,.0f}" if isinstance(rate, (int, float))

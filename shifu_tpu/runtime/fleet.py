@@ -1,0 +1,759 @@
+"""Serving fleet: heartbeat membership, hot-standby failover, fleet-wide
+hot-swap, and burn-rate-driven scale decisions (docs/SERVING.md "Fleet").
+
+The production successor of the reference AM's container supervision
+(PAPER.md L2/L3: the AM heartbeats N worker containers and promotes
+pre-warmed hot-standby backups on failure).  Our unit is the scoring
+daemon (runtime/serve.py); the fleet plane adds:
+
+- **membership via leases** — every member runs a `Heartbeat` thread that
+  writes a small lease file in its telemetry dir each beat (through the
+  `fleet.heartbeat` chaos probe, so drills can silence a member without
+  killing it).  The manager's monitor marks a member DOWN after
+  `heartbeat_misses` missed beats and journals `fleet_failover` while
+  promoting a hot standby pre-warmed on the current artifact.
+- **fleet-wide hot-swap** — one export propagates through every member
+  (in-proc `daemon.swap`, or wire SWAP for socket members).  A member
+  whose swap fails is pulled from the router rotation (STALE) and
+  retried by the monitor until it catches up; once the swap barrier is
+  set, the router refuses members not on the target generation, so no
+  request is ever served by a stale version past the barrier.
+- **scale loop** — `decide_scale` closes the loop PR 8 opened: when the
+  fast AND slow burn windows agree (worst member's burn >= up threshold,
+  or every member <= down threshold), the manager promotes/spawns or
+  retires a member and journals `fleet_scale`.
+
+The routing front-end (consistent ring, hedged retry, overload shedding,
+reconnect backoff) lives in runtime/router.py; `shifu-tpu fleet` drives
+both.  Members are in-proc by default (each with its own loopback wire
+server — the tier-1 drill mode); `ProcessMember` spawns real
+`shifu-tpu serve` children through the launcher plane's process-group
+machinery (launcher/supervisor._kill_tree) for production hosts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from ..config.schema import FleetConfig, ServingConfig
+
+# the heartbeat probe: every beat passes here, so a chaos plan can
+# silence a member's lease (partition / wedged-reporter drill) without
+# touching its scoring path — the manager must then mark it DOWN and
+# fail over even though the daemon still answers (docs/ROBUSTNESS.md)
+HEARTBEAT_SITE = "fleet.heartbeat"
+LEASE_FILE = "lease.json"
+
+
+# -- leases ----------------------------------------------------------------
+
+
+def write_lease(lease_dir: str, member_id: str, seq: int,
+                ttl_s: float, pid: Optional[int] = None) -> str:
+    """Atomically write `<lease_dir>/lease.json` — the membership beat.
+    `ttl_s` rides IN the lease so any reader (serving_rollup, `top`)
+    knows this member's own staleness bound without extra config."""
+    path = os.path.join(lease_dir, LEASE_FILE)
+    tmp = path + ".tmp"
+    rec = {"member": member_id, "ts": round(time.time(), 3),
+           "seq": int(seq), "ttl_s": round(float(ttl_s), 3),
+           "pid": int(pid if pid is not None else os.getpid())}
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_lease(lease_dir: str) -> Optional[dict]:
+    """Tolerant lease read: a torn/garbage/absent lease is None, never an
+    exception — the monitor treats unreadable exactly like stale."""
+    try:
+        with open(os.path.join(lease_dir, LEASE_FILE)) as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def lease_age_s(lease: Optional[dict],
+                now: Optional[float] = None) -> Optional[float]:
+    if not lease or not isinstance(lease.get("ts"), (int, float)):
+        return None
+    return max(0.0, (time.time() if now is None else now)
+               - float(lease["ts"]))
+
+
+class Heartbeat:
+    """One member's lease writer: beats every `every_s` through the
+    `fleet.heartbeat` chaos probe.  An injected fault SKIPS the beat
+    (the lease ages — exactly what a partitioned/wedged member looks
+    like from the manager); the thread itself never dies from chaos."""
+
+    def __init__(self, lease_dir: str, member_id: str, every_s: float,
+                 ttl_s: float,
+                 is_alive: Optional[Callable[[], bool]] = None):
+        self._dir = lease_dir
+        self._member_id = member_id
+        self._every_s = every_s
+        self._ttl_s = ttl_s
+        self._is_alive = is_alive or (lambda: True)
+        self._stop = threading.Event()
+        self._seq = 0
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Heartbeat":
+        self.beat()  # first lease lands synchronously: a member is never
+        #              observed lease-less between spawn and first tick
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"fleet-heartbeat-{self._member_id}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Abrupt: no farewell beat — a killed member's lease must AGE,
+        not be refreshed on the way down."""
+        self._stop.set()
+
+    def beat(self) -> bool:
+        from .. import chaos
+        try:
+            chaos.maybe_fail(HEARTBEAT_SITE, member=self._member_id)
+            self._seq += 1
+            write_lease(self._dir, self._member_id, self._seq,
+                        self._ttl_s)
+            return True
+        except Exception:
+            # chaos (or a full/readonly disk) silenced this beat: the
+            # lease ages and the manager decides — the heartbeat thread
+            # must survive to beat again if the fault clears
+            return False
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._every_s):
+            if not self._is_alive():
+                return
+            self.beat()
+
+
+# -- members ---------------------------------------------------------------
+
+STATE_ACTIVE = "active"
+STATE_STANDBY = "standby"
+STATE_STALE = "stale"     # failed the fleet swap: out of rotation
+STATE_DOWN = "down"
+STATE_RETIRED = "retired"
+
+
+class FleetMember:
+    """One in-proc serving daemon under fleet management: its own
+    ScoringDaemon + loopback wire server + heartbeat lease.  `kill()` is
+    the SIGKILL analog for drills — no drain, no farewell beat."""
+
+    def __init__(self, member_id: str, export_dir: Optional[str], *,
+                 serving: ServingConfig, fleet: FleetConfig,
+                 tele_dir: str,
+                 loader: Optional[Callable] = None,
+                 model_id: str = "default"):
+        from . import serve, serve_wire
+
+        self.member_id = member_id
+        self.tele_dir = tele_dir
+        os.makedirs(tele_dir, exist_ok=True)
+        self.state = STATE_STANDBY
+        self.generation = 0
+        self.export_dir = export_dir
+        self._fleet = fleet
+        registry = serve.ModelRegistry(loader=loader) if loader else None
+        if registry is not None and export_dir is not None:
+            registry.load(export_dir, engine=serving.engine,
+                          model_id=model_id)
+            export_dir = None  # already loaded through the injected loader
+        self.daemon = serve.ScoringDaemon(
+            export_dir, config=serving, registry=registry,
+            model_id=model_id)
+        if registry is not None:
+            self.daemon._owns_registry = True  # the member built it
+        self.daemon.start()
+        self.server = serve_wire.ServeServer(
+            self.daemon, host="127.0.0.1", port=0).start()
+        self.host, self.port = self.server.host, self.server.port
+        self.heartbeat = Heartbeat(
+            tele_dir, member_id, fleet.heartbeat_every_s,
+            fleet.heartbeat_ttl_s,
+            is_alive=lambda: self.daemon._running).start()
+
+    @property
+    def version(self) -> Optional[int]:
+        handle = self.daemon._registry.current(self.daemon.model_id)
+        return handle.version if handle else None
+
+    def swap(self, export_dir: str,
+             engine: Optional[str] = None) -> dict:
+        return self.daemon.swap(export_dir, engine=engine)
+
+    def burns(self) -> list:
+        """[(burn_fast, burn_slow)] per SLO objective — the scale loop's
+        and router-shedding's signal; [] when SLO is disabled."""
+        eng = self.daemon._slo
+        if eng is None:
+            return []
+        return [(b.get("burn_fast", 0.0), b.get("burn_slow", 0.0))
+                for b in eng.state().get("burns", {}).values()]
+
+    def stats(self) -> dict:
+        return self.daemon.stats()
+
+    def kill(self) -> None:
+        """SIGKILL semantics for in-proc drills: the wire server closes
+        mid-connection, queued requests fail, the heartbeat stops with
+        NO farewell beat — the lease ages into the DOWN verdict.
+
+        Deliberately does NOT touch `self.state`: a process that dies
+        cannot update the manager's bookkeeping either — the DOWN
+        verdict belongs to the monitor's lease check (failover)."""
+        self.heartbeat.stop()
+        self.server.kill()   # sever live conns too — peers must see
+        self.daemon.kill()   # transport death, not app-error zombies
+
+    def stop(self) -> None:
+        """Graceful retire: drain the daemon, close the wire server."""
+        self.heartbeat.stop()
+        self.server.close()
+        self.daemon.stop()
+        self.state = STATE_RETIRED
+
+
+class ProcessMember:
+    """A fleet member as a real `shifu-tpu serve` child process — the
+    production spawn path, riding the launcher plane's process-group
+    teardown (launcher/supervisor._kill_tree).  The child writes its own
+    lease (`shifu-tpu serve --heartbeat-s`) into its telemetry dir, so
+    the manager's monitor reads it exactly like an in-proc member's."""
+
+    def __init__(self, member_id: str, export_dir: str, *,
+                 serving: ServingConfig, fleet: FleetConfig,
+                 tele_dir: str, port: int,
+                 python: Optional[str] = None):
+        import subprocess
+        import sys
+
+        self.member_id = member_id
+        self.tele_dir = tele_dir
+        os.makedirs(tele_dir, exist_ok=True)
+        self.state = STATE_STANDBY
+        self.generation = 0
+        self.export_dir = export_dir
+        self.host, self.port = serving.host, port
+        env = dict(os.environ)
+        env["SHIFU_TPU_METRICS_DIR"] = tele_dir
+        cmd = [python or sys.executable, "-m",
+               "shifu_tpu.launcher.cli", "serve", export_dir,
+               "--engine", serving.engine, "--port", str(port),
+               "--host", serving.host,
+               "--heartbeat-s", str(fleet.heartbeat_every_s),
+               "--heartbeat-misses", str(fleet.heartbeat_misses)]
+        # own session: retire/kill signals the whole tree, never just
+        # the CLI shim (launcher/supervisor.py's spawn contract)
+        self.proc = subprocess.Popen(cmd, env=env,
+                                     start_new_session=True)
+
+    @property
+    def version(self) -> Optional[int]:
+        try:
+            return self.stats().get("version")
+        except Exception:
+            return None
+
+    def _client(self):
+        from . import serve_wire
+        return serve_wire.ServeClient(self.host, self.port, timeout=5.0)
+
+    def swap(self, export_dir: str,
+             engine: Optional[str] = None) -> dict:
+        try:
+            with self._client() as c:
+                return c.swap(export_dir, engine=engine)
+        except Exception as e:  # noqa: BLE001 — degrade like daemon.swap
+            return {"ok": False,
+                    "error": f"{type(e).__name__}: {e}"[:300]}
+
+    def burns(self) -> list:
+        try:
+            slo = self.stats().get("slo") or {}
+            return [(b.get("burn_fast", 0.0), b.get("burn_slow", 0.0))
+                    for b in (slo.get("burns") or {}).values()]
+        except Exception:
+            return []
+
+    def stats(self) -> dict:
+        with self._client() as c:
+            return c.stats()
+
+    def kill(self) -> None:
+        # state bookkeeping stays with the manager — see FleetMember.kill
+        from ..launcher.supervisor import _kill_tree
+        _kill_tree(self.proc, sig=None)
+
+    def stop(self) -> None:
+        import signal
+
+        from ..launcher.supervisor import _kill_tree
+        _kill_tree(self.proc, sig=signal.SIGTERM)
+        self.state = STATE_RETIRED
+
+
+# -- scale decisions -------------------------------------------------------
+
+
+def decide_scale(burns: list, n_active: int, cfg: FleetConfig) -> str:
+    """"up" / "down" / "hold" from per-member (fast, slow) burn pairs —
+    pure, so the policy is unit-testable without a live fleet.
+
+    Both windows must AGREE (the PR 8 multiwindow rule lifted to fleet
+    scope): scale up when the worst member burns >= scale_up_burn on
+    fast AND slow (a fast-only spike is noise; a slow-only burn is
+    already recovering); scale down only when EVERY member is idle on
+    both windows."""
+    if not burns or n_active < 1:
+        return "hold"
+    worst_fast = max(f for f, _s in burns)
+    worst_slow = max(s for _f, s in burns)
+    if (worst_fast >= cfg.scale_up_burn
+            and worst_slow >= cfg.scale_up_burn
+            and n_active < cfg.max_daemons):
+        return "up"
+    if (worst_fast <= cfg.scale_down_burn
+            and worst_slow <= cfg.scale_down_burn
+            and n_active > cfg.min_daemons):
+        return "down"
+    return "hold"
+
+
+# -- the manager -----------------------------------------------------------
+
+
+class FleetManager:
+    """Spawns and supervises N members + hot standbys, owns the router
+    membership, runs the heartbeat monitor / swap-retry / scale loop.
+
+    In-proc members only here (`member_factory` swaps in ProcessMember
+    spawning for production); the drill-critical behaviors — lease
+    expiry -> failover -> standby promotion, fleet swap with straggler
+    quarantine + re-admission, burn-driven scale — are identical in both
+    modes because they only touch leases, the member protocol, and the
+    router table."""
+
+    def __init__(self, export_dir: str, *,
+                 fleet: Optional[FleetConfig] = None,
+                 serving: Optional[ServingConfig] = None,
+                 root_dir: Optional[str] = None,
+                 loader: Optional[Callable] = None,
+                 member_factory: Optional[Callable] = None,
+                 model_id: str = "default"):
+        import tempfile
+
+        from .router import FleetRouter
+
+        self.fleet = fleet or FleetConfig()
+        self.fleet.validate()
+        # per-member daemons inherit the serving config minus the wire
+        # bind (each member binds its own ephemeral loopback port)
+        base = serving or ServingConfig()
+        self.serving = dataclasses.replace(base, port=0)
+        self.export_dir = export_dir
+        self.model_id = model_id
+        self._loader = loader
+        self._factory = member_factory or self._spawn_inproc
+        self.root_dir = root_dir or tempfile.mkdtemp(prefix="fleet_")
+        self.router = FleetRouter(self.fleet)
+        self._lock = threading.RLock()
+        self.members: dict[str, FleetMember] = {}   # in rotation or stale
+        self.standbys: list[FleetMember] = []
+        self._next_id = 0
+        self._generation = 0
+        self._running = False
+        self._monitor_thread: Optional[threading.Thread] = None
+        self._last_scale_t = 0.0
+        self._failovers = 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "FleetManager":
+        from .. import obs
+
+        with self._lock:
+            if self._running:
+                return self
+            self._running = True
+            for _ in range(self.fleet.n_daemons):
+                m = self._spawn()
+                self._admit(m)
+            for _ in range(self.fleet.standbys):
+                self.standbys.append(self._spawn())
+        obs.event("fleet_start", n_daemons=self.fleet.n_daemons,
+                  standbys=self.fleet.standbys, path=self.export_dir,
+                  heartbeat_every_s=self.fleet.heartbeat_every_s,
+                  heartbeat_misses=self.fleet.heartbeat_misses)
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="fleet-monitor")
+        self._monitor_thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            self._running = False
+            members = list(self.members.values()) + list(self.standbys)
+            self.members.clear()
+            self.standbys.clear()
+        if self._monitor_thread is not None:
+            self._monitor_thread.join(timeout=5)
+        self.router.close()
+        for m in members:
+            if m.state not in (STATE_DOWN, STATE_RETIRED):
+                try:
+                    m.stop()
+                except Exception:
+                    pass
+
+    def __enter__(self) -> "FleetManager":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- membership ----------------------------------------------------
+
+    def _spawn_inproc(self, member_id: str, tele_dir: str) -> FleetMember:
+        return FleetMember(member_id, self.export_dir,
+                           serving=self.serving, fleet=self.fleet,
+                           tele_dir=tele_dir, loader=self._loader,
+                           model_id=self.model_id)
+
+    def _spawn(self):
+        with self._lock:
+            member_id = f"member-{self._next_id}"
+            self._next_id += 1
+        tele_dir = os.path.join(self.root_dir, member_id)
+        m = self._factory(member_id, tele_dir)
+        m.generation = self._generation
+        return m
+
+    def _admit(self, m) -> None:
+        """Into the membership table and router rotation (caller ensures
+        it is on the current generation)."""
+        m.state = STATE_ACTIVE
+        self.members[m.member_id] = m
+        self.router.add(m.member_id, m.host, m.port,
+                        generation=m.generation)
+
+    def member_dirs(self) -> list:
+        """Telemetry dirs of every member (active + standby + stale) —
+        the `serving_rollup` / `shifu-tpu top` fleet view's input."""
+        with self._lock:
+            return [m.tele_dir for m in self.members.values()] + \
+                   [m.tele_dir for m in self.standbys]
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "active": [mid for mid, m in self.members.items()
+                           if m.state == STATE_ACTIVE],
+                "stale": [mid for mid, m in self.members.items()
+                          if m.state == STATE_STALE],
+                "standbys": [m.member_id for m in self.standbys],
+                "generation": self._generation,
+                "failovers": self._failovers,
+            }
+
+    # -- heartbeat monitor + failover ----------------------------------
+
+    def _monitor_loop(self) -> None:
+        tick = self.fleet.heartbeat_every_s
+        while self._running:
+            time.sleep(min(tick, 0.2))
+            if not self._running:
+                return
+            try:
+                self.check_members()
+                self._retry_stale()
+                if self.fleet.scale_every_s > 0:
+                    now = time.monotonic()
+                    if now - self._last_scale_t \
+                            >= self.fleet.scale_every_s:
+                        self._last_scale_t = now
+                        self.scale_tick()
+            except Exception:
+                # the control plane must outlive any single bad tick
+                continue
+
+    def check_members(self) -> list:
+        """One monitor pass: expire leases, fail over.  Returns the
+        member ids failed over this pass (tests drive this directly)."""
+        ttl = self.fleet.heartbeat_ttl_s
+        now = time.time()
+        failed = []
+        with self._lock:
+            suspects = [m for m in self.members.values()
+                        if m.state == STATE_ACTIVE]
+        for m in suspects:
+            age = lease_age_s(read_lease(m.tele_dir), now=now)
+            if age is None or age > ttl:
+                self.failover(m, lease_age=age)
+                failed.append(m.member_id)
+        return failed
+
+    def failover(self, member, lease_age: Optional[float] = None) -> None:
+        """DOWN member out of rotation; a pre-warmed standby promoted in
+        its place — the reference AM's backup-worker takeover, journaled
+        as ONE `fleet_failover` event."""
+        from .. import obs
+
+        t0 = time.perf_counter()
+        with self._lock:
+            if self.members.get(member.member_id) is not member:
+                return  # already handled (monitor/drill race)
+            self.router.remove(member.member_id)
+            del self.members[member.member_id]
+            member.state = STATE_DOWN
+            standby = self.standbys.pop(0) if self.standbys else None
+            if standby is not None:
+                if standby.generation != self._generation:
+                    # a fleet swap landed while this standby idled:
+                    # catch it up BEFORE it takes traffic (the barrier
+                    # would refuse it anyway)
+                    r = standby.swap(self.export_dir)
+                    if r.get("ok"):
+                        standby.generation = self._generation
+                self.members[standby.member_id] = standby
+                self._admit(standby)
+            self._failovers += 1
+        obs.counter("fleet_failover_total",
+                    "members failed over after missed heartbeats").inc()
+        obs.event("fleet_failover", member=member.member_id,
+                  standby=standby.member_id if standby else None,
+                  lease_age_s=(round(lease_age, 3)
+                               if lease_age is not None else None),
+                  ttl_s=round(self.fleet.heartbeat_ttl_s, 3),
+                  promoted_in_s=round(time.perf_counter() - t0, 4))
+        try:
+            obs.flush()
+        except Exception:
+            pass
+        # reap the corpse AFTER journaling (a straggling wire teardown
+        # must never delay the fleet_failover record), then restore the
+        # standby pool so the NEXT failure also has a warm takeover
+        try:
+            if member.state == STATE_DOWN:
+                member.kill()
+        except Exception:
+            pass
+        if standby is not None and self._running:
+            try:
+                replacement = self._spawn()
+                with self._lock:
+                    if self._running:
+                        self.standbys.append(replacement)
+                    else:
+                        replacement.stop()
+            except Exception:
+                pass  # degraded: fleet serves on without a standby
+
+    # -- fleet-wide hot swap -------------------------------------------
+
+    def swap_fleet(self, export_dir: str,
+                   engine: Optional[str] = None) -> dict:
+        """One export -> every member (actives AND standbys, so a later
+        promotion is already current).  Failures quarantine the member
+        (STALE, out of rotation, journaled) and the monitor retries it;
+        the swap barrier then refuses any member still on the old
+        generation — after this returns, only new-version members serve.
+        """
+        from .. import obs
+
+        with self._lock:
+            self._generation += 1
+            gen = self._generation
+            self.export_dir = export_dir
+            targets = list(self.members.values()) + list(self.standbys)
+        swapped, failed = [], []
+        for m in targets:
+            r = m.swap(export_dir, engine=engine)
+            if r.get("ok"):
+                m.generation = gen
+                m.export_dir = export_dir
+                self.router.set_generation(m.member_id, gen)
+                swapped.append(m.member_id)
+            else:
+                failed.append({"member": m.member_id,
+                               "error": r.get("error")})
+                with self._lock:
+                    if m.member_id in self.members:
+                        m.state = STATE_STALE
+                        self.router.remove(m.member_id)
+                obs.event("fleet_swap_degraded", member=m.member_id,
+                          path=export_dir,
+                          error=str(r.get("error"))[:300])
+        # the barrier: from here the router refuses any member whose
+        # generation predates this swap — stragglers stay refused until
+        # the monitor's retry catches them up and re-admits them
+        self.router.set_barrier(gen)
+        obs.event("fleet_swap", path=export_dir, generation=gen,
+                  swapped=swapped,
+                  failed=[f["member"] for f in failed])
+        return {"ok": not failed, "generation": gen,
+                "swapped": swapped, "failed": failed}
+
+    def _retry_stale(self) -> list:
+        """Monitor leg: re-swap STALE members toward the current target;
+        success re-admits them behind the barrier (`fleet_readmit`)."""
+        from .. import obs
+
+        with self._lock:
+            stale = [m for m in self.members.values()
+                     if m.state == STATE_STALE]
+            target, gen = self.export_dir, self._generation
+        readmitted = []
+        for m in stale:
+            r = m.swap(target)
+            if not r.get("ok"):
+                continue
+            m.generation = gen
+            m.export_dir = target
+            with self._lock:
+                if self.members.get(m.member_id) is m:
+                    self._admit(m)
+                    self.router.set_generation(m.member_id, gen)
+            readmitted.append(m.member_id)
+            obs.event("fleet_readmit", member=m.member_id,
+                      generation=gen, path=target)
+        return readmitted
+
+    # -- scale loop ----------------------------------------------------
+
+    def scale_tick(self, burns: Optional[list] = None) -> str:
+        """One scale decision over the live members' burn pairs (or
+        injected `burns` — deterministic tests).  "up" promotes a
+        standby (or spawns fresh); "down" retires the least-burned
+        member.  Journals `fleet_scale` on every non-hold action."""
+        from .. import obs
+
+        with self._lock:
+            active = [m for m in self.members.values()
+                      if m.state == STATE_ACTIVE]
+        if burns is None:
+            burns = []
+            for m in active:
+                pairs = m.burns()
+                if pairs:
+                    burns.append((max(f for f, _ in pairs),
+                                  max(s for _, s in pairs)))
+        action = decide_scale(burns, len(active), self.fleet)
+        if action == "hold":
+            return action
+        n_before = len(active)
+        if action == "up":
+            with self._lock:
+                grown = self.standbys.pop(0) if self.standbys else None
+            if grown is None:
+                grown = self._spawn()
+            if grown.generation != self._generation:
+                r = grown.swap(self.export_dir)
+                if r.get("ok"):
+                    grown.generation = self._generation
+            with self._lock:
+                self.members[grown.member_id] = grown
+                self._admit(grown)
+                n_after = sum(1 for m in self.members.values()
+                              if m.state == STATE_ACTIVE)
+        else:
+            # retire the least-burned active member, gracefully: drain,
+            # don't drop — scale-down must never cost a request
+            victim = active[-1]
+            if burns and len(burns) == len(active):
+                victim = min(zip(burns, active),
+                             key=lambda p: p[0][0])[1]
+            with self._lock:
+                self.router.remove(victim.member_id)
+                self.members.pop(victim.member_id, None)
+                n_after = sum(1 for m in self.members.values()
+                              if m.state == STATE_ACTIVE)
+            try:
+                victim.stop()
+            except Exception:
+                pass
+        worst_fast = max((f for f, _ in burns), default=0.0)
+        worst_slow = max((s for _, s in burns), default=0.0)
+        obs.counter("fleet_scale_total",
+                    "burn-rate-driven fleet scale actions").inc(
+            action=action)
+        obs.event("fleet_scale", action=action, n_before=n_before,
+                  n_after=n_after, burn_fast=round(worst_fast, 4),
+                  burn_slow=round(worst_slow, 4))
+        return action
+
+    def push_burns(self) -> None:
+        """Feed each member's fast-window burn to the router (overload
+        shedding reads it) — monitor cadence in `shifu-tpu fleet`,
+        direct calls in tests."""
+        with self._lock:
+            active = [m for m in self.members.values()
+                      if m.state == STATE_ACTIVE]
+        for m in active:
+            pairs = m.burns()
+            if pairs:
+                self.router.set_burn(
+                    m.member_id, max(f for f, _ in pairs))
+
+
+def fleet_forever(export_dir: str, *, fleet: FleetConfig,
+                  serving: ServingConfig, router_host: str,
+                  router_port: int, root_dir: Optional[str] = None,
+                  echo=print) -> int:
+    """`shifu-tpu fleet` body: manager + router front-end until
+    SIGINT/SIGTERM.  Returns a process exit code."""
+    import signal
+
+    from .. import obs
+    from .router import RouterServer
+
+    manager = FleetManager(export_dir, fleet=fleet, serving=serving,
+                           root_dir=root_dir)
+    manager.start()
+    try:
+        front = RouterServer(manager.router, host=router_host,
+                             port=router_port, manager=manager).start()
+    except OSError:
+        manager.stop()
+        raise
+    stop_evt = threading.Event()
+
+    def _stop(signum, _frame):
+        echo(f"fleet: signal {signum} — draining")
+        stop_evt.set()
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, _stop)
+        except ValueError:
+            pass  # non-main thread (tests)
+    echo(f"fleet: {fleet.n_daemons} member(s) + {fleet.standbys} "
+         f"standby(s) on {front.host}:{front.port} "
+         f"(heartbeat {fleet.heartbeat_every_s}s x "
+         f"{fleet.heartbeat_misses}, artifact {export_dir})")
+    obs.event("fleet_serve_start", path=export_dir, port=front.port,
+              n_daemons=fleet.n_daemons, pid=os.getpid())
+    try:
+        while not stop_evt.wait(max(fleet.heartbeat_every_s, 0.5)):
+            manager.push_burns()
+    except KeyboardInterrupt:
+        pass
+    front.close()
+    manager.stop()
+    echo("fleet: stopped — " + json.dumps(manager.router.router_stats()))
+    return 0

@@ -29,6 +29,7 @@ highest one that still meets a p99 target.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Optional
@@ -230,16 +231,35 @@ def _run_socket(connect: str, *, rate: float, duration: float,
     lat_lists: list[list] = [[] for _ in range(senders)]
     err_counts = [0] * senders
     rej_counts = [0] * senders
+    reconnects = [0] * senders
     t_start = time.perf_counter() + 0.05
+    # a sender may reconnect until the schedule has fully played out
+    # (plus grace for the last round-trips): failover drills measure
+    # real drops, not a client that gave up on the first RST
+    t_give_up = t_start + (float(schedule[-1]) if n else 0.0) + 5.0
+
+    def _reconnect(deadline: float):
+        """Decorrelated-jitter reconnect: sleep uniform(base, last*3)
+        capped, retry until the deadline.  None = transport never came
+        back — only THEN does the remaining schedule count as errors."""
+        sleep_s = 0.0
+        while time.perf_counter() < deadline:
+            try:
+                return serve_wire.ServeClient(host, port)
+            except (ConnectionError, OSError):
+                sleep_s = min(0.5, random.uniform(0.02,
+                                                  max(0.02, sleep_s * 3)))
+                time.sleep(min(sleep_s,
+                               max(0.0, deadline - time.perf_counter())))
+        return None
 
     def sender(s: int) -> None:
         lats = lat_lists[s]
-        try:
-            # connect inside the accounting scope: a refused/reset
-            # connect must charge this sender's whole schedule as
-            # errors, not silently vanish with the thread
-            client = serve_wire.ServeClient(host, port)
-        except (ConnectionError, OSError):
+        # connect inside the accounting scope: a server that is never
+        # reachable within the whole schedule charges this sender's
+        # every request as an error, not a silent thread exit
+        client = _reconnect(t_give_up)
+        if client is None:
             err_counts[s] += len(range(s, n, senders))
             return
         try:
@@ -248,20 +268,31 @@ def _run_socket(connect: str, *, rate: float, duration: float,
                 dt = t_sched - time.perf_counter()
                 if dt > 0:
                     time.sleep(dt)  # see _run_inproc: never spin
-                try:
-                    client.score_rows(rows[k % n_unique][None, :])
-                    lats.append(time.perf_counter() - t_sched)
-                except serve_wire.WireOverload:
-                    rej_counts[s] += 1  # backpressure, like inproc mode
-                except serve_wire.WireError:
-                    err_counts[s] += 1  # per-request error frame: carry on
-                except (ConnectionError, OSError):
-                    # transport died (daemon restarted, socket reset):
-                    # charge every unsent request of this sender as an
-                    # error instead of silently abandoning the schedule
-                    err_counts[s] += 1 + len(range(k + senders, n,
-                                                   senders))
-                    return
+                sent = False
+                while not sent:
+                    try:
+                        client.score_rows(rows[k % n_unique][None, :])
+                        lats.append(time.perf_counter() - t_sched)
+                        sent = True
+                    except serve_wire.WireOverload:
+                        rej_counts[s] += 1  # backpressure, like inproc
+                        sent = True
+                    except serve_wire.WireError:
+                        err_counts[s] += 1  # per-request error: carry on
+                        sent = True
+                    except (ConnectionError, OSError):
+                        # transport died (daemon killed, socket reset):
+                        # reconnect with backoff and RETRY this request
+                        # — scoring is idempotent, and the whole point
+                        # of the drill is whether the fleet still
+                        # answers, not whether one TCP stream survived
+                        client.close()
+                        reconnects[s] += 1
+                        client = _reconnect(t_give_up)
+                        if client is None:
+                            err_counts[s] += 1 + len(
+                                range(k + senders, n, senders))
+                            return
         finally:
             client.close()
 
@@ -283,6 +314,7 @@ def _run_socket(connect: str, *, rate: float, duration: float,
         "completed": int(latencies.size),
         "rejected": sum(rej_counts),
         "errors": sum(err_counts),
+        "reconnects": sum(reconnects),
         "achieved_scores_per_sec": round(latencies.size / span, 1),
         "senders": senders,
         **_percentiles(latencies),

@@ -405,6 +405,23 @@ class ScoringDaemon:
         if self._owns_registry:
             self._registry.close()
 
+    def kill(self) -> None:
+        """SIGKILL semantics for fault drills (runtime/fleet.py): no
+        drain — admission slams shut, queued futures fail immediately,
+        worker threads are abandoned (daemon threads; they exit on their
+        next queue check).  The registry is left open: a racing worker
+        may still hold a handle, and the process-death analog never runs
+        destructors anyway."""
+        with self._cond:
+            self._accepting = False
+            self._running = False
+            leftovers, self._queue = self._queue, []
+            self._cond.notify_all()
+        for _row, _t, fut, _te, _ts in leftovers:
+            if fut is not None:
+                fut.set_exception(RuntimeError("serving daemon killed"))
+        self._threads.clear()
+
     def __enter__(self) -> "ScoringDaemon":
         return self.start()
 
@@ -907,15 +924,31 @@ class ScoringDaemon:
 
 
 def serve_forever(export_dir: str, config: ServingConfig,
-                  echo=print, allow_swap: Optional[bool] = None) -> int:
+                  echo=print, allow_swap: Optional[bool] = None,
+                  heartbeat_every_s: float = 0.0,
+                  heartbeat_misses: int = 3) -> int:
     """`shifu-tpu serve` body: daemon + wire server until SIGINT/SIGTERM.
-    Returns a process exit code."""
+    Returns a process exit code.
+
+    `heartbeat_every_s > 0` writes a fleet membership lease into the
+    metrics dir each beat (runtime/fleet.py) — how a process-mode member
+    proves liveness to a FleetManager in another process."""
     import signal
 
     from . import serve_wire
 
     daemon = ScoringDaemon(export_dir, config=config)
     daemon.start()
+    heartbeat = None
+    if heartbeat_every_s > 0:
+        from .. import obs
+        from .fleet import Heartbeat
+        lease_dir = obs.resolve_metrics_dir()
+        if lease_dir:
+            heartbeat = Heartbeat(
+                lease_dir, f"serve-{os.getpid()}", heartbeat_every_s,
+                heartbeat_every_s * max(1, heartbeat_misses),
+                is_alive=lambda: daemon._running).start()
     try:
         server = serve_wire.ServeServer(daemon, host=config.host,
                                         port=config.port,
@@ -949,6 +982,8 @@ def serve_forever(export_dir: str, config: ServingConfig,
         stop_evt.wait()
     except KeyboardInterrupt:
         pass
+    if heartbeat is not None:
+        heartbeat.stop()
     server.close()
     daemon.stop()
     stats = daemon.stats()

@@ -180,6 +180,10 @@ class ServeServer:
         self.host, self.port = self._listener.getsockname()[:2]
         self._accept_thread: Optional[threading.Thread] = None
         self._closing = False
+        # live accepted connections, for kill(): a graceful close lets
+        # in-flight frames finish, but SIGKILL semantics must sever them
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
     def start(self) -> "ServeServer":
         self._accept_thread = threading.Thread(
@@ -190,11 +194,37 @@ class ServeServer:
     def close(self) -> None:
         self._closing = True
         try:
+            # shutdown BEFORE close: merely closing the fd does not wake
+            # a thread blocked in accept() on Linux — the join below
+            # would stall its full timeout on every daemon teardown
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._listener.close()
         except OSError:
             pass
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
+
+    def kill(self) -> None:
+        """Process-death analog for fault drills (runtime/fleet.py): a
+        SIGKILL'd process drops every TCP connection it holds, so the
+        in-proc kill severs live connections too — peers must observe
+        transport death (and hedge/reconnect), not a zombie that keeps
+        answering application errors on already-accepted sockets."""
+        self.close()
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     # idle connections are reaped after this long without a frame —
     # bounds the threads/fds a stalled or half-frame client can pin
@@ -211,30 +241,37 @@ class ServeServer:
                 continue          # server must not die silently
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn.settimeout(self.IDLE_TIMEOUT_S)
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True, name="serve-conn").start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        with conn:
-            while True:
-                try:
-                    frame = read_request(conn)
-                except (ConnectionError, OSError):
-                    return
-                except WireError as e:
+        try:
+            with conn:
+                while True:
                     try:
-                        write_response(conn, 1, str(e).encode())
-                    except OSError:
-                        pass
-                    return  # framing lost — drop the connection
-                # arrival stamps at frame receipt: decode + admission ride
-                # the request's `admission` lifecycle stage (obs/slo.py)
-                # instead of vanishing between socket and daemon
-                t_arrival = time.perf_counter()
-                try:
-                    self._handle(conn, t_arrival, *frame)
-                except (ConnectionError, OSError):
-                    return
+                        frame = read_request(conn)
+                    except (ConnectionError, OSError):
+                        return
+                    except WireError as e:
+                        try:
+                            write_response(conn, 1, str(e).encode())
+                        except OSError:
+                            pass
+                        return  # framing lost — drop the connection
+                    # arrival stamps at frame receipt: decode + admission
+                    # ride the request's `admission` lifecycle stage
+                    # (obs/slo.py) instead of vanishing between socket
+                    # and daemon
+                    t_arrival = time.perf_counter()
+                    try:
+                        self._handle(conn, t_arrival, *frame)
+                    except (ConnectionError, OSError):
+                        return
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     def _handle(self, conn, t_arrival, op, dtype, n_rows, n_cols, scale,
                 offset, payload) -> None:

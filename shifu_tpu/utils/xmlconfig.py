@@ -147,6 +147,26 @@ KEY_SERVING_SLO_AVAILABILITY = "shifu.serving.slo.availability"
 KEY_SERVING_SLO_FAST_WINDOW_S = "shifu.serving.slo.fast-window-s"
 KEY_SERVING_SLO_SLOW_WINDOW_S = "shifu.serving.slo.slow-window-s"
 KEY_SERVING_SLO_BURN_THRESHOLD = "shifu.serving.slo.burn-threshold"
+# serving fleet (FleetConfig — runtime/fleet.py, docs/SERVING.md "Fleet"):
+# member/standby counts, heartbeat lease cadence + miss tolerance, the
+# router's per-request/connect timeouts + reconnect backoff + overload
+# shed threshold, and the burn-rate scale loop's windows and bounds
+KEY_FLEET_N_DAEMONS = "shifu.fleet.n-daemons"
+KEY_FLEET_STANDBYS = "shifu.fleet.standbys"
+KEY_FLEET_HEARTBEAT_EVERY_S = "shifu.fleet.heartbeat-every-s"
+KEY_FLEET_HEARTBEAT_MISSES = "shifu.fleet.heartbeat-misses"
+KEY_FLEET_ROUTE_TIMEOUT_MS = "shifu.fleet.route-timeout-ms"
+KEY_FLEET_CONNECT_TIMEOUT_MS = "shifu.fleet.connect-timeout-ms"
+KEY_FLEET_SHED_BURN = "shifu.fleet.shed-burn"
+KEY_FLEET_BACKOFF_BASE_MS = "shifu.fleet.backoff-base-ms"
+KEY_FLEET_BACKOFF_CAP_MS = "shifu.fleet.backoff-cap-ms"
+KEY_FLEET_SCALE_EVERY_S = "shifu.fleet.scale-every-s"
+KEY_FLEET_SCALE_UP_BURN = "shifu.fleet.scale-up-burn"
+KEY_FLEET_SCALE_DOWN_BURN = "shifu.fleet.scale-down-burn"
+KEY_FLEET_SCALE_COOLDOWN_S = "shifu.fleet.scale-cooldown-s"
+KEY_FLEET_MIN_DAEMONS = "shifu.fleet.min-daemons"
+KEY_FLEET_MAX_DAEMONS = "shifu.fleet.max-daemons"
+KEY_FLEET_VNODES = "shifu.fleet.vnodes"
 
 
 def parse_sharding_rules(value: str) -> tuple:
@@ -271,6 +291,41 @@ def serving_config_from_conf(conf: Mapping[str, str], base: Any = None) -> Any:
     if KEY_SERVING_SLO_BURN_THRESHOLD in conf:
         kw["slo_burn_threshold"] = float(
             conf[KEY_SERVING_SLO_BURN_THRESHOLD])
+    return dataclasses.replace(base, **kw) if kw else base
+
+
+def fleet_config_from_conf(conf: Mapping[str, str], base: Any = None) -> Any:
+    """FleetConfig from `shifu.fleet.*` keys over `base` (default: the
+    dataclass defaults) — `shifu-tpu fleet` layers CLI flags on top of
+    this exactly like serve does with serving_config_from_conf."""
+    import dataclasses
+
+    from ..config.schema import FleetConfig
+
+    base = base or FleetConfig()
+    kw: dict[str, Any] = {}
+    _int_keys = {KEY_FLEET_N_DAEMONS: "n_daemons",
+                 KEY_FLEET_STANDBYS: "standbys",
+                 KEY_FLEET_HEARTBEAT_MISSES: "heartbeat_misses",
+                 KEY_FLEET_MIN_DAEMONS: "min_daemons",
+                 KEY_FLEET_MAX_DAEMONS: "max_daemons",
+                 KEY_FLEET_VNODES: "vnodes"}
+    _float_keys = {KEY_FLEET_HEARTBEAT_EVERY_S: "heartbeat_every_s",
+                   KEY_FLEET_ROUTE_TIMEOUT_MS: "route_timeout_ms",
+                   KEY_FLEET_CONNECT_TIMEOUT_MS: "connect_timeout_ms",
+                   KEY_FLEET_SHED_BURN: "shed_burn",
+                   KEY_FLEET_BACKOFF_BASE_MS: "backoff_base_ms",
+                   KEY_FLEET_BACKOFF_CAP_MS: "backoff_cap_ms",
+                   KEY_FLEET_SCALE_EVERY_S: "scale_every_s",
+                   KEY_FLEET_SCALE_UP_BURN: "scale_up_burn",
+                   KEY_FLEET_SCALE_DOWN_BURN: "scale_down_burn",
+                   KEY_FLEET_SCALE_COOLDOWN_S: "scale_cooldown_s"}
+    for key, field in _int_keys.items():
+        if key in conf:
+            kw[field] = int(conf[key])
+    for key, field in _float_keys.items():
+        if key in conf:
+            kw[field] = float(conf[key])
     return dataclasses.replace(base, **kw) if kw else base
 
 

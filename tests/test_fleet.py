@@ -1,0 +1,508 @@
+"""Fleet-plane tests (runtime/fleet.py, runtime/router.py —
+docs/SERVING.md "Fleet", docs/ROBUSTNESS.md chaos catalog).
+
+Covers the ISSUE-12 acceptance drills as tier-1 in-proc tests:
+
+- the **kill drill**: SIGKILL-semantics on 1 of 3 members mid
+  open-loop load -> the loadtest finishes with zero errors, exactly
+  one `fleet_failover` journal event, and the hot standby serving
+  inside the heartbeat window;
+- the **hot-swap drill**: one export propagates to every member; a
+  member whose swap fails (chaos at `runtime.serve`) is pulled from
+  rotation, retried by the monitor, and re-admitted — and no request
+  is ever answered by the stale version past the swap barrier;
+- lease mechanics (atomic write / tolerant read / aging), the
+  `fleet.heartbeat` chaos probe (a silenced beat ages the lease, the
+  thread survives), deterministic lease-expiry failover, the
+  `fleet.route` chaos probe, the pure `decide_scale` policy, the
+  router's ring / barrier / shed / backoff behaviors, and
+  FleetConfig validation.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tpu import chaos, obs
+from shifu_tpu.chaos import plan as plan_mod
+from shifu_tpu.config.schema import ConfigError, FleetConfig, ServingConfig
+from shifu_tpu.runtime import fleet as fleet_mod
+from shifu_tpu.runtime import loadtest as loadtest_mod
+from shifu_tpu.runtime import serve_wire as wire_mod
+from shifu_tpu.runtime.fleet import (FleetManager, Heartbeat, decide_scale,
+                                     lease_age_s, read_lease, write_lease)
+from shifu_tpu.runtime.router import FleetRouter, NoHealthyMember, RouterServer
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_and_obs():
+    chaos.reset_for_tests()
+    obs.reset_for_tests()
+    yield
+    chaos.reset_for_tests()
+    obs.reset_for_tests()
+
+
+class _TagScorer:
+    """Stub engine whose score encodes the artifact version: scoring
+    `stub://vN` returns `row[0] + N` — swap drills read the served
+    version straight out of the wire answer."""
+
+    engine = "stub"
+    static_shapes = False
+    num_features = 4
+
+    def __init__(self, tag: float):
+        self.tag = tag
+
+    def compute_batch(self, rows, n_valid=None):
+        x = np.asarray(rows, np.float32)
+        return np.ascontiguousarray(x[:, :1] + self.tag)
+
+    def close(self):
+        pass
+
+
+def _tag_loader(path, _engine):
+    tag = 0.0
+    if "v" in path:
+        try:
+            tag = float(path.rsplit("v", 1)[-1])
+        except ValueError:
+            pass
+    return _TagScorer(tag)
+
+
+def _fleet_cfg(**kw) -> FleetConfig:
+    # 0.1s x 3 = 0.3s window: tight enough that the kill drill proves
+    # in-window promotion, loose enough that a GIL-loaded host never
+    # misses a HEALTHY member's beats (0.05s flakes under load)
+    base = dict(n_daemons=3, standbys=1,
+                heartbeat_every_s=0.1, heartbeat_misses=3)
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+def _serving_cfg(**kw) -> ServingConfig:
+    base = dict(engine="numpy", report_every_s=0.0)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def _mgr(tmp_path, export="stub://v0", **fleet_kw) -> FleetManager:
+    return FleetManager(export, fleet=_fleet_cfg(**fleet_kw),
+                        serving=_serving_cfg(),
+                        root_dir=str(tmp_path / "fleet"),
+                        loader=_tag_loader)
+
+
+def _events(tmp_path):
+    return obs.read_journal(str(tmp_path / "tele" / "journal.jsonl"))
+
+
+def _wait(pred, timeout=5.0, tick=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(tick)
+    return pred()
+
+
+# ------------------------------------------------------------------ leases
+
+
+def test_lease_roundtrip_and_age(tmp_path):
+    d = str(tmp_path)
+    write_lease(d, "member-0", seq=7, ttl_s=0.3)
+    rec = read_lease(d)
+    assert rec["member"] == "member-0"
+    assert rec["seq"] == 7
+    assert rec["ttl_s"] == 0.3
+    assert rec["pid"] == os.getpid()
+    age = lease_age_s(rec)
+    assert age is not None and 0.0 <= age < 5.0
+    # aging is relative to the recorded ts
+    assert lease_age_s(rec, now=rec["ts"] + 1.25) == pytest.approx(1.25)
+
+
+def test_lease_read_is_tolerant(tmp_path):
+    d = str(tmp_path)
+    assert read_lease(d) is None                       # absent
+    with open(os.path.join(d, fleet_mod.LEASE_FILE), "w") as f:
+        f.write('{"member": "m", "ts": 1.')            # torn mid-write
+    assert read_lease(d) is None
+    with open(os.path.join(d, fleet_mod.LEASE_FILE), "w") as f:
+        f.write('[1, 2]')                              # wrong shape
+    assert read_lease(d) is None
+    assert lease_age_s(None) is None
+    assert lease_age_s({"member": "m"}) is None        # no ts
+
+
+def test_heartbeat_beats_and_chaos_silences(tmp_path):
+    d = str(tmp_path)
+    hb = Heartbeat(d, "member-0", every_s=0.02, ttl_s=0.06)
+    hb.start()
+    try:
+        first = read_lease(d)
+        assert first is not None          # first beat lands synchronously
+        assert _wait(lambda: (read_lease(d) or {}).get("seq", 0)
+                     > first["seq"], timeout=2.0)
+        # chaos at fleet.heartbeat: the beat is SKIPPED (returns False,
+        # lease unchanged) but the thread survives to beat again
+        chaos.configure(plan_mod.parse_plan({"faults": [
+            {"site": fleet_mod.HEARTBEAT_SITE, "every": 1,
+             "action": "raise"}]}))
+        before = read_lease(d)
+        assert hb.beat() is False
+        assert read_lease(d) == before    # the lease aged, not refreshed
+        chaos.reset_for_tests()
+        assert hb.beat() is True          # fault cleared -> beats resume
+        assert read_lease(d)["seq"] == before["seq"] + 1
+    finally:
+        hb.stop()
+
+
+# ------------------------------------------------------------ scale policy
+
+
+def test_decide_scale_policy():
+    cfg = FleetConfig(scale_up_burn=2.0, scale_down_burn=0.25,
+                      min_daemons=1, max_daemons=4)
+    # both windows agree hot -> up
+    assert decide_scale([(3.0, 2.5)], 2, cfg) == "up"
+    # fast-only spike is noise; slow-only burn is already recovering
+    assert decide_scale([(3.0, 0.5)], 2, cfg) == "hold"
+    assert decide_scale([(0.5, 3.0)], 2, cfg) == "hold"
+    # every member idle on both windows -> down
+    assert decide_scale([(0.1, 0.1), (0.2, 0.05)], 2, cfg) == "down"
+    # one busy member blocks scale-down
+    assert decide_scale([(0.1, 0.1), (1.5, 1.5)], 2, cfg) == "hold"
+    # bounds: never above max, never below min, never without signal
+    assert decide_scale([(5.0, 5.0)], 4, cfg) == "hold"
+    assert decide_scale([(0.0, 0.0)], 1, cfg) == "hold"
+    assert decide_scale([], 2, cfg) == "hold"
+
+
+def test_fleet_config_validation():
+    FleetConfig().validate()
+    with pytest.raises(ConfigError):
+        FleetConfig(n_daemons=0).validate()
+    with pytest.raises(ConfigError):
+        FleetConfig(standbys=-1).validate()
+    with pytest.raises(ConfigError):
+        FleetConfig(heartbeat_every_s=0.0).validate()
+    with pytest.raises(ConfigError):
+        FleetConfig(heartbeat_misses=0).validate()
+    with pytest.raises(ConfigError):
+        FleetConfig(route_timeout_ms=0.0).validate()
+    with pytest.raises(ConfigError):
+        FleetConfig(backoff_cap_ms=1.0, backoff_base_ms=50.0).validate()
+    assert FleetConfig(heartbeat_every_s=0.5,
+                       heartbeat_misses=3).heartbeat_ttl_s \
+        == pytest.approx(1.5)
+
+
+# ----------------------------------------------------------------- router
+
+
+def test_router_ring_is_deterministic_and_rebalances():
+    r = FleetRouter(FleetConfig())
+    for mid in ("a", "b", "c"):
+        r.add(mid, "127.0.0.1", 1)
+    first = [m.member_id for m in r.candidates("model-x")]
+    assert sorted(first) == ["a", "b", "c"]
+    # same key -> same order, every time
+    assert [m.member_id for m in r.candidates("model-x")] == first
+    # removing a non-primary member keeps the primary stable
+    survivors = [mid for mid in ("a", "b", "c") if mid != first[-1]]
+    r.remove(first[-1])
+    assert [m.member_id for m in r.candidates("model-x")] \
+        == [mid for mid in first if mid in survivors]
+    r.close()
+
+
+def test_router_barrier_refuses_stale_generations():
+    r = FleetRouter(FleetConfig())
+    r.add("a", "127.0.0.1", 1, generation=0)
+    r.add("b", "127.0.0.1", 2, generation=1)
+    r.set_barrier(1)
+    cands = r.candidates("m")
+    assert [m.member_id for m in cands] == ["b"]
+    # catching a up re-admits it
+    r.set_generation("a", 1)
+    assert sorted(m.member_id for m in r.candidates("m")) == ["a", "b"]
+    # everyone stale -> no candidates -> NoHealthyMember on the wire path
+    r.set_barrier(2)
+    assert r.candidates("m") == []
+    with pytest.raises(NoHealthyMember):
+        r.score_rows(np.zeros((1, 4), np.float32))
+    r.close()
+
+
+def test_router_sheds_hot_primary_to_least_burned():
+    r = FleetRouter(FleetConfig(shed_burn=1.0))
+    for mid in ("a", "b", "c"):
+        r.add(mid, "127.0.0.1", 1)
+    order = [m.member_id for m in r.candidates("k")]
+    primary = order[0]
+    coolest = order[-1]
+    r.set_burn(primary, 2.0)          # over shed_burn
+    r.set_burn(order[1], 1.5)
+    r.set_burn(coolest, 0.1)
+    shed = [m.member_id for m in r.candidates("k")]
+    assert shed[0] == coolest          # least-burned moved to front
+    assert r.router_stats()["sheds"] >= 1
+    r.close()
+
+
+def test_router_backoff_is_decorrelated_and_expires():
+    b = fleet_mod.FleetConfig(backoff_base_ms=20.0, backoff_cap_ms=100.0)
+    r = FleetRouter(b)
+    r.add("a", "127.0.0.1", 1)
+    m = r._members["a"]
+    s1 = m.backoff.fail(now=100.0)
+    assert 0.02 <= s1 <= 0.1           # within [base, cap]
+    assert m.backoff.blocked(now=100.0 + s1 * 0.5)
+    assert not m.backoff.blocked(now=100.0 + 0.1 + 0.001)
+    # a success resets the ladder
+    m.backoff.ok()
+    assert not m.backoff.blocked(now=0.0)
+    # a backed-off member leaves candidate selection
+    m.backoff.fail()
+    assert r.candidates("k") == []
+    r.close()
+
+
+def test_route_chaos_site_fires(tmp_path):
+    """`fleet.route` drills the front-end independently of any member:
+    the injected fault surfaces to the caller and is journaled."""
+    obs.configure(str(tmp_path / "tele"))
+    from shifu_tpu.runtime import router as router_mod
+    chaos.configure(plan_mod.parse_plan({"faults": [
+        {"site": router_mod.ROUTE_SITE, "at_call": 1, "max_times": 1,
+         "action": "raise"}]}))
+    r = FleetRouter(FleetConfig())
+    r.add("a", "127.0.0.1", 1)
+    with pytest.raises(chaos.ChaosError):
+        r.score_rows(np.zeros((1, 4), np.float32))
+    obs.flush()
+    kinds = [e["kind"] for e in _events(tmp_path)]
+    assert "chaos_inject" in kinds
+    r.close()
+
+
+# ---------------------------------------------------- manager + failover
+
+
+def test_lease_expiry_failover_promotes_standby(tmp_path):
+    """Deterministic failover: age a member's lease by hand (a huge
+    heartbeat interval keeps the live threads out of the picture), then
+    drive the monitor pass directly."""
+    obs.configure(str(tmp_path / "tele"))
+    mgr = _mgr(tmp_path, heartbeat_every_s=30.0, n_daemons=2, standbys=1)
+    mgr.start()
+    try:
+        victim_id = sorted(mgr.members)[0]
+        victim = mgr.members[victim_id]
+        standby_id = mgr.standbys[0].member_id
+        # nothing stale yet: a healthy pass fails nobody over
+        assert mgr.check_members() == []
+        # rewrite the victim's lease with an ancient ts
+        rec = read_lease(victim.tele_dir)
+        rec["ts"] = rec["ts"] - 1000.0
+        with open(os.path.join(victim.tele_dir,
+                               fleet_mod.LEASE_FILE), "w") as f:
+            json.dump(rec, f)
+        failed = mgr.check_members()
+        assert failed == [victim_id]
+        summary = mgr.summary()
+        assert victim_id not in summary["active"]
+        assert standby_id in summary["active"]
+        assert summary["failovers"] == 1
+        assert victim_id not in mgr.router.member_ids()
+        # the standby pool is restored for the NEXT failure
+        assert _wait(lambda: len(mgr.summary()["standbys"]) == 1)
+        obs.flush()
+        evs = [e for e in _events(tmp_path) if e["kind"] == "fleet_failover"]
+        assert len(evs) == 1
+        assert evs[0]["member"] == victim_id
+        assert evs[0]["standby"] == standby_id
+        assert evs[0]["lease_age_s"] > evs[0]["ttl_s"]
+    finally:
+        mgr.stop()
+
+
+@pytest.mark.chaos
+def test_kill_drill_zero_errors_one_failover(tmp_path):
+    """The ISSUE-12 chaos drill: SIGKILL-semantics on 1 of 3 members in
+    the middle of an open-loop socket load.  The run must finish with
+    zero errors (hedged retry + reconnect-with-backoff absorb the
+    death), exactly one `fleet_failover`, at most one firing `slo_alert`
+    episode, and the standby serving inside the heartbeat window."""
+    obs.configure(str(tmp_path / "tele"))
+    mgr = _mgr(tmp_path)
+    mgr.start()
+    front = RouterServer(mgr.router, manager=mgr).start()
+    t_killed = [0.0]
+    try:
+        victim_id = sorted(mgr.members)[1]
+        victim = mgr.members[victim_id]
+
+        def _kill_later():
+            time.sleep(0.6)
+            t_killed[0] = time.monotonic()
+            victim.kill()
+
+        killer = threading.Thread(target=_kill_later)
+        killer.start()
+        report = loadtest_mod.run_loadtest(
+            connect=f"{front.host}:{front.port}",
+            rate=400.0, duration=2.0, senders=2, seed=7)
+        killer.join()
+        assert report["errors"] == 0, report
+        assert report["completed"] == report["submitted"]
+        assert "reconnects" in report   # the satellite-3 field
+        # the standby took over within the heartbeat window
+        assert _wait(lambda: mgr.summary()["failovers"] == 1, timeout=2.0)
+        t_detect = time.monotonic() - t_killed[0]
+        assert t_detect < 10 * mgr.fleet.heartbeat_ttl_s
+        summary = mgr.summary()
+        assert victim_id not in summary["active"]
+        assert len(summary["active"]) == 3
+        # the promoted member serves: one more routed score succeeds
+        out = mgr.router.score_rows(np.ones((1, 4), np.float32))
+        assert np.asarray(out).shape == (1, 1)
+        obs.flush()
+        evs = _events(tmp_path)
+        failovers = [e for e in evs if e["kind"] == "fleet_failover"]
+        assert len(failovers) == 1
+        assert failovers[0]["member"] == victim_id
+        firing = [e for e in evs if e["kind"] == "slo_alert"
+                  and e.get("state") == "firing"]
+        assert len(firing) <= 1
+    finally:
+        front.close()
+        mgr.stop()
+
+
+@pytest.mark.chaos
+def test_swap_drill_straggler_quarantined_then_readmitted(tmp_path):
+    """The ISSUE-12 hot-swap drill: one export -> every member; the
+    member whose swap fails (chaos at `runtime.serve`) is pulled from
+    rotation and re-admitted by the monitor's retry; no request is ever
+    served by the stale version past the barrier."""
+    obs.configure(str(tmp_path / "tele"))
+    mgr = _mgr(tmp_path)   # 3 members + 1 standby on stub://v0
+    mgr.start()
+    try:
+        members = sorted(mgr.members)
+        # second swap during the fan-out fails once; the monitor's retry
+        # then succeeds (max_times=1)
+        chaos.configure(plan_mod.parse_plan({"faults": [
+            {"site": "runtime.serve", "at_call": 2, "max_times": 1,
+             "action": "raise"}]}))
+        out = mgr.swap_fleet("stub://v1")
+        straggler = out["failed"][0]["member"]
+        assert out["ok"] is False
+        assert straggler == members[1]
+        assert straggler not in out["swapped"]
+        assert len(out["swapped"]) == 3   # 2 members + the standby
+        assert straggler in mgr.summary()["stale"]
+        assert straggler not in mgr.router.member_ids()
+        # past the barrier every routed answer is the NEW version: the
+        # tag rides in the score (row 1.0 + v1 tag 1.0 = 2.0; int8 wire
+        # quantization costs ~0.008)
+        for _ in range(12):
+            out_rows = mgr.router.score_rows(np.ones((1, 4), np.float32))
+            assert abs(float(np.asarray(out_rows)[0, 0]) - 2.0) < 0.05
+        # the monitor retries the straggler and re-admits it
+        assert _wait(lambda: mgr.summary()["stale"] == [], timeout=5.0)
+        assert straggler in mgr.summary()["active"]
+        assert straggler in mgr.router.member_ids()
+        assert all(m.generation == 1
+                   for m in list(mgr.members.values()) + mgr.standbys)
+        obs.flush()
+        evs = _events(tmp_path)
+        degraded = [e for e in evs if e["kind"] == "fleet_swap_degraded"]
+        readmits = [e for e in evs if e["kind"] == "fleet_readmit"]
+        swaps = [e for e in evs if e["kind"] == "fleet_swap"]
+        assert [e["member"] for e in degraded] == [straggler]
+        assert straggler in [e["member"] for e in readmits]
+        assert len(swaps) == 1 and swaps[0]["generation"] == 1
+        assert straggler in swaps[0]["failed"]
+    finally:
+        mgr.stop()
+
+
+def test_scale_tick_up_promotes_standby_and_journals(tmp_path):
+    obs.configure(str(tmp_path / "tele"))
+    mgr = _mgr(tmp_path, n_daemons=2, standbys=1, max_daemons=4)
+    mgr.start()
+    try:
+        standby_id = mgr.standbys[0].member_id
+        assert mgr.scale_tick(burns=[(3.0, 3.0), (0.5, 0.4)]) == "up"
+        summary = mgr.summary()
+        assert standby_id in summary["active"]
+        assert len(summary["active"]) == 3
+        # cool everywhere -> retire one, gracefully
+        assert mgr.scale_tick(burns=[(0.1, 0.1)] * 3) == "down"
+        assert len(mgr.summary()["active"]) == 2
+        # disagreement holds
+        assert mgr.scale_tick(burns=[(3.0, 0.1), (0.1, 0.1)]) == "hold"
+        obs.flush()
+        evs = [e for e in _events(tmp_path) if e["kind"] == "fleet_scale"]
+        assert [e["action"] for e in evs] == ["up", "down"]
+        assert evs[0]["n_before"] == 2 and evs[0]["n_after"] == 3
+        assert evs[1]["n_before"] == 3 and evs[1]["n_after"] == 2
+    finally:
+        mgr.stop()
+
+
+def test_router_server_wire_face_and_fleet_stats(tmp_path):
+    """The front-end speaks serve_wire end to end: score + stats (with
+    the fleet rollup block) + swap fan-out through the manager."""
+    obs.configure(str(tmp_path / "tele"))
+    mgr = _mgr(tmp_path, n_daemons=2, standbys=0)
+    mgr.start()
+    front = RouterServer(mgr.router, manager=mgr).start()
+    try:
+        with wire_mod.ServeClient(front.host, front.port) as c:
+            assert c.ping()
+            out = c.score_rows(np.ones((3, 4), np.float32))
+            assert np.asarray(out).shape == (3, 1)
+            stats = c.stats()
+            assert stats["fleet"]["routed"] >= 1
+            assert stats["fleet"]["generation"] == 0
+            assert len(stats["fleet"]["active"]) == 2
+            # wire swap fans out to the whole fleet
+            swap = c.swap("stub://v3")
+            assert swap["ok"] is True
+            out = c.score_rows(np.ones((1, 4), np.float32))
+            assert abs(float(np.asarray(out)[0, 0]) - 4.0) < 0.05
+        assert mgr.summary()["generation"] == 1
+    finally:
+        front.close()
+        mgr.stop()
+
+
+def test_member_dirs_feed_fleet_rollup(tmp_path):
+    """`serving_rollup` over the manager's member dirs is the `top`
+    fleet view's input: every live member is visible and not DOWN."""
+    from shifu_tpu.obs.aggregate import serving_rollup
+
+    obs.configure(str(tmp_path / "tele"))
+    mgr = _mgr(tmp_path, n_daemons=2, standbys=1)
+    mgr.start()
+    try:
+        dirs = mgr.member_dirs()
+        assert len(dirs) == 3
+        roll = serving_rollup(dirs)
+        assert roll["fleet"]["daemons"] == 3
+        assert roll["fleet"]["down"] == 0
+    finally:
+        mgr.stop()

@@ -413,3 +413,55 @@ def test_load_datasets_over_uri(data_dir):
     t1, v1 = load_datasets(schema, cfg_uri)
     np.testing.assert_array_equal(t0.features, t1.features)
     np.testing.assert_array_equal(v0.features, v1.features)
+
+
+def test_retry_ladder_total_deadline_cap(tmp_path, monkeypatch):
+    """The retry ladder's wall-clock budget (SHIFU_TPU_FS_RETRY_DEADLINE_S):
+    a persistent fault surfaces the real error as soon as the NEXT backoff
+    sleep would overrun the per-call deadline — long before a raised
+    SHIFU_TPU_FS_RETRIES would exhaust — and journals `fsio_retry_exhausted`
+    with the elapsed time and attempt count."""
+    import time
+
+    from shifu_tpu import obs
+
+    obs.reset_for_tests()
+    obs.configure(str(tmp_path / "tele"))
+    monkeypatch.setenv("SHIFU_TPU_FS_RETRIES", "1000")
+    monkeypatch.setenv("SHIFU_TPU_FS_RETRY_DEADLINE_S", "0.05")
+    calls = {"n": 0}
+
+    def always_down():
+        calls["n"] += 1
+        raise OSError("transient datanode error")
+
+    t0 = time.monotonic()
+    with pytest.raises(OSError, match="transient"):
+        fsio._retry_transient(always_down, op_name="read_bytes")
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5.0            # nowhere near 1000 x backoff
+    assert calls["n"] < 5           # gave up on the deadline, not attempts
+    obs.flush()
+    events = obs.read_journal(str(tmp_path / "tele" / "journal.jsonl"))
+    rec = [e for e in events if e["kind"] == "fsio_retry_exhausted"]
+    assert len(rec) == 1
+    assert rec[0]["reason"] == "deadline"
+    assert rec[0]["op"] == "read_bytes"
+    assert rec[0]["attempts"] == calls["n"]
+    assert rec[0]["deadline_s"] == 0.05
+    assert rec[0]["elapsed_s"] >= 0.0
+
+    # attempts-exhaustion journals too (reason="attempts"), and 0 disables
+    # the deadline entirely
+    monkeypatch.setenv("SHIFU_TPU_FS_RETRIES", "1")
+    monkeypatch.setenv("SHIFU_TPU_FS_RETRY_DEADLINE_S", "0")
+    calls["n"] = 0
+    with pytest.raises(OSError, match="transient"):
+        fsio._retry_transient(always_down, op_name="read_bytes")
+    assert calls["n"] == 2          # 1 + SHIFU_TPU_FS_RETRIES
+    obs.flush()
+    events = obs.read_journal(str(tmp_path / "tele" / "journal.jsonl"))
+    reasons = [e["reason"] for e in events
+               if e["kind"] == "fsio_retry_exhausted"]
+    assert reasons == ["deadline", "attempts"]
+    obs.reset_for_tests()

@@ -309,7 +309,7 @@ def test_status_quick_summary_carries_goodput(tmp_path, monkeypatch):
 
 def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7,
               cold=300.0, hbm=1 << 30, serving=250_000.0,
-              serving_p99=6.0, sparse=1.3, ft_mfu=0.31):
+              serving_p99=6.0, sparse=1.3, ft_mfu=0.31, fleet_eff=0.8):
     return {"value": value, "unit": "samples/sec/chip",
             "goodput": {"goodput_fraction_mean": goodput_frac},
             "xla_compiles": {"total": compiles},
@@ -319,7 +319,8 @@ def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7,
             "serving_scores_per_sec": serving,
             "serving_p99_ms": serving_p99,
             "ladder_deepfm_4mvocab_sparse_speedup": sparse,
-            "ft_transformer_mfu": ft_mfu}
+            "ft_transformer_mfu": ft_mfu,
+            "fleet_scaling_efficiency": fleet_eff}
 
 
 @pytest.mark.perf
@@ -424,6 +425,20 @@ def test_perf_gate_fails_each_axis():
     r = perf_gate.run_gate(_artifact(ft_mfu=0.058),
                            _artifact(ft_mfu=0.058))
     assert r["verdict"] == "PASS"
+    # fleet scaling-efficiency collapse (below the 0.6 floor, ISSUE 12):
+    # the router serialized while single-daemon capacity held
+    r = perf_gate.run_gate(_artifact(fleet_eff=0.3), base)
+    assert r["verdict"] == "REGRESSION"
+    assert [c for c in r["checks"]
+            if c["name"] == "fleet_scaling_efficiency"][0]["status"] \
+        == "REGRESSION"
+    # ...above the floor passes even below the baseline (floor-style)
+    r = perf_gate.run_gate(_artifact(fleet_eff=0.65), base)
+    assert r["verdict"] == "PASS"
+    # ...and a pre-ratchet 0.5 baseline gates against itself
+    r = perf_gate.run_gate(_artifact(fleet_eff=0.5),
+                           _artifact(fleet_eff=0.5))
+    assert r["verdict"] == "PASS"
     # e2e ceiling ratchet floor (ISSUE 11): a healthy 0.7 baseline holds
     # the limit at the 0.5 floor, so a bleed to 0.45 fails even though
     # it is within the 0.2 absolute drop...
@@ -447,7 +462,7 @@ def test_perf_gate_fails_each_axis():
     # still gates the axes it carries
     r = perf_gate.run_gate({"value": 100.0}, base)
     assert r["verdict"] == "PASS"
-    assert [c["status"] for c in r["checks"]] == ["OK"] + ["SKIP"] * 9
+    assert [c["status"] for c in r["checks"]] == ["OK"] + ["SKIP"] * 10
 
 
 @pytest.mark.perf
@@ -487,7 +502,8 @@ def test_perf_gate_cli_pass_fail_and_check_only(tmp_path):
     fresh_bad.write_text(json.dumps(
         _artifact(value=10.0, goodput_frac=0.1, compiles=100, ceiling=0.1,
                   cold=10.0, hbm=8 << 30, serving=10_000.0,
-                  serving_p99=90.0, sparse=0.5, ft_mfu=0.05)))
+                  serving_p99=90.0, sparse=0.5, ft_mfu=0.05,
+                  fleet_eff=0.1)))
 
     def run(*args):
         return subprocess.run([sys.executable, gate, *args],

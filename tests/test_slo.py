@@ -601,3 +601,90 @@ def test_top_renders_loadtest_only_dir(tmp_path):
     assert summary["serving"]["p99_ms"] == 6.5
     assert summary["serving"]["scores_per_sec"] == 4100.0
     assert summary["stages"]["device"]["mean_ms"] == 0.4
+
+
+# -------------------------------------------- fleet-view degradation
+
+
+def test_top_marks_stale_daemon_down(tmp_path):
+    """The stale-frame fix: a daemon whose lease is older than its own
+    ttl renders DOWN (last frame flagged, not shown as live), and the
+    fleet rollup excludes it from the live totals."""
+    from shifu_tpu.obs import aggregate as aggregate_mod
+    from shifu_tpu.runtime import fleet as fleet_lib
+
+    old = time.time() - 100.0
+    dead = tmp_path / "dead"
+    dead.mkdir()
+    with open(dead / "journal.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "serving_report", "ts": old,
+                            "requests": 500, "scores_per_sec": 9000.0,
+                            "p99_ms": 2.0, "queue_depth": 1,
+                            "errors": 0}) + "\n")
+    fleet_lib.write_lease(str(dead), "member-0", seq=9, ttl_s=0.3)
+    # age the lease in place (write_lease stamps now)
+    rec = fleet_lib.read_lease(str(dead))
+    rec["ts"] = old
+    with open(dead / fleet_lib.LEASE_FILE, "w") as f:
+        json.dump(rec, f)
+
+    live = tmp_path / "live"
+    live.mkdir()
+    with open(live / "journal.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "serving_report", "ts": time.time(),
+                            "requests": 300, "scores_per_sec": 4000.0,
+                            "p99_ms": 3.0, "queue_depth": 0,
+                            "errors": 0}) + "\n")
+
+    s = render_mod.top_summary(str(dead))
+    assert s["down"] is True
+    assert s["stale_s"] > 0.3
+    assert s["lease"]["member"] == "member-0"
+    assert "DOWN" in render_mod.render_top_text(s)
+    # the live dir (no lease, fresh events) is NOT down by default...
+    assert "down" not in render_mod.top_summary(str(live))
+    # ...but an explicit --stale-after can flag anything
+    assert render_mod.top_summary(str(live),
+                                  stale_after_s=3600.0).get("down") \
+        is None
+
+    roll = aggregate_mod.serving_rollup([str(live), str(dead)])
+    assert roll["fleet"]["daemons"] == 2
+    assert roll["fleet"]["down"] == 1
+    # the dead member's 9000/s last frame is NOT in the live rate
+    assert roll["fleet"]["scores_per_sec"] == 4000.0
+    text = render_mod.render_top_fleet_text(roll)
+    assert "(1 DOWN)" in text and "DOWN" in text
+
+
+def test_top_survives_torn_journal_and_corrupt_scrape(tmp_path):
+    """A torn mid-line journal tail (writer died mid-record) and a
+    corrupt scrape file both degrade gracefully: the frame renders from
+    what parsed, flagged — never an exception."""
+    from shifu_tpu.obs import aggregate as aggregate_mod
+
+    tele = tmp_path / "tele"
+    tele.mkdir()
+    with open(tele / "journal.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "serving_report", "ts": time.time(),
+                            "requests": 100, "scores_per_sec": 1000.0,
+                            "p99_ms": 5.0, "errors": 0}) + "\n")
+        f.write('{"kind": "serving_report", "ts": 99, "requ')  # torn
+    with open(tele / "metrics.prom", "w") as f:
+        # a bucket bound that is not a float raises inside the
+        # histogram parser — the frame must flag it, not die
+        f.write('serve_stage_seconds_bucket{stage="device",'
+                'le="garbage"} 5\n')
+    s = render_mod.top_summary(str(tele))
+    assert s["mode"] == "serving"
+    assert s["serving"]["p99_ms"] == 5.0     # the intact line rendered
+    assert s.get("scrape_error") is True
+    assert s.get("stages") is None
+    # the rollup carries the degraded frame instead of crashing, and a
+    # dir with no journal at all becomes an error row
+    roll = aggregate_mod.serving_rollup(
+        [str(tele), str(tmp_path / "missing")])
+    assert roll["fleet"]["daemons"] == 2
+    assert roll["daemons"][0]["serving"]["p99_ms"] == 5.0
+    assert "error" in roll["daemons"][1]
+    render_mod.render_top_fleet_text(roll)   # renders, no exception

@@ -3,7 +3,7 @@ BENCH baseline and exit nonzero on regression.
 
 The repo's first *enforceable* perf trajectory (ISSUE 3): every round the
 driver captures a `BENCH_r*.json`; this gate compares a freshly produced
-`bench_full.json` against the newest of those baselines on ten axes —
+`bench_full.json` against the newest of those baselines on eleven axes —
 
 - **throughput / step time**: the headline resident-tier
   samples/sec/chip (`value`) must not fall below
@@ -61,6 +61,14 @@ driver captures a `BENCH_r*.json`; this gate compares a freshly produced
   the sparse axis: MFU is normalized by the part's peak (tunnel-drift-
   immune), pre-fusion 0.058 baselines keep gating against themselves,
   and once a fused round lands the floor holds.
+- **fleet scaling efficiency**: `fleet_scaling_efficiency` (the
+  2-daemon in-proc fleet's scores/s divided by `n_daemons x` the
+  single-daemon capacity, ISSUE 12 — bench.py's fleet rollup) must
+  not fall below `min(--fleet-eff-floor, baseline)` — ratchet-floor
+  style because the field is already a same-run ratio
+  (tunnel-drift-immune): a serialized router, a lost connection
+  pool, or a head-of-line lock would collapse it toward 1/n while
+  single-daemon capacity survives.
 
 The e2e ceiling axis additionally carries a ratchet FLOOR
 (`--e2e-ceiling-floor`, default 0.5): once a non-degraded baseline
@@ -166,6 +174,7 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
              p99_factor: float = 3.0,
              sparse_floor: float = 1.0,
              ft_mfu_floor: float = 0.25,
+             fleet_eff_floor: float = 0.6,
              e2e_ceiling_floor: float = 0.5) -> dict:
     """The comparison itself (pure — unit-tested on synthetic pairs).
     Returns {"checks": [...], "verdict": "PASS"|"REGRESSION"}."""
@@ -312,6 +321,23 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
         check("ft_transformer_mfu", fft, bft, fft >= limit,
               round(limit, 4))
 
+    # fleet scaling efficiency: the 2-daemon in-proc fleet's scores/s
+    # over n_daemons x the single-daemon capacity (ISSUE 12's router +
+    # fleet plane).  Ratchet-floor like the sparse and MFU axes: the
+    # field is a same-run ratio, so it's immune to tunnel drift, and a
+    # regression here means the ROUTING layer serialized (a lost
+    # per-member connection pool, a global lock on the ring walk, a
+    # hedge storm) while raw single-daemon capacity looks fine.  SKIP
+    # when either side predates the fleet plane.
+    ffe = _num(fresh, "fleet_scaling_efficiency")
+    bfe = _num(baseline, "fleet_scaling_efficiency")
+    if ffe is None or bfe is None or bfe <= 0:
+        check("fleet_scaling_efficiency", ffe, bfe, None, None)
+    else:
+        limit = min(fleet_eff_floor, bfe)
+        check("fleet_scaling_efficiency", ffe, bfe, ffe >= limit,
+              round(limit, 4))
+
     regressed = [c for c in checks if c["status"] == "REGRESSION"]
     return {"checks": checks,
             "verdict": "REGRESSION" if regressed else "PASS"}
@@ -377,6 +403,11 @@ def main(argv=None) -> int:
                    help="fresh ft_transformer_mfu must be >= min(this, "
                         "baseline) (the fused attention+FFN block's rung, "
                         "ISSUE 11; SKIP when either side lacks the field)")
+    p.add_argument("--fleet-eff-floor", type=float, default=0.6,
+                   help="fresh fleet_scaling_efficiency must be >= "
+                        "min(this, baseline) (the fleet's scores/s over "
+                        "n_daemons x single-daemon capacity, ISSUE 12; "
+                        "SKIP when either side lacks the field)")
     p.add_argument("--e2e-ceiling-floor", type=float, default=0.5,
                    help="ratchet floor on e2e_cached_disk_fraction_of_"
                         "ceiling: a non-degraded baseline at/above this "
@@ -428,6 +459,7 @@ def main(argv=None) -> int:
                       p99_factor=args.p99_factor,
                       sparse_floor=args.sparse_floor,
                       ft_mfu_floor=args.ft_mfu_floor,
+                      fleet_eff_floor=args.fleet_eff_floor,
                       e2e_ceiling_floor=args.e2e_ceiling_floor)
     report["fresh"] = args.fresh
     report["baseline"] = baseline_path
