@@ -195,8 +195,14 @@ def _matches(spec: FaultSpec, site: str) -> bool:
 
 
 def _triggered(spec: FaultSpec, call_n: int, seed: int, site: str,
-               epoch: Optional[int]) -> bool:
+               epoch: Optional[int],
+               member: Optional[str] = None) -> bool:
     if spec.rank >= 0 and _rank() != spec.rank:
+        return False
+    if spec.member and (member is None or not fnmatch.fnmatchcase(
+            str(member), spec.member)):
+        # member-targeted fault (fleet.lease / fleet.sync drills): only
+        # the named member's probes fire, its peers beat/sync untouched
         return False
     if spec.at_epoch >= 0 and (epoch is None or int(epoch) != spec.at_epoch):
         return False
@@ -252,7 +258,8 @@ def maybe_fail(site: str, echo: Optional[Callable[[str], None]] = None,
                 n_fired = _fires.get(key, 0)
             if spec.max_times > 0 and n_fired >= spec.max_times:
                 continue
-            if not _triggered(spec, call_n, plan.seed, site, epoch):
+            if not _triggered(spec, call_n, plan.seed, site, epoch,
+                              member=ctx.get("member")):
                 continue
             if spec.scope == "job" and state is not None:
                 state["fires"][key] = n_fired + 1

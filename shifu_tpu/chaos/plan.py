@@ -74,6 +74,10 @@ class FaultSpec:
     at_epoch: int = -1        # fire when ctx epoch == K; -1 = off
     before_epoch: int = -1    # fire while ctx epoch < N; -1 = off
     rank: int = -1            # only on this gang rank; -1 = any
+    member: str = ""          # only when the probe's `member` context
+                              # matches this fnmatch pattern ("" = any) —
+                              # fleet drills silence ONE member's lease
+                              # or sync without touching its peers
     prob: float = 0.0         # seeded per-call probability; 0 = off
     max_times: int = 0        # stop after M injections; 0 = unlimited
     scope: str = "process"
@@ -112,6 +116,9 @@ class FaultSpec:
         if not isinstance(self.message, str):
             raise ChaosPlanError(f"fault {self.site!r}: message must be a "
                                  "string")
+        if not isinstance(self.member, str):
+            raise ChaosPlanError(f"fault {self.site!r}: member must be a "
+                                 "string (fnmatch pattern)")
         spec = dataclasses.replace(self, **coerced)
         if not (0.0 <= spec.prob <= 1.0):
             raise ChaosPlanError(

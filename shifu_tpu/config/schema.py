@@ -750,6 +750,25 @@ class FleetConfig:
     max_daemons: int = 8
     # consistent-ring virtual nodes per member (per-model routing)
     vnodes: int = 32
+    # --- host plane (cross-host fleet, docs/SERVING.md) ---
+    # launcher/pod.py host grammar: "" = single-host in-proc fleet (the
+    # pre-host-plane behavior), "local:N" = N simulated hosts (tier-1
+    # drills), "h1,h2"/"@file" = one `shifu-tpu serve` member per slot
+    # over ssh
+    hosts: str = ""
+    # member spawn mode: "auto" (in-proc on local transport, process on
+    # ssh), or force "inproc"/"process"
+    member_mode: str = "auto"
+    # first wire port for process-mode members (member i binds base+i)
+    member_port_base: int = 8600
+    # atomic artifact sync: each host pulls the export once, verifies it
+    # against the exporter's blake2b manifest, atomically renames into
+    # its cache, and only then swaps (torn/corrupt pulls quarantine the
+    # member; the old version keeps serving)
+    sync_artifacts: bool = True
+    # split-brain guard: a DOWN member whose lease resurrects (partition
+    # healed) rejoins as a STANDBY — never re-promoted into its old slot
+    rejoin_standby: bool = True
 
     @property
     def heartbeat_ttl_s(self) -> float:
@@ -801,6 +820,30 @@ class FleetConfig:
                 f"{self.max_daemons}]")
         if self.vnodes < 1:
             raise ConfigError(f"fleet.vnodes must be >= 1: {self.vnodes}")
+        if self.member_mode not in ("auto", "inproc", "process"):
+            raise ConfigError(
+                "fleet.member-mode must be auto/inproc/process: "
+                f"{self.member_mode!r}")
+        if not (0 < self.member_port_base < 65536):
+            raise ConfigError(
+                f"fleet.member-port-base out of range: "
+                f"{self.member_port_base}")
+        if self.hosts:
+            # fail at config time, not at fleet start: the same grammar
+            # parse_hosts uses later, minus the file read for @lists
+            h = self.hosts.strip()
+            if h.startswith("local:"):
+                try:
+                    n = int(h.split(":", 1)[1])
+                except ValueError:
+                    n = 0
+                if n < 1:
+                    raise ConfigError(
+                        f"fleet.hosts {self.hosts!r}: need local:N "
+                        "with N >= 1")
+            elif not h.startswith("@") \
+                    and not [x for x in h.split(",") if x.strip()]:
+                raise ConfigError(f"fleet.hosts {self.hosts!r}: no hosts")
 
 
 # ---------------------------------------------------------------------------

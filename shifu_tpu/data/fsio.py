@@ -107,6 +107,67 @@ def file_info(path: str) -> Tuple[Optional[int], Optional[int]]:
     return size, mtime_ns
 
 
+def exists(path: str) -> bool:
+    """Does a file/object exist at `path`?  Local paths stat; remote URIs
+    ask the filesystem.  Unreachable filesystems read as absent — callers
+    at this level (lease reads, staleness probes) treat "can't tell" and
+    "not there" the same way."""
+    if not is_remote(path):
+        return os.path.exists(path)
+    try:
+        filesystem, fs_path = _filesystem(path)
+        from pyarrow import fs as pafs
+        return filesystem.get_file_info(fs_path).type \
+            != pafs.FileType.NotFound
+    except Exception:
+        return False
+
+
+def write_bytes_atomic(path: str, data: bytes) -> None:
+    """Publish `data` at `path` so no reader ever observes a torn write.
+
+    Local: tmp file + os.replace (POSIX rename atomicity).  Remote: a
+    single open_output_stream/close — object stores publish the object
+    only when the stream closes, which is the same no-torn-reads
+    guarantee; hdfs-style filesystems expose the file at create, so a
+    tmp + move lands the rename-atomicity there too.  The membership
+    lease and sync-manifest writers sit on this."""
+    if not is_remote(path):
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        return
+    filesystem, fs_path = _filesystem(path)
+    tmp_fs_path = f"{fs_path}.tmp.{os.getpid()}"
+
+    def op() -> None:
+        from .. import chaos
+        chaos.maybe_fail("fsio.write_bytes", path=path)
+        parent = fs_path.rsplit("/", 1)[0]
+        if parent and parent != fs_path:
+            try:
+                filesystem.create_dir(parent, recursive=True)
+            except Exception:
+                pass  # object stores have no dirs; write decides
+        with filesystem.open_output_stream(tmp_fs_path) as f:
+            f.write(data)
+        try:
+            filesystem.move(tmp_fs_path, fs_path)
+        except Exception:
+            # no rename on this store: the close above already published
+            # the tmp object whole — fall back to a direct whole-object
+            # write (still never torn) and drop the tmp
+            with filesystem.open_output_stream(fs_path) as f:
+                f.write(data)
+            try:
+                filesystem.delete_file(tmp_fs_path)
+            except Exception:
+                pass
+    _retry_transient(op, _classifier(filesystem, fs_path, path),
+                     op_name="write_bytes_atomic")
+
+
 def open_input_file(path: str):
     """A seekable pyarrow input file for a remote URI (parquet readers need
     random access, unlike the streaming read_bytes path)."""
@@ -336,9 +397,13 @@ def upload_dir(local_dir: str, remote_dir: str,
 
 
 def read_bytes(path: str) -> bytes:
-    """Fetch a remote file's raw bytes (gzip detection happens downstream).
-    Transient stream errors are retried with backoff; NotFound/Directory and
-    auth failures classify immediately and never retry."""
+    """Fetch a file's raw bytes (gzip detection happens downstream).
+    Local paths read directly; remote URIs stream through pyarrow.fs with
+    transient errors retried with backoff — NotFound/Directory and auth
+    failures classify immediately and never retry."""
+    if not is_remote(path):
+        with open(path, "rb") as f:
+            return f.read()
     filesystem, fs_path = _filesystem(path)  # guards the pyarrow import
 
     def op() -> bytes:

@@ -191,4 +191,12 @@ def save_artifact(params: Any, job: JobConfig, export_dir: str,
     if forward_fn is not None:
         export_stablehlo(forward_fn, params, job.schema.feature_count,
                          os.path.join(export_dir, STABLEHLO))
+    try:
+        # digest manifest for cross-host fleet pulls (runtime/fleet.py
+        # sync_artifact verifies against it); best-effort — a local-only
+        # artifact serves fine without one
+        from ..runtime.fleet import write_sync_manifest
+        write_sync_manifest(export_dir)
+    except Exception:
+        pass
     return export_dir

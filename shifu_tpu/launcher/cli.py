@@ -305,10 +305,32 @@ def build_parser() -> argparse.ArgumentParser:
     fl.add_argument("--globalconfig", default=None,
                     help="Hadoop-style XML carrying shifu.fleet.* and "
                          "shifu.serving.* keys (flags override)")
+    fl.add_argument("--hosts", default=None,
+                    help="cross-host member placement (launcher/pod.py "
+                         "grammar: local:N simulated hosts, h1,h2 or "
+                         "@file over ssh; default: shifu.fleet.hosts / "
+                         "single-host in-proc)")
+    fl.add_argument("--member-mode", default=None,
+                    choices=["auto", "inproc", "process"],
+                    help="member spawn mode (default: "
+                         "shifu.fleet.member-mode / auto — in-proc on "
+                         "local transport, process children over ssh)")
     fl.add_argument("--chaos-plan", default=None,
                     help="fault-injection plan (fleet.heartbeat / "
-                         "fleet.route / runtime.serve sites, "
-                         "docs/ROBUSTNESS.md)")
+                         "fleet.lease / fleet.sync / fleet.route / "
+                         "runtime.serve sites, docs/ROBUSTNESS.md)")
+
+    fv = sub.add_parser(
+        "fleet-verify", help="audit a fleet run's journal: every "
+                             "failover promoted a standby, swap "
+                             "generations never regress, every swap "
+                             "reached every live member exactly once "
+                             "(the chaos-verify analog for the serving "
+                             "fleet, docs/SERVING.md)")
+    fv.add_argument("job_dir", help="fleet telemetry/job dir (or any "
+                                    "dir holding its journal.jsonl)")
+    fv.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
 
     lt = sub.add_parser(
         "loadtest", help="open-loop (Poisson-arrival) load harness for "
@@ -1370,6 +1392,40 @@ def run_chaos_verify(args) -> int:
     return EXIT_OK if report["verdict"] == "PASS" else EXIT_FAIL
 
 
+def run_fleet_verify(args) -> int:
+    """`shifu-tpu fleet-verify <dir>`: audit a fleet run's journal
+    against the fleet lifecycle invariants (runtime/fleet.py
+    fleet_verify_events — the chaos-verify analog for the serving
+    plane).  Exit 0 = every check holds."""
+    from ..obs import journal as journal_mod
+    from ..obs import render as obs_render
+    from ..runtime.fleet import fleet_verify_events
+
+    jpath = obs_render.find_journal(args.job_dir)
+    if jpath is None:
+        print(f"no telemetry journal found under {args.job_dir}",
+              file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    events = journal_mod.read_journal(jpath)
+    report = fleet_verify_events(events)
+    report["journal"] = jpath
+    if getattr(args, "json", False):
+        print(json.dumps(report))
+    else:
+        counts = report["counts"]
+        print(f"fleet-verify: {report['verdict']} — "
+              f"{counts['failovers']} failover(s), "
+              f"{counts['swaps']} fleet swap(s), "
+              f"{counts['member_swaps']} member application(s), "
+              f"{counts['rejoins']} rejoin(s), "
+              f"{counts['degraded']} degraded, "
+              f"{counts['syncs']} host sync(s)")
+        for c in report["checks"]:
+            mark = "ok " if c["ok"] else "FAIL"
+            print(f"  [{mark}] {c['check']}: {c['detail']}")
+    return EXIT_OK if report["verdict"] == "PASS" else EXIT_FAIL
+
+
 def run_score(args) -> int:
     from .. import obs
     from ..data import reader
@@ -1514,6 +1570,10 @@ def run_fleet(args) -> int:
         kw["heartbeat_misses"] = args.heartbeat_misses
     if args.scale_every_s >= 0:
         kw["scale_every_s"] = args.scale_every_s
+    if getattr(args, "hosts", None) is not None:
+        kw["hosts"] = args.hosts
+    if getattr(args, "member_mode", None) is not None:
+        kw["member_mode"] = args.member_mode
     if kw:
         fleet_cfg = dataclasses.replace(fleet_cfg, **kw)
     try:
@@ -1924,6 +1984,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.command == "chaos-verify":
         # likewise journal/plan reads only — no jax import
         return run_chaos_verify(args)
+    if args.command == "fleet-verify":
+        # likewise journal reads only — no jax import
+        return run_fleet_verify(args)
     if args.command == "cache":
         # cache-dir file reads only — no jax import
         return run_cache(args)

@@ -148,6 +148,21 @@ def _host_command(spec: PodSpec, rank: int, child_args: Sequence[str],
             None)
 
 
+def member_command(spec: PodSpec, rank: int, child_args: Sequence[str],
+                   env_contract: dict[str, str]
+                   ) -> tuple[list[str], Optional[dict]]:
+    """(argv, env-or-None) to run one `shifu-tpu` child on host `rank` —
+    the serving fleet's spawn path (runtime/fleet.py HostPlane): the
+    SAME local/ssh transport wrapping the training gang uses, exposed
+    for per-member dispatch instead of gang dispatch.  Local transport
+    inherits+extends this env (with the pdeathsig tether); ssh carries
+    the contract inline so no remote shell profile can drop it."""
+    if not (0 <= rank < len(spec.hosts)):
+        raise ValueError(f"member rank {rank} outside the host list "
+                         f"({len(spec.hosts)} hosts)")
+    return _host_command(spec, rank, child_args, env_contract)
+
+
 def launch_gang(spec: PodSpec, child_args: Sequence[str], out_dir: str,
                 attempt: int, liveness_seconds: float = 0.0,
                 echo=print, deadline=None) -> tuple[int, tuple[int, ...]]:

@@ -186,6 +186,14 @@ def worker_hosts(spec: ProvisionSpec) -> list[str]:
     return hosts
 
 
+def serving_hosts(spec: ProvisionSpec) -> str:
+    """The slice's workers as a `--hosts`-grammar string (comma-joined,
+    worker order) — what `shifu-tpu fleet --hosts` / `shifu.fleet.hosts`
+    consume to place serving members on a provisioned slice through the
+    same launcher/pod.py ssh transport the training gang uses."""
+    return ",".join(worker_hosts(spec))
+
+
 def delete(spec: ProvisionSpec, echo=print) -> bool:
     """Release the slice (idempotent best-effort: releasing twice or
     releasing a failed create must not mask the original error).  Returns

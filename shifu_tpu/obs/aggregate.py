@@ -181,6 +181,16 @@ def serving_rollup(paths: list,
             active.append(a)
             if a.get("objective"):
                 firing.add(str(a["objective"]))
+    # per-host grouping off the lease's host stamp (the cross-host fleet
+    # writes it; dirs without one group under "-"): live/down counts per
+    # placement, so a whole-host loss reads as ONE row going dark
+    hosts: dict = {}
+    for d in daemons:
+        host = str((d.get("lease") or {}).get("host") or "-")
+        slot = hosts.setdefault(host, {"members": 0, "down": 0})
+        slot["members"] += 1
+        if d.get("down"):
+            slot["down"] += 1
     return {
         "daemons": daemons,
         "fleet": {
@@ -191,5 +201,6 @@ def serving_rollup(paths: list,
             "queue_depth": queue,
             "active_alerts": len(active),
             "firing": sorted(firing),
+            "hosts": {h: hosts[h] for h in sorted(hosts)},
         },
     }

@@ -743,13 +743,24 @@ def _load_events_tail(jpath: str, tail_bytes: int = _TOP_TAIL_BYTES
 def _read_lease_nearby(journal_path: str) -> Optional[dict]:
     """The fleet membership lease (runtime/fleet.py `lease.json`) next to
     a journal, tolerantly: torn/absent/garbage is None — the top frame
-    then falls back to journal-event freshness alone."""
+    then falls back to journal-event freshness alone.
+
+    Routed through data/fsio so a REMOTE (gs://-style) fleet telemetry
+    dir answers too: with the old local-open-only read, every remote
+    member rendered always-fresh — a dead member on shared storage never
+    showed DOWN (`--stale-after` satellite fix)."""
     try:
-        with open(os.path.join(os.path.dirname(journal_path),
-                               "lease.json")) as f:
-            rec = json.load(f)
+        from ..data import fsio
+        if fsio.is_remote(journal_path):
+            parent = journal_path.rsplit("/", 1)[0]
+            raw = fsio.read_bytes(fsio.join(parent, "lease.json"))
+            rec = json.loads(raw.decode())
+        else:
+            with open(os.path.join(os.path.dirname(journal_path),
+                                   "lease.json")) as f:
+                rec = json.load(f)
         return rec if isinstance(rec, dict) else None
-    except (OSError, ValueError):
+    except Exception:
         return None
 
 
@@ -834,6 +845,8 @@ def top_summary(path: str,
     if lease is not None:
         out["lease"] = {"member": lease.get("member"),
                         "ttl_s": lease.get("ttl_s")}
+        if lease.get("host"):
+            out["lease"]["host"] = lease.get("host")
     if threshold is not None and threshold > 0 and freshest is not None:
         age = max(0.0, now - freshest)
         if age > threshold:
@@ -1078,6 +1091,16 @@ def render_top_fleet_text(rollup: dict) -> str:
            else "-")
         + f"  worst p99 {fleet.get('worst_p99_ms')} ms  "
         f"active alerts {fleet.get('active_alerts')}"]
+    hosts = fleet.get("hosts") or {}
+    if [h for h in hosts if h != "-"]:
+        # the cross-host view: one cell per placement, dark hosts loud
+        cells = []
+        for h in sorted(hosts):
+            slot = hosts[h]
+            n, dn = slot.get("members", 0), slot.get("down", 0)
+            cells.append(f"{h}:{n - dn}/{n}"
+                         + (" DOWN" if dn and dn == n else ""))
+        lines.append("  hosts: " + "  ".join(cells))
     lines.append(f"  {'daemon':<28} {'rate/s':>10} {'p99_ms':>8} "
                  f"{'queue':>6} {'alerts':>7} {'slo':>8}")
     for d in rollup.get("daemons") or []:

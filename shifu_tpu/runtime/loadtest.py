@@ -29,7 +29,6 @@ highest one that still meets a p99 target.
 
 from __future__ import annotations
 
-import random
 import threading
 import time
 from typing import Optional
@@ -238,27 +237,38 @@ def _run_socket(connect: str, *, rate: float, duration: float,
     # real drops, not a client that gave up on the first RST
     t_give_up = t_start + (float(schedule[-1]) if n else 0.0) + 5.0
 
-    def _reconnect(deadline: float):
-        """Decorrelated-jitter reconnect: sleep uniform(base, last*3)
-        capped, retry until the deadline.  None = transport never came
-        back — only THEN does the remaining schedule count as errors."""
-        sleep_s = 0.0
+    def _reconnect(deadline: float, ladder) -> object:
+        """Reconnect with the SENDER's persistent backoff ladder, retry
+        until the deadline.  None = transport never came back — only
+        THEN does the remaining schedule count as errors.
+
+        The ladder lives OUTSIDE this function and a successful connect
+        does NOT reset it: a zombie that accepts then dies per-request
+        (the kill() shape — listener lingers, every round-trip RSTs)
+        would otherwise restart the ladder at zero every cycle and flap
+        at full tightness forever.  Only a successful REQUEST in the
+        sender loop calls ladder.ok()."""
         while time.perf_counter() < deadline:
             try:
                 return serve_wire.ServeClient(host, port)
             except (ConnectionError, OSError):
-                sleep_s = min(0.5, random.uniform(0.02,
-                                                  max(0.02, sleep_s * 3)))
+                sleep_s = ladder.fail()
                 time.sleep(min(sleep_s,
                                max(0.0, deadline - time.perf_counter())))
         return None
 
     def sender(s: int) -> None:
+        from .router import _Backoff
+
         lats = lat_lists[s]
+        # one decorrelated-jitter ladder per sender, shared by every
+        # reconnect THIS sender ever does (satellite fix: it used to be
+        # re-zeroed inside each _reconnect call)
+        ladder = _Backoff(base_s=0.02, cap_s=0.5)
         # connect inside the accounting scope: a server that is never
         # reachable within the whole schedule charges this sender's
         # every request as an error, not a silent thread exit
-        client = _reconnect(t_give_up)
+        client = _reconnect(t_give_up, ladder)
         if client is None:
             err_counts[s] += len(range(s, n, senders))
             return
@@ -273,6 +283,8 @@ def _run_socket(connect: str, *, rate: float, duration: float,
                     try:
                         client.score_rows(rows[k % n_unique][None, :])
                         lats.append(time.perf_counter() - t_sched)
+                        ladder.ok()  # a COMPLETED round-trip — the only
+                        #              reset (never a bare connect)
                         sent = True
                     except serve_wire.WireOverload:
                         rej_counts[s] += 1  # backpressure, like inproc
@@ -288,7 +300,14 @@ def _run_socket(connect: str, *, rate: float, duration: float,
                         # answers, not whether one TCP stream survived
                         client.close()
                         reconnects[s] += 1
-                        client = _reconnect(t_give_up)
+                        # pace BEFORE reconnecting: against a zombie the
+                        # connect below succeeds instantly, so this
+                        # sleep is the only thing breaking the flap loop
+                        time.sleep(min(
+                            ladder.fail(),
+                            max(0.0,
+                                t_give_up - time.perf_counter())))
+                        client = _reconnect(t_give_up, ladder)
                         if client is None:
                             err_counts[s] += 1 + len(
                                 range(k + senders, n, senders))

@@ -249,38 +249,50 @@ class FleetRouter:
         last_err: Optional[BaseException] = None
         hedged = False
         for i, m in enumerate(cands[:2]):   # primary + ONE hedge
-            client = None
+            # connect (checkout) and the request proper are SEPARATE
+            # failure domains: the accepts-then-dies zombie (a kill()'d
+            # member whose listener lingers) connects fine and dies on
+            # every request — if connecting reset the ladder, that shape
+            # would flap at full tightness forever
             try:
                 client = m.checkout()
-                out = attempt_fn(client)
-                m.checkin(client)
-                m.backoff.ok()
-                with self._lock:
-                    self._routed += 1
-                    if i > 0:
-                        self._hedges += 1
-                return out
-            except serve_wire.WireOverload as e:
-                # member alive but shedding: it is NOT a transport
-                # failure — no backoff, but try the other candidate once
-                if client is not None:
-                    m.checkin(client)
-                last_err = e
-                with self._lock:
-                    self._sheds += 1
-            except serve_wire.WireError as e:
-                # application-level error from a healthy member: the
-                # request itself is bad — hedging elsewhere won't help
-                if client is not None:
-                    m.checkin(client)
-                raise e
             except (ConnectionError, socket.timeout, OSError) as e:
-                if client is not None:
-                    m.invalidate(client)
                 m.backoff.fail()
                 m.drain_pool()
                 last_err = e
                 hedged = True
+                continue
+            try:
+                out = attempt_fn(client)
+            except serve_wire.WireOverload as e:
+                # member alive but shedding: it is NOT a transport
+                # failure — no backoff, but try the other candidate once
+                m.checkin(client)
+                last_err = e
+                with self._lock:
+                    self._sheds += 1
+                continue
+            except serve_wire.WireError as e:
+                # application-level error from a healthy member: the
+                # request itself is bad — hedging elsewhere won't help
+                m.checkin(client)
+                raise e
+            except (ConnectionError, socket.timeout, OSError) as e:
+                m.invalidate(client)
+                m.backoff.fail()
+                m.drain_pool()
+                last_err = e
+                hedged = True
+                continue
+            m.checkin(client)
+            # the ONLY ladder reset: a COMPLETED round-trip — never a
+            # bare successful connect (see the zombie note above)
+            m.backoff.ok()
+            with self._lock:
+                self._routed += 1
+                if i > 0:
+                    self._hedges += 1
+            return out
         with self._lock:
             self._errors += 1
         if isinstance(last_err, serve_wire.WireOverload):

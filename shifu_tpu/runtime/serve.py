@@ -948,7 +948,8 @@ def serve_forever(export_dir: str, config: ServingConfig,
             heartbeat = Heartbeat(
                 lease_dir, f"serve-{os.getpid()}", heartbeat_every_s,
                 heartbeat_every_s * max(1, heartbeat_misses),
-                is_alive=lambda: daemon._running).start()
+                is_alive=lambda: daemon._running,
+                host=os.environ.get("SHIFU_TPU_FLEET_HOST")).start()
     try:
         server = serve_wire.ServeServer(daemon, host=config.host,
                                         port=config.port,

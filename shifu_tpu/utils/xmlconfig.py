@@ -167,6 +167,11 @@ KEY_FLEET_SCALE_COOLDOWN_S = "shifu.fleet.scale-cooldown-s"
 KEY_FLEET_MIN_DAEMONS = "shifu.fleet.min-daemons"
 KEY_FLEET_MAX_DAEMONS = "shifu.fleet.max-daemons"
 KEY_FLEET_VNODES = "shifu.fleet.vnodes"
+KEY_FLEET_HOSTS = "shifu.fleet.hosts"
+KEY_FLEET_MEMBER_MODE = "shifu.fleet.member-mode"
+KEY_FLEET_MEMBER_PORT_BASE = "shifu.fleet.member-port-base"
+KEY_FLEET_SYNC_ARTIFACTS = "shifu.fleet.sync-artifacts"
+KEY_FLEET_REJOIN_STANDBY = "shifu.fleet.rejoin-standby"
 
 
 def parse_sharding_rules(value: str) -> tuple:
@@ -309,7 +314,8 @@ def fleet_config_from_conf(conf: Mapping[str, str], base: Any = None) -> Any:
                  KEY_FLEET_HEARTBEAT_MISSES: "heartbeat_misses",
                  KEY_FLEET_MIN_DAEMONS: "min_daemons",
                  KEY_FLEET_MAX_DAEMONS: "max_daemons",
-                 KEY_FLEET_VNODES: "vnodes"}
+                 KEY_FLEET_VNODES: "vnodes",
+                 KEY_FLEET_MEMBER_PORT_BASE: "member_port_base"}
     _float_keys = {KEY_FLEET_HEARTBEAT_EVERY_S: "heartbeat_every_s",
                    KEY_FLEET_ROUTE_TIMEOUT_MS: "route_timeout_ms",
                    KEY_FLEET_CONNECT_TIMEOUT_MS: "connect_timeout_ms",
@@ -323,9 +329,19 @@ def fleet_config_from_conf(conf: Mapping[str, str], base: Any = None) -> Any:
     for key, field in _int_keys.items():
         if key in conf:
             kw[field] = int(conf[key])
+    _str_keys = {KEY_FLEET_HOSTS: "hosts",
+                 KEY_FLEET_MEMBER_MODE: "member_mode"}
+    _bool_keys = {KEY_FLEET_SYNC_ARTIFACTS: "sync_artifacts",
+                  KEY_FLEET_REJOIN_STANDBY: "rejoin_standby"}
     for key, field in _float_keys.items():
         if key in conf:
             kw[field] = float(conf[key])
+    for key, field in _str_keys.items():
+        if key in conf:
+            kw[field] = str(conf[key]).strip()
+    for key, field in _bool_keys.items():
+        if key in conf:
+            kw[field] = parse_bool(conf[key])
     return dataclasses.replace(base, **kw) if kw else base
 
 

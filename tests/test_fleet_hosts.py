@@ -1,0 +1,752 @@
+"""Cross-host fleet tests (runtime/fleet.py HostPlane + lease/sync
+planes, runtime/router.py backoff discipline — docs/SERVING.md
+"Cross-host fleet", docs/ROBUSTNESS.md `fleet.lease` / `fleet.sync`).
+
+Covers the ISSUE-14 acceptance drills as tier-1 in-proc tests on
+`local:N` simulated hosts:
+
+- **drill A, whole-host loss**: kill every member on one host in the
+  middle of an open-loop load -> a standby on the SURVIVING host
+  promotes, the run finishes with zero client errors, and
+  `shifu-tpu fleet-verify` passes;
+- **drill B, lease blackhole**: chaos at `fleet.lease` silences one
+  member's lease WRITES (the process stays alive — a storage-level
+  partition).  The member is quarantined by lease age, a standby
+  promotes, and when the partition heals the member rejoins as a
+  STANDBY at the current generation — never double-promoting, never
+  serving a stale generation;
+- **drill C, corrupt artifact sync**: chaos at `fleet.sync` corrupts
+  one host's pulled artifact mid-fleet-swap.  The digest check
+  quarantines that member (`fleet_swap_degraded`), its old version
+  keeps serving, every other member lands the new version, and the
+  monitor's retried pull completes the swap;
+- **exactly-once propagation**: one fleet swap across 2 hosts pulls
+  the artifact once per HOST (`fleet_sync`) and applies it once per
+  member (`fleet_member_swap`), audited by `fleet_verify_events`;
+- **member flap under load** (satellite): repeated kill/failover
+  cycles under open-loop load finish with zero errors;
+- **zombie backoff** (satellite): an accepts-then-dies listener never
+  resets the reconnect ladder — only a completed round-trip does;
+- **remote staleness** (satellite): a mock:// telemetry dir's lease /
+  journal age routes through data/fsio, so dead remote members render
+  DOWN in `top` / `serving_rollup`;
+- unit coverage: HostPlane placement, sync manifest + corrupt-pull
+  recovery, member-targeted chaos, `fleet_verify_events` shapes.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tpu import chaos, obs
+from shifu_tpu.chaos import plan as plan_mod
+from shifu_tpu.config.schema import ConfigError, FleetConfig, ServingConfig
+from shifu_tpu.runtime import fleet as fleet_mod
+from shifu_tpu.runtime import loadtest as loadtest_mod
+from shifu_tpu.runtime import serve_wire as wire_mod
+from shifu_tpu.runtime.fleet import (FleetManager, HostPlane, SyncError,
+                                     fleet_verify_events, read_sync_manifest,
+                                     sync_artifact, write_lease,
+                                     write_sync_manifest)
+from shifu_tpu.runtime.router import FleetRouter, RouterServer
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_and_obs():
+    chaos.reset_for_tests()
+    obs.reset_for_tests()
+    yield
+    chaos.reset_for_tests()
+    obs.reset_for_tests()
+
+
+class _TagScorer:
+    """Stub engine whose score encodes the artifact version (see
+    test_fleet.py): scoring v-tagged artifacts returns `row[0] + tag`."""
+
+    engine = "stub"
+    static_shapes = False
+    num_features = 4
+
+    def __init__(self, tag: float):
+        self.tag = tag
+
+    def compute_batch(self, rows, n_valid=None):
+        x = np.asarray(rows, np.float32)
+        return np.ascontiguousarray(x[:, :1] + self.tag)
+
+    def close(self):
+        pass
+
+
+def _tag_loader(path, _engine):
+    tag = 0.0
+    if "v" in path:
+        try:
+            tag = float(path.rsplit("v", 1)[-1])
+        except ValueError:
+            pass
+    return _TagScorer(tag)
+
+
+def _file_tag_loader(path, _engine):
+    """Loader for REAL artifact dirs (the sync drills): the version tag
+    lives in `<dir>/tag.txt` of the host's digest-verified synced copy."""
+    with open(os.path.join(path, "tag.txt")) as f:
+        return _TagScorer(float(f.read().strip()))
+
+
+def _make_artifact(tmp_path, name: str, tag: float) -> str:
+    """A syncable on-disk artifact: a tag file + opaque payload +
+    exporter manifest."""
+    d = tmp_path / name
+    d.mkdir()
+    (d / "tag.txt").write_text(str(tag))
+    (d / "weights.bin").write_bytes(bytes(range(256)) * 8)
+    write_sync_manifest(str(d))
+    return str(d)
+
+
+def _fleet_cfg(**kw) -> FleetConfig:
+    base = dict(n_daemons=2, standbys=1, hosts="local:2",
+                heartbeat_every_s=0.1, heartbeat_misses=3)
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+def _serving_cfg(**kw) -> ServingConfig:
+    base = dict(engine="numpy", report_every_s=0.0)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def _mgr(tmp_path, export="stub://v0", loader=_tag_loader,
+         **fleet_kw) -> FleetManager:
+    return FleetManager(export, fleet=_fleet_cfg(**fleet_kw),
+                        serving=_serving_cfg(),
+                        root_dir=str(tmp_path / "fleet"),
+                        loader=loader)
+
+
+def _events(tmp_path):
+    return obs.read_journal(str(tmp_path / "tele" / "journal.jsonl"))
+
+
+def _wait(pred, timeout=5.0, tick=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(tick)
+    return pred()
+
+
+def _fleet_verify_cli(tmp_path) -> None:
+    """Satellite-5: every drill ends with the CLI journal audit."""
+    from shifu_tpu.launcher import cli
+
+    obs.flush()
+    assert cli.main(["fleet-verify", str(tmp_path / "tele")]) == 0
+
+
+# -------------------------------------------------------------- host plane
+
+
+def test_hostplane_placement_is_deterministic(tmp_path):
+    hp = HostPlane("local:3", str(tmp_path))
+    assert hp.host_ids == ("local-0", "local-1", "local-2")
+    # least-loaded, first-wins ties: round-robin from a cold start
+    assert [hp.place() for _ in range(5)] == \
+        ["local-0", "local-1", "local-2", "local-0", "local-1"]
+    hp.release("local-0")
+    hp.release("local-0")
+    assert hp.place() == "local-0"
+    # per-host artifact caches are disjoint
+    assert hp.cache_dir("local-0") != hp.cache_dir("local-1")
+    assert os.path.isdir(hp.cache_dir("local-2"))
+
+
+def test_hostplane_serve_command_exports_host_identity(tmp_path):
+    hp = HostPlane("local:2", str(tmp_path))
+    argv, env = hp.serve_command("local-1", ["serve", "/art"], {"K": "1"})
+    assert argv[1:4] == ["-m", "shifu_tpu.launcher.cli", "serve"]
+    assert env["K"] == "1"
+    assert env[fleet_mod.ENV_FLEET_HOST] == "local-1"
+
+
+def test_fleet_config_hosts_grammar():
+    FleetConfig(hosts="local:2").validate()
+    FleetConfig(hosts="tpu-a,tpu-b").validate()
+    with pytest.raises(ConfigError):
+        FleetConfig(hosts="local:0").validate()
+    with pytest.raises(ConfigError):
+        FleetConfig(member_mode="weird").validate()
+
+
+# ------------------------------------------------------------ artifact sync
+
+
+def test_sync_manifest_roundtrip_and_exactly_once(tmp_path):
+    src = _make_artifact(tmp_path, "v0", 0.0)
+    manifest = read_sync_manifest(src)
+    assert manifest["algo"] == "blake2b-16"
+    assert sorted(manifest["files"]) == ["tag.txt", "weights.bin"]
+    cache = str(tmp_path / "cache")
+    dest = sync_artifact(src, cache, 3)
+    assert dest.endswith("gen-000003")
+    assert sorted(os.listdir(dest)) == ["tag.txt", "weights.bin"]
+    # idempotent: the published generation is returned untouched
+    assert sync_artifact(src, cache, 3) == dest
+
+
+def test_sync_corrupt_pull_raises_cleans_staging_then_retries(tmp_path):
+    src = _make_artifact(tmp_path, "v0", 0.0)
+    cache = str(tmp_path / "cache")
+    chaos.configure(plan_mod.parse_plan({"faults": [
+        {"site": fleet_mod.SYNC_SITE, "every": 1, "max_times": 1,
+         "action": "corrupt"}]}))
+    with pytest.raises(SyncError):
+        sync_artifact(src, cache, 1)
+    # the torn staging dir never survives a failed pull
+    assert [f for f in os.listdir(cache) if "incoming" in f] == []
+    assert not os.path.isdir(os.path.join(cache, "gen-000001"))
+    # fault exhausted: the retried pull verifies and publishes
+    dest = sync_artifact(src, cache, 1)
+    assert os.path.isdir(dest)
+    got = read_sync_manifest(src)["files"]["weights.bin"]
+    import hashlib
+    with open(os.path.join(dest, "weights.bin"), "rb") as f:
+        assert hashlib.blake2b(f.read(), digest_size=16).hexdigest() == got
+
+
+def test_sync_torn_pull_is_a_sync_error(tmp_path):
+    src = _make_artifact(tmp_path, "v0", 0.0)
+    chaos.configure(plan_mod.parse_plan({"faults": [
+        {"site": fleet_mod.SYNC_SITE, "every": 1, "max_times": 1,
+         "action": "raise"}]}))
+    with pytest.raises(SyncError):
+        sync_artifact(src, str(tmp_path / "cache"), 1)
+
+
+def test_exported_artifact_carries_manifest(tmp_path):
+    """export/artifact.py writes the sync manifest so fleet pulls verify
+    against the exporter's own digests."""
+    pytest.importorskip("jax")
+    from shifu_tpu.config import JobConfig, ModelSpec
+    from shifu_tpu.data import synthetic
+    from shifu_tpu.export import save_artifact
+    from shifu_tpu.train import init_state
+
+    schema = synthetic.make_schema(num_features=4)
+    job = JobConfig(schema=schema,
+                    model=ModelSpec(model_type="mlp",
+                                    hidden_nodes=(4,),
+                                    activations=("tanh",))).validate()
+    state = init_state(job, 4)
+    out = save_artifact(state.params, job, str(tmp_path / "art"))
+    manifest = read_sync_manifest(out)
+    assert manifest is not None
+    assert "topology.json" in manifest["files"]
+    assert fleet_mod.MANIFEST_FILE not in manifest["files"]
+
+
+# ----------------------------------------------------- member-scoped chaos
+
+
+def test_lease_chaos_targets_one_member(tmp_path):
+    d = str(tmp_path / "lease")
+    chaos.configure(plan_mod.parse_plan({"faults": [
+        {"site": fleet_mod.LEASE_SITE, "member": "member-1",
+         "every": 1, "action": "raise"}]}))
+    # untargeted member writes fine, targeted member is blackholed
+    write_lease(d, "member-0", seq=1, ttl_s=0.5)
+    with pytest.raises(chaos.ChaosError):
+        write_lease(d, "member-1", seq=1, ttl_s=0.5)
+    # fnmatch patterns cover member families
+    chaos.configure(plan_mod.parse_plan({"faults": [
+        {"site": fleet_mod.LEASE_SITE, "member": "member-*",
+         "every": 1, "action": "raise"}]}))
+    with pytest.raises(chaos.ChaosError):
+        write_lease(d, "member-7", seq=1, ttl_s=0.5)
+    write_lease(d, "serve-123", seq=1, ttl_s=0.5)
+
+
+def test_faultspec_member_field_validates():
+    with pytest.raises(chaos.ChaosPlanError):
+        plan_mod.parse_plan({"faults": [
+            {"site": "fleet.lease", "member": 3, "every": 1,
+             "action": "raise"}]})
+
+
+# ------------------------------------------------- fleet_verify_events unit
+
+
+def _ev(kind, **kw):
+    kw["kind"] = kind
+    return kw
+
+
+def test_fleet_verify_events_pass_shape():
+    events = [
+        _ev("fleet_start"),
+        _ev("fleet_member_swap", member="member-0", generation=1,
+            via="fanout"),
+        _ev("fleet_member_swap", member="member-1", generation=1,
+            via="fanout"),
+        _ev("fleet_swap", generation=1,
+            swapped=["member-0", "member-1"], failed=[]),
+        _ev("fleet_failover", member="member-1", standby="member-2"),
+        _ev("fleet_member_swap", member="member-2", generation=1,
+            via="promote"),
+        _ev("fleet_rejoin", member="member-1", generation=1,
+            caught_up=True),
+    ]
+    report = fleet_verify_events(events)
+    assert report["verdict"] == "PASS", report
+    assert report["counts"]["failovers"] == 1
+    assert report["counts"]["member_swaps"] == 3
+
+
+def test_fleet_verify_events_fail_shapes():
+    # double application of one generation to one member
+    r = fleet_verify_events([
+        _ev("fleet_member_swap", member="m0", generation=1, via="fanout"),
+        _ev("fleet_member_swap", member="m0", generation=1, via="retry"),
+        _ev("fleet_swap", generation=1, swapped=["m0"], failed=[]),
+    ])
+    assert r["verdict"] == "FAIL"
+    assert not [c for c in r["checks"]
+                if c["check"] == "swap_applied_exactly_once"][0]["ok"]
+    # a swap that never reached a live member
+    r = fleet_verify_events([
+        _ev("fleet_swap", generation=1, swapped=["m0"], failed=["m1"]),
+        _ev("fleet_member_swap", member="m0", generation=1, via="fanout"),
+    ])
+    assert not [c for c in r["checks"]
+                if c["check"] == "swap_reached_every_member"][0]["ok"]
+    # ... unless that member DIED before the retry
+    r = fleet_verify_events([
+        _ev("fleet_swap", generation=1, swapped=["m0"], failed=["m1"]),
+        _ev("fleet_member_swap", member="m0", generation=1, via="fanout"),
+        _ev("fleet_failover", member="m1", standby="m2"),
+    ])
+    assert r["verdict"] == "PASS"
+    # generation regression per member
+    r = fleet_verify_events([
+        _ev("fleet_member_swap", member="m0", generation=2, via="fanout"),
+        _ev("fleet_member_swap", member="m0", generation=1, via="retry"),
+    ])
+    assert not [c for c in r["checks"]
+                if c["check"] == "member_generation_monotonic"][0]["ok"]
+    # rejoin without a prior failover (the split-brain paper trail)
+    r = fleet_verify_events([_ev("fleet_rejoin", member="m9")])
+    assert not [c for c in r["checks"]
+                if c["check"] == "rejoin_follows_failover"][0]["ok"]
+    # barrier rollback
+    r = fleet_verify_events([
+        _ev("fleet_swap", generation=2, swapped=[], failed=[]),
+        _ev("fleet_swap", generation=1, swapped=[], failed=[]),
+    ])
+    assert not [c for c in r["checks"]
+                if c["check"] == "swap_generations_increase"][0]["ok"]
+
+
+# ------------------------------------------------------- drill A: host kill
+
+
+@pytest.mark.chaos
+def test_host_kill_drill_promotes_on_surviving_host(tmp_path):
+    """ISSUE-14 drill (a): kill a WHOLE host mid-open-loop-load.  The
+    standby on the surviving host promotes (anti-affinity), the load
+    finishes with zero client errors, and fleet-verify passes."""
+    obs.configure(str(tmp_path / "tele"))
+    mgr = _mgr(tmp_path)   # 2 members + 1 standby across local:2
+    mgr.start()
+    front = RouterServer(mgr.router, manager=mgr).start()
+    try:
+        assert mgr.summary()["hosts"] == ["local-0", "local-1"]
+        # deterministic placement: member-0@local-0, member-1@local-1,
+        # standby member-2@local-0
+        assert mgr.members["member-1"].host_id == "local-1"
+        assert mgr.standbys[0].host_id == "local-0"
+
+        def _kill_later():
+            time.sleep(0.6)
+            killed = mgr.kill_host("local-1")
+            assert killed == ["member-1"]
+
+        killer = threading.Thread(target=_kill_later)
+        killer.start()
+        report = loadtest_mod.run_loadtest(
+            connect=f"{front.host}:{front.port}",
+            rate=400.0, duration=2.0, senders=2, seed=7)
+        killer.join()
+        assert report["errors"] == 0, report
+        assert report["completed"] == report["submitted"]
+        assert _wait(lambda: mgr.summary()["failovers"] == 1, timeout=2.0)
+        summary = mgr.summary()
+        assert "member-1" not in summary["active"]
+        assert "member-2" in summary["active"]
+        # the promotion landed on the SURVIVING host
+        assert mgr.members["member-2"].host_id == "local-0"
+        out = mgr.router.score_rows(np.ones((1, 4), np.float32))
+        assert np.asarray(out).shape == (1, 1)
+        obs.flush()
+        evs = _events(tmp_path)
+        failovers = [e for e in evs if e["kind"] == "fleet_failover"]
+        assert len(failovers) == 1
+        assert failovers[0]["member"] == "member-1"
+        assert failovers[0]["host"] == "local-1"
+        assert failovers[0]["standby_host"] == "local-0"
+        assert fleet_verify_events(evs)["verdict"] == "PASS"
+    finally:
+        front.close()
+        mgr.stop()
+    _fleet_verify_cli(tmp_path)
+
+
+# -------------------------------------------------- drill B: lease blackhole
+
+
+@pytest.mark.chaos
+def test_lease_blackhole_quarantine_then_clean_rejoin(tmp_path):
+    """ISSUE-14 drill (b): blackhole ONE member's lease writes (the
+    daemon stays alive — a storage partition).  Lease age quarantines
+    it, a standby promotes; when writes resume the member REJOINS AS A
+    STANDBY caught up to the current generation — it never
+    double-promotes, and no stale generation is ever served."""
+    obs.configure(str(tmp_path / "tele"))
+    # ~8 blackholed beats (0.8s) >> ttl (0.3s): the partition outlives
+    # the lease window, then heals
+    chaos.configure(plan_mod.parse_plan({"faults": [
+        {"site": fleet_mod.LEASE_SITE, "member": "member-1",
+         "every": 1, "max_times": 8, "action": "raise"}]}))
+    mgr = _mgr(tmp_path)
+    mgr.start()
+    try:
+        assert _wait(lambda: mgr.summary()["failovers"] == 1, timeout=4.0)
+        summary = mgr.summary()
+        assert "member-1" in summary["down"]
+        assert "member-2" in summary["active"]
+        # a fleet swap lands while member-1 sits in the DOWN ledger
+        out = mgr.swap_fleet("stub://v1")
+        assert out["ok"] is True, out
+        # the partition heals -> rejoin as STANDBY at the new generation
+        assert _wait(
+            lambda: "member-1" in mgr.summary()["standbys"], timeout=6.0)
+        summary = mgr.summary()
+        assert "member-1" not in summary["active"]     # never re-promoted
+        assert summary["failovers"] == 1
+        assert "member-1" not in summary["down"]
+        # no stale generation served past the barrier
+        for _ in range(8):
+            rows = mgr.router.score_rows(np.ones((1, 4), np.float32))
+            assert abs(float(np.asarray(rows)[0, 0]) - 2.0) < 0.05
+        obs.flush()
+        evs = _events(tmp_path)
+        rejoins = [e for e in evs if e["kind"] == "fleet_rejoin"]
+        assert len(rejoins) == 1
+        assert rejoins[0]["member"] == "member-1"
+        assert rejoins[0]["caught_up"] is True
+        assert rejoins[0]["generation"] == 1
+        assert fleet_verify_events(evs)["verdict"] == "PASS"
+    finally:
+        mgr.stop()
+    _fleet_verify_cli(tmp_path)
+
+
+# ------------------------------------------------- drill C: corrupt sync
+
+
+@pytest.mark.chaos
+def test_corrupt_sync_quarantines_then_retried_swap_completes(tmp_path):
+    """ISSUE-14 drill (c): chaos corrupts ONE host's artifact pull
+    mid-fleet-swap.  The digest check fails that member's swap
+    (`fleet_swap_degraded`, old version keeps serving), every other
+    member lands the new version, and the monitor's retried pull
+    completes the swap."""
+    obs.configure(str(tmp_path / "tele"))
+    v0 = _make_artifact(tmp_path, "v0", 0.0)
+    v1 = _make_artifact(tmp_path, "v1", 1.0)
+    # sync probe call order: spawn pulls gen-0 on local-0 (1) and
+    # local-1 (2); the swap pulls gen-1 — call 3 is member-0's host
+    chaos.configure(plan_mod.parse_plan({"faults": [
+        {"site": fleet_mod.SYNC_SITE, "at_call": 3, "max_times": 1,
+         "action": "corrupt"}]}))
+    mgr = _mgr(tmp_path, export=v0, loader=_file_tag_loader)
+    mgr.start()
+    try:
+        m0 = mgr.members["member-0"]
+        out = mgr.swap_fleet(v1)
+        assert out["ok"] is False
+        assert [f["member"] for f in out["failed"]] == ["member-0"]
+        assert "sync" in out["failed"][0]["error"]
+        # the other members all landed the new version
+        assert sorted(out["swapped"]) == ["member-1", "member-2"]
+        # the degraded member was never torn down: its daemon still
+        # answers on its own wire port (old version keeps serving until
+        # the retried pull lands)
+        with wire_mod.ServeClient(m0.host, m0.port) as c:
+            assert np.asarray(
+                c.score_rows(np.ones((1, 4), np.float32))).shape == (1, 1)
+        # routed traffic past the barrier is the NEW version only
+        for _ in range(8):
+            rows = mgr.router.score_rows(np.ones((1, 4), np.float32))
+            assert abs(float(np.asarray(rows)[0, 0]) - 2.0) < 0.05
+        # the monitor re-pulls and re-admits the straggler
+        assert _wait(lambda: mgr.summary()["stale"] == [], timeout=4.0)
+        assert _wait(
+            lambda: "member-0" in mgr.router.member_ids(), timeout=2.0)
+        assert m0.generation == 1
+        obs.flush()
+        evs = _events(tmp_path)
+        degraded = [e for e in evs if e["kind"] == "fleet_swap_degraded"]
+        assert len(degraded) == 1
+        assert degraded[0]["member"] == "member-0"
+        assert "sync" in degraded[0]["error"]
+        retried = [e for e in evs if e["kind"] == "fleet_member_swap"
+                   and e["member"] == "member-0"
+                   and e["generation"] == 1]
+        assert len(retried) == 1 and retried[0]["via"] == "retry"
+        assert [e for e in evs if e["kind"] == "fleet_readmit"]
+        assert fleet_verify_events(evs)["verdict"] == "PASS"
+    finally:
+        mgr.stop()
+    _fleet_verify_cli(tmp_path)
+
+
+# ------------------------------------------- exactly-once swap propagation
+
+
+def test_swap_propagates_exactly_once_per_host_and_member(tmp_path):
+    """The acceptance audit: ONE fleet swap across 2 simulated hosts
+    pulls the artifact once per HOST and applies it once per MEMBER."""
+    obs.configure(str(tmp_path / "tele"))
+    v0 = _make_artifact(tmp_path, "v0", 0.0)
+    v1 = _make_artifact(tmp_path, "v1", 1.0)
+    mgr = _mgr(tmp_path, export=v0, loader=_file_tag_loader, standbys=0)
+    mgr.start()
+    try:
+        out = mgr.swap_fleet(v1)
+        assert out["ok"] is True
+        assert sorted(out["swapped"]) == ["member-0", "member-1"]
+        for _ in range(4):
+            rows = mgr.router.score_rows(np.ones((1, 4), np.float32))
+            assert abs(float(np.asarray(rows)[0, 0]) - 2.0) < 0.05
+        obs.flush()
+        evs = _events(tmp_path)
+        # one verified pull per host for the new generation
+        syncs = [e for e in evs if e["kind"] == "fleet_sync"
+                 and e["generation"] == 1]
+        assert sorted(e["host"] for e in syncs) == ["local-0", "local-1"]
+        # one application per member, exactly once
+        applies = [e for e in evs if e["kind"] == "fleet_member_swap"
+                   and e["generation"] == 1]
+        assert sorted(e["member"] for e in applies) == \
+            ["member-0", "member-1"]
+        assert {e["via"] for e in applies} == {"fanout"}
+        report = fleet_verify_events(evs)
+        assert report["verdict"] == "PASS", report
+        assert report["counts"]["syncs"] >= 2
+    finally:
+        mgr.stop()
+    _fleet_verify_cli(tmp_path)
+
+
+# ------------------------------------------- satellite: flap under load
+
+
+@pytest.mark.chaos
+def test_member_flap_under_open_loop_load(tmp_path):
+    """Satellite-3: sustained member flap — repeated kill/failover
+    cycles in the middle of an open-loop load.  Every cycle promotes a
+    standby; the run finishes with zero client errors."""
+    obs.configure(str(tmp_path / "tele"))
+    mgr = _mgr(tmp_path)
+    mgr.start()
+    front = RouterServer(mgr.router, manager=mgr).start()
+    try:
+        def _flapper():
+            for round_n in range(2):
+                time.sleep(0.5)
+                with mgr._lock:
+                    actives = [m for m in mgr.members.values()
+                               if m.state == fleet_mod.STATE_ACTIVE]
+                actives[round_n % len(actives)].kill()
+                # wait for the failover + a replenished standby before
+                # the next flap (a real flap has the same spacing: the
+                # lease window must expire between deaths)
+                _wait(lambda: mgr.summary()["failovers"] == round_n + 1,
+                      timeout=3.0)
+                _wait(lambda: len(mgr.summary()["standbys"]) >= 1,
+                      timeout=3.0)
+
+        flapper = threading.Thread(target=_flapper)
+        flapper.start()
+        report = loadtest_mod.run_loadtest(
+            connect=f"{front.host}:{front.port}",
+            rate=300.0, duration=3.0, senders=2, seed=11)
+        flapper.join()
+        assert report["errors"] == 0, report
+        assert report["completed"] == report["submitted"]
+        assert _wait(lambda: mgr.summary()["failovers"] == 2, timeout=3.0)
+        assert len(mgr.summary()["active"]) == 2
+        obs.flush()
+        evs = _events(tmp_path)
+        assert len([e for e in evs
+                    if e["kind"] == "fleet_failover"]) == 2
+        assert fleet_verify_events(evs)["verdict"] == "PASS"
+    finally:
+        front.close()
+        mgr.stop()
+    _fleet_verify_cli(tmp_path)
+
+
+# ------------------------------------------- satellite: zombie backoff
+
+
+def test_zombie_listener_never_resets_backoff_ladder():
+    """Satellite-2: an accepts-then-dies zombie (a killed member whose
+    listener lingers) connects instantly and fails every REQUEST.  The
+    reconnect ladder must keep growing — only a completed round-trip
+    resets it; a bare successful connect must not."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    host, port = srv.getsockname()[:2]
+    stop = threading.Event()
+
+    def _zombie():
+        srv.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.close()   # accepted, then dead before any response
+
+    t = threading.Thread(target=_zombie, daemon=True)
+    t.start()
+    router = FleetRouter(FleetConfig(
+        n_daemons=1, standbys=0, backoff_base_ms=5, backoff_cap_ms=200,
+        route_timeout_ms=300, connect_timeout_ms=300))
+    try:
+        router.add("zombie", host, port, generation=0)
+        m = router._members["zombie"]
+        sleeps = []
+        for _ in range(4):
+            with pytest.raises(ConnectionError):
+                router.score_rows(np.ones((1, 4), np.float32))
+            sleeps.append(m.backoff._sleep)
+            m.backoff._until = 0.0   # re-arm without waiting out the nap
+        # the ladder accumulated on every failed request and was never
+        # reset by the (always successful) connects
+        assert all(s > 0 for s in sleeps), sleeps
+    finally:
+        stop.set()
+        router.close()
+        try:
+            srv.close()
+        except OSError:
+            pass
+
+
+def test_backoff_ladder_unit():
+    from shifu_tpu.runtime.router import _Backoff
+
+    b = _Backoff(base_s=0.01, cap_s=0.05)
+    first = b.fail(now=100.0)
+    assert 0.01 <= first <= 0.05
+    assert b.blocked(now=100.0)
+    assert not b.blocked(now=100.0 + first + 0.001)
+    for _ in range(10):
+        assert b.fail() <= 0.05   # capped
+    b.ok()
+    assert not b.blocked()
+    assert b._sleep == 0.0
+
+
+# --------------------------------------- satellite: remote staleness (top)
+
+
+def test_remote_telemetry_dir_renders_down_through_fsio(tmp_path):
+    """Satellite-1: a mock:// (remote shared-storage) telemetry dir's
+    lease + journal freshness routes through data/fsio — a dead remote
+    member renders DOWN in top_summary and counts against its host in
+    the serving_rollup grouping."""
+    pafs = pytest.importorskip("pyarrow.fs")
+    from shifu_tpu.data import fsio
+    from shifu_tpu.obs import aggregate, render
+
+    filesystem, _ = pafs.FileSystem.from_uri("mock://seed")
+    # pin THIS in-memory instance for the ('mock', '') endpoint — the
+    # same stand-in-namenode idiom as test_fsio's mock_fs fixture
+    with fsio._fs_lock:
+        fsio._fs_cache[("mock", "")] = filesystem
+    filesystem.create_dir("bucket/fleetdrill/member-0")
+    try:
+        _remote_staleness_body(fsio, aggregate, render)
+    finally:
+        with fsio._fs_lock:
+            fsio._fs_cache.pop(("mock", ""), None)
+
+
+def _remote_staleness_body(fsio, aggregate, render):
+    root = "mock://bucket/fleetdrill/member-0"
+    old = time.time() - 120.0
+    fsio.write_bytes(fsio.join(root, "journal.jsonl"),
+                     (json.dumps({"kind": "serve_start", "ts": old})
+                      + "\n").encode())
+    fsio.write_bytes_atomic(
+        fsio.join(root, "lease.json"),
+        json.dumps({"member": "member-0", "ts": old, "ttl_s": 5.0,
+                    "host": "remote-a"}).encode())
+    s = render.top_summary(root)
+    assert s is not None
+    assert s.get("down") is True
+    assert s["stale_s"] > 60
+    assert s["lease"]["host"] == "remote-a"
+    roll = aggregate.serving_rollup([root])
+    assert roll["fleet"]["down"] == 1
+    assert roll["fleet"]["hosts"]["remote-a"] == {"members": 1, "down": 1}
+    text = render.render_top_fleet_text(roll)
+    assert "remote-a" in text and "DOWN" in text
+    # a fresh lease beat (through the same fsio-routed write the fleet
+    # uses) clears the verdict
+    write_lease(root, "member-0", seq=2, ttl_s=5.0, host="remote-a")
+    s2 = render.top_summary(root)
+    assert not s2.get("down")
+    roll2 = aggregate.serving_rollup([root])
+    assert roll2["fleet"]["hosts"]["remote-a"]["down"] == 0
+
+
+# --------------------------------------------------- fleet-verify CLI face
+
+
+def test_fleet_verify_cli_fails_on_bad_journal(tmp_path, capsys):
+    from shifu_tpu.launcher import cli
+
+    tele = tmp_path / "tele"
+    tele.mkdir()
+    evs = [
+        {"kind": "fleet_member_swap", "member": "m0", "generation": 1,
+         "via": "fanout"},
+        {"kind": "fleet_member_swap", "member": "m0", "generation": 1,
+         "via": "retry"},
+        {"kind": "fleet_swap", "generation": 1, "swapped": ["m0"],
+         "failed": []},
+    ]
+    with open(tele / "journal.jsonl", "w") as f:
+        for e in evs:
+            f.write(json.dumps(e) + "\n")
+    rc = cli.main(["fleet-verify", str(tele), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc != 0
+    assert out["verdict"] == "FAIL"
+    # and a missing journal is a clean failure, not a traceback
+    assert cli.main(["fleet-verify", str(tmp_path / "nope")]) != 0
