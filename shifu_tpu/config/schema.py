@@ -644,6 +644,10 @@ class ServingConfig:
     # admitted request (deterministic 1-in-N).  0 disables sampling; the
     # per-stage `serve_stage_seconds` histograms stay on regardless.
     trace_sample: int = 0
+    # p99 exemplars: how many slowest-request trace_ids a loadtest run
+    # reports in its `loadtest_report` (0 disables; only meaningful with
+    # trace_sample > 0 — exemplars come from the sampled traces)
+    trace_exemplars: int = 5
     # serving SLO objectives (`shifu.serving.slo.*` XML keys); 0 disables
     # each.  p99 target in ms — pick a value on the latency bucket grid
     # (1/2.5/5/10/25...) so the violation count is bucket-exact; error
@@ -685,6 +689,9 @@ class ServingConfig:
         if self.trace_sample < 0:
             raise ConfigError("serving.trace_sample must be >= 0 "
                               f"(0 = off, N = 1-in-N): {self.trace_sample}")
+        if self.trace_exemplars < 0:
+            raise ConfigError("serving.trace-exemplars must be >= 0: "
+                              f"{self.trace_exemplars}")
         if self.slo_p99_ms < 0:
             raise ConfigError(
                 f"serving.slo.p99-ms must be >= 0: {self.slo_p99_ms}")
@@ -769,6 +776,13 @@ class FleetConfig:
     # split-brain guard: a DOWN member whose lease resurrects (partition
     # healed) rejoins as a STANDBY — never re-promoted into its old slot
     rejoin_standby: bool = True
+    # fleet timeline (obs/timeline.py): estimate per-host clock offsets
+    # from lease round-trips and merge member journals in the corrected
+    # order; off = raw per-journal timestamps (debugging the estimator)
+    timeline_skew_correct: bool = True
+    # clamp on any single host's estimated |offset| — a lease stamped by
+    # a wildly wrong clock must not fling the merged timeline
+    timeline_max_offset_s: float = 300.0
 
     @property
     def heartbeat_ttl_s(self) -> float:
@@ -828,6 +842,10 @@ class FleetConfig:
             raise ConfigError(
                 f"fleet.member-port-base out of range: "
                 f"{self.member_port_base}")
+        if self.timeline_max_offset_s <= 0:
+            raise ConfigError(
+                "fleet.timeline-max-offset-s must be > 0: "
+                f"{self.timeline_max_offset_s}")
         if self.hosts:
             # fail at config time, not at fleet start: the same grammar
             # parse_hosts uses later, minus the file read for @lists

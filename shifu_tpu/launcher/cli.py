@@ -332,6 +332,28 @@ def build_parser() -> argparse.ArgumentParser:
     fv.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
 
+    tl = sub.add_parser(
+        "timeline", help="skew-corrected causal fleet timeline: merge "
+                         "every member's journal into one ordered "
+                         "event stream, stitch incidents (failover / "
+                         "SLO / degraded-swap episodes) and show "
+                         "sampled request traces end to end "
+                         "(docs/OBSERVABILITY.md)")
+    tl.add_argument("job_dir", help="fleet telemetry/job dir (member "
+                                    "journals are discovered one "
+                                    "level below)")
+    tl.add_argument("--json", action="store_true",
+                    help="machine-readable summary on stdout")
+    tl.add_argument("--trace-id", default=None,
+                    help="show one trace: its router hop spans and "
+                         "per-member stage decompositions")
+    tl.add_argument("--incident", action="store_true",
+                    help="incident records only (root event, causal "
+                         "chain, affected traces, recovery)")
+    tl.add_argument("--no-skew-correct", action="store_true",
+                    help="merge on raw per-host timestamps (skip the "
+                         "heartbeat-derived clock-offset correction)")
+
     lt = sub.add_parser(
         "loadtest", help="open-loop (Poisson-arrival) load harness for "
                          "the scoring plane: reports scores/s and "
@@ -362,6 +384,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "meeting the p99 target instead of a single run")
     lt.add_argument("--p99-target-ms", type=float, default=10.0,
                     help="p99 target for --capacity (default 10ms)")
+    lt.add_argument("--trace-sample", type=int, default=0,
+                    help="trace 1-in-N requests and report the trace "
+                         "ids of the slowest sampled ones (p99 "
+                         "exemplars; 0 = off, default)")
+    lt.add_argument("--trace-exemplars", type=int, default=5,
+                    help="how many slowest-trace exemplars to report "
+                         "(default 5)")
     lt.add_argument("--json", action="store_true",
                     help="machine-readable report instead of text")
 
@@ -1396,19 +1425,25 @@ def run_fleet_verify(args) -> int:
     """`shifu-tpu fleet-verify <dir>`: audit a fleet run's journal
     against the fleet lifecycle invariants (runtime/fleet.py
     fleet_verify_events — the chaos-verify analog for the serving
-    plane).  Exit 0 = every check holds."""
-    from ..obs import journal as journal_mod
-    from ..obs import render as obs_render
+    plane).  Exit 0 = every check holds.
+
+    Process-mode members journal into their own tele dirs on their own
+    clocks, so the audit runs on the skew-corrected merged timeline
+    (obs/timeline.py): raw cross-host timestamps can make a later swap
+    generation appear to precede an earlier one and fail the ordering
+    checks spuriously."""
+    from ..obs import timeline as timeline_mod
     from ..runtime.fleet import fleet_verify_events
 
-    jpath = obs_render.find_journal(args.job_dir)
-    if jpath is None:
+    merged = timeline_mod.load_merged(args.job_dir, tail_bytes=None)
+    if merged is None:
         print(f"no telemetry journal found under {args.job_dir}",
               file=sys.stderr, flush=True)
         return EXIT_FAIL
-    events = journal_mod.read_journal(jpath)
-    report = fleet_verify_events(events)
-    report["journal"] = jpath
+    report = fleet_verify_events(merged["events"])
+    report["journal"] = merged["journals"][0]
+    report["journals"] = merged["journals"]
+    report["skew_correct"] = merged["skew_correct"]
     if getattr(args, "json", False):
         print(json.dumps(report))
     else:
@@ -1424,6 +1459,29 @@ def run_fleet_verify(args) -> int:
             mark = "ok " if c["ok"] else "FAIL"
             print(f"  [{mark}] {c['check']}: {c['detail']}")
     return EXIT_OK if report["verdict"] == "PASS" else EXIT_FAIL
+
+
+def run_timeline(args) -> int:
+    """`shifu-tpu timeline <dir>`: the skew-corrected causal fleet
+    timeline (obs/timeline.py) — merged member journals, incident
+    records, sampled request traces.  Journal reads only: never imports
+    jax, bounded tails, safe against a live fleet from any machine."""
+    from ..obs import timeline as timeline_mod
+
+    summary = timeline_mod.timeline_summary(
+        args.job_dir,
+        trace_id=getattr(args, "trace_id", None),
+        incidents_only=getattr(args, "incident", False),
+        skew_correct=not getattr(args, "no_skew_correct", False))
+    if summary is None:
+        print(f"no telemetry journal found under {args.job_dir}",
+              file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    if getattr(args, "json", False):
+        print(json.dumps(summary))
+    else:
+        print(timeline_mod.render_timeline_text(summary))
+    return EXIT_OK
 
 
 def run_score(args) -> int:
@@ -1630,10 +1688,13 @@ def run_loadtest(args) -> int:
                                       p99_target_ms=args.p99_target_ms,
                                       senders=args.senders, config=config)
         else:
-            report = lt.run_loadtest(args.model, connect=args.connect,
-                                     engine=args.engine, rate=args.rate,
-                                     duration=args.duration,
-                                     senders=args.senders, config=config)
+            report = lt.run_loadtest(
+                args.model, connect=args.connect,
+                engine=args.engine, rate=args.rate,
+                duration=args.duration, senders=args.senders,
+                config=config,
+                trace_sample=getattr(args, "trace_sample", 0),
+                trace_exemplars=getattr(args, "trace_exemplars", 5))
     except (ValueError, OSError, KeyError, RuntimeError) as e:
         print(f"loadtest: {e}", file=sys.stderr, flush=True)
         return EXIT_FAIL
@@ -1987,6 +2048,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.command == "fleet-verify":
         # likewise journal reads only — no jax import
         return run_fleet_verify(args)
+    if args.command == "timeline":
+        # likewise journal reads only — no jax import
+        return run_timeline(args)
     if args.command == "cache":
         # cache-dir file reads only — no jax import
         return run_cache(args)

@@ -166,7 +166,18 @@ def serving_rollup(paths: list,
     down = 0
     active: list[dict] = []
     firing: set = set()
+    route_traces = 0
+    hedges = 0
+    incidents = 0
+    incidents_open = 0
     for d in daemons:
+        # tracing/incident counts are historical, not live capacity —
+        # a DOWN member's journal still tells the incident story
+        route_traces += int(d.get("route_traces") or 0)
+        hedges += int(d.get("hedges") or 0)
+        inc = d.get("incidents") or {}
+        incidents += int(inc.get("total") or 0)
+        incidents_open += int(inc.get("open") or 0)
         if d.get("down"):
             down += 1
             continue  # a dead member's last frame is not live capacity
@@ -201,6 +212,10 @@ def serving_rollup(paths: list,
             "queue_depth": queue,
             "active_alerts": len(active),
             "firing": sorted(firing),
+            "route_traces": route_traces,
+            "hedges": hedges,
+            "incidents": incidents,
+            "incidents_open": incidents_open,
             "hosts": {h: hosts[h] for h in sorted(hosts)},
         },
     }

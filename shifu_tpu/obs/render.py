@@ -794,6 +794,8 @@ def top_summary(path: str,
     serve_start: Optional[dict] = None
     loadtests: list[dict] = []
     traces = 0
+    route_traces = 0
+    hedges = 0
     slo_profiles = 0
     tier_last: Optional[dict] = None
     dedup_last: Optional[dict] = None
@@ -810,6 +812,10 @@ def top_summary(path: str,
             loadtests.append(rec)
         elif kind == "request_trace":
             traces += 1
+        elif kind == "route_trace":
+            route_traces += 1
+            if rec.get("hedged"):
+                hedges += 1
         elif kind == "device_profile" and rec.get("trigger") == "slo":
             slo_profiles += 1
         elif kind == "epoch":
@@ -902,6 +908,9 @@ def top_summary(path: str,
             out["stages"] = last["stages"]
         out["slo"] = _slo_state_from_alerts(alerts, last.get("slo"))
         out["request_traces"] = traces
+        if route_traces:
+            out["route_traces"] = route_traces
+            out["hedges"] = hedges
         if slo_profiles:
             out["slo_device_profiles"] = slo_profiles
     else:
@@ -924,6 +933,24 @@ def top_summary(path: str,
             embed["dedup_ratio"] = dedup_last.get("dedup_ratio")
         if embed:
             out["embed"] = embed
+    # incident digest from the same tail: failover / SLO / degraded-swap
+    # episodes stitched by obs/timeline.py (lazy import; `shifu-tpu
+    # timeline` holds the full records with causal chains + traces)
+    if any(rec.get("kind") in ("fleet_failover", "fleet_swap_degraded",
+                               "slo_alert") for rec in events):
+        try:
+            from . import timeline as timeline_mod
+            inc = timeline_mod.reconstruct_incidents(
+                timeline_mod.merge_sources([(events, "")]))
+        except Exception:
+            inc = []
+        if inc:
+            out["incidents"] = {
+                "total": len(inc),
+                "open": sum(1 for i in inc if not i["resolved"]),
+                "last": {"id": inc[-1]["id"], "kind": inc[-1]["kind"],
+                         "resolved": inc[-1]["resolved"],
+                         "recovery_s": inc[-1]["recovery_s"]}}
     return out
 
 
@@ -1034,6 +1061,21 @@ def render_top_text(summary: dict) -> str:
                      + (f"  slo device profiles: "
                         f"{summary['slo_device_profiles']}"
                         if summary.get("slo_device_profiles") else ""))
+    if summary.get("route_traces"):
+        lines.append(f"route traces: {summary['route_traces']}"
+                     + (f"  hedged: {summary['hedges']}"
+                        if summary.get("hedges") else ""))
+    inc = summary.get("incidents")
+    if inc:
+        last = inc.get("last") or {}
+        lines.append(
+            f"incidents: {inc.get('total')} ({inc.get('open')} open)"
+            + (f"  last: {last.get('kind')}"
+               + (f" recovered in {last.get('recovery_s')}s"
+                  if last.get("recovery_s") is not None else
+                  ("" if last.get("resolved") else " OPEN"))
+               if last else "")
+            + "  — `shifu-tpu timeline` for causal chains")
     ep = summary.get("epoch")
     if ep:
         lines.append(
@@ -1091,6 +1133,12 @@ def render_top_fleet_text(rollup: dict) -> str:
            else "-")
         + f"  worst p99 {fleet.get('worst_p99_ms')} ms  "
         f"active alerts {fleet.get('active_alerts')}"]
+    if fleet.get("route_traces") or fleet.get("incidents"):
+        lines.append(
+            f"  route traces {fleet.get('route_traces', 0)}"
+            f"  hedged {fleet.get('hedges', 0)}"
+            f"  incidents {fleet.get('incidents', 0)}"
+            f" ({fleet.get('incidents_open', 0)} open)")
     hosts = fleet.get("hosts") or {}
     if [h for h in hosts if h != "-"]:
         # the cross-host view: one cell per placement, dark hosts loud

@@ -631,7 +631,14 @@ class FleetManager:
             HostPlane(self.fleet.hosts, self.root_dir)
             if self.fleet.hosts else None)
         self.router = FleetRouter(self.fleet)
+        # ingress trace sampling rides the serving config: the router
+        # mints 1-in-N; members force-sample whatever arrives sampled
+        self.router.trace_sample = self.serving.trace_sample
         self._lock = threading.RLock()
+        # per-host clock-offset estimation (see _observe_skew)
+        self._skew_offsets: dict = {}
+        self._skew_published: dict = {}
+        self._skew_samples: dict = {}
         self.members: dict[str, FleetMember] = {}   # in rotation or stale
         self.standbys: list[FleetMember] = []
         # split-brain ledger: DOWN members kept (not killed) awaiting
@@ -773,7 +780,8 @@ class FleetManager:
         m.state = STATE_ACTIVE
         self.members[m.member_id] = m
         self.router.add(m.member_id, m.host, m.port,
-                        generation=m.generation)
+                        generation=m.generation,
+                        host_id=getattr(m, "host_id", ""))
 
     def member_dirs(self) -> list:
         """Telemetry dirs of every member (active + standby + stale +
@@ -830,13 +838,53 @@ class FleetManager:
             suspects = [m for m in self.members.values()
                         if m.state == STATE_ACTIVE]
         for m in suspects:
-            age = lease_age_s(read_lease(m.tele_dir), now=now)
+            lease = read_lease(m.tele_dir)
+            self._observe_skew(lease, now)
+            age = lease_age_s(lease, now=now)
             if age is None or age > ttl:
                 self.failover(m, lease_age=age)
                 failed.append(m.member_id)
         self._sweep_standbys(now, ttl)
         self._tend_downed(now, ttl)
         return failed
+
+    def _observe_skew(self, lease: Optional[dict], now: float) -> None:
+        """Per-host clock-offset estimation off the lease round-trips
+        already flowing through the monitor: every fresh lease gives one
+        sample of ``manager_now - member_lease_ts``.  True lease age is
+        >= 0, so the RUNNING MIN of the samples approximates the host's
+        clock offset (manager frame) with a positive bias bounded by one
+        heartbeat period — tight enough to causally order cross-host
+        journal events at failover scale (obs/timeline.py).  Publishes a
+        `fleet_clock_skew` journal event per host on first observation
+        and whenever the estimate moves > 5ms; |offset| is clamped to
+        `timeline_max_offset_s` (a lease stamped by a wildly wrong clock
+        must not fling the merge)."""
+        if not self.fleet.timeline_skew_correct:
+            return
+        if not lease or not isinstance(lease.get("ts"), (int, float)):
+            return
+        host = lease.get("host")
+        if not host:
+            return  # single-host in-proc fleet: one clock, no offsets
+        from .. import obs
+
+        cap = self.fleet.timeline_max_offset_s
+        sample = max(-cap, min(cap, now - float(lease["ts"])))
+        with self._lock:
+            n = self._skew_samples.get(host, 0) + 1
+            self._skew_samples[host] = n
+            prev = self._skew_offsets.get(host)
+            est = sample if prev is None else min(prev, sample)
+            self._skew_offsets[host] = est
+            published = self._skew_published.get(host)
+            if published is not None and abs(est - published) <= 0.005:
+                return
+            self._skew_published[host] = est
+        obs.event("fleet_clock_skew", host=str(host),
+                  offset_s=round(est, 4),
+                  rtt_bound_s=round(self.fleet.heartbeat_every_s, 4),
+                  samples=n)
 
     def _sweep_standbys(self, now: float, ttl: float) -> None:
         """A standby is only a standby while ITS lease is fresh: a dead

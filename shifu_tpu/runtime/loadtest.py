@@ -70,13 +70,23 @@ def run_loadtest(export_dir: Optional[str] = None, *,
                  senders: int = 2,
                  seed: int = 0,
                  config: Optional[ServingConfig] = None,
-                 drain_timeout: float = 30.0) -> dict:
+                 drain_timeout: float = 30.0,
+                 trace_sample: int = 0,
+                 trace_exemplars: int = 5) -> dict:
     """One open-loop run at a fixed offered rate; returns the report dict
     (offered/achieved scores/s, exact p50/p99/max latency, reject/error
-    counts).  Exactly one of `export_dir` / `daemon` / `connect`."""
+    counts).  Exactly one of `export_dir` / `daemon` / `connect`.
+
+    `trace_sample` > 0 mints a distributed TraceContext (obs/tracing.py)
+    for every Nth request and the report carries `trace_exemplars`: the
+    trace_ids of the N SLOWEST sampled requests — a bad ramp's p99 is
+    immediately traceable to its hop/stage decomposition in
+    `shifu-tpu timeline`.  0 = off: no minting, no per-request overhead."""
     if connect is not None:
         return _run_socket(connect, rate=rate, duration=duration,
-                           senders=senders, seed=seed)
+                           senders=senders, seed=seed,
+                           trace_sample=trace_sample,
+                           trace_exemplars=trace_exemplars)
     own_daemon = daemon is None
     if own_daemon:
         if export_dir is None:
@@ -86,14 +96,35 @@ def run_loadtest(export_dir: Optional[str] = None, *,
     try:
         return _run_inproc(daemon, rate=rate, duration=duration,
                            senders=senders, seed=seed,
-                           drain_timeout=drain_timeout)
+                           drain_timeout=drain_timeout,
+                           trace_sample=trace_sample,
+                           trace_exemplars=trace_exemplars)
     finally:
         if own_daemon:
             daemon.stop()
 
 
+def _top_exemplars(arrivals: np.ndarray, latencies: np.ndarray,
+                   trace_map: dict, limit: int) -> list:
+    """The `limit` slowest SAMPLED requests as [{trace_id, ms}], joined
+    by exact arrival stamp (senders key `trace_map` with the same float
+    they submit as t_arrival — float64 round-trips exactly)."""
+    out: list = []
+    if not trace_map or limit <= 0 or latencies.size == 0:
+        return out
+    for i in np.argsort(latencies)[::-1]:
+        tid = trace_map.get(float(arrivals[i]))
+        if tid is not None:
+            out.append({"trace_id": tid,
+                        "ms": round(float(latencies[i]) * 1e3, 3)})
+            if len(out) >= limit:
+                break
+    return out
+
+
 def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
-                senders: int, seed: int, drain_timeout: float) -> dict:
+                senders: int, seed: int, drain_timeout: float,
+                trace_sample: int = 0, trace_exemplars: int = 5) -> dict:
     rng = np.random.default_rng(seed)
     rows = _make_rows(daemon.num_features, rng)
     n_unique = len(rows)
@@ -121,11 +152,20 @@ def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
     # Python allows (plain floats, no per-request numpy indexing)
     row_views = list(rows)  # slice once; senders share the 1-D views
     offsets = schedule.tolist()
+    # trace contexts are pre-minted OUTSIDE the timed region too: the
+    # sampled sender path adds one tuple element, not an os.urandom call
+    if trace_sample > 0:
+        from ..obs import tracing
+        ctx_for = [tracing.mint() if k % trace_sample == 0 else None
+                   for k in range(n)]
+    else:
+        ctx_for = [None] * n
+    trace_map: dict = {}  # exact t_sched float -> trace_id (exemplars)
     per_sender = []
     for s in range(senders):
         idx = range(s, n, senders)  # thinned Poisson is still Poisson
-        per_sender.append([(offsets[k], row_views[k % n_unique])
-                           for k in idx])
+        per_sender.append([(offsets[k], row_views[k % n_unique],
+                            ctx_for[k]) for k in idx])
     # stamp the epoch AFTER the (slow) precompute: a t_start taken before
     # it would put every sender behind schedule from the first request
     t_start = time.perf_counter() + 0.02  # lead so senders start on time
@@ -136,7 +176,7 @@ def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
         sleep = time.sleep
         epoch = t_start
         n_sub = n_rej = 0
-        for off, row in per_sender[s]:
+        for off, row, ctx in per_sender[s]:
             t_sched = epoch + off
             dt = t_sched - clock()
             if dt > 0:
@@ -148,8 +188,11 @@ def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
                 # the open-loop contract.
                 sleep(dt)
             try:
-                submit(row, t_arrival=t_sched, need_future=False)
+                submit(row, t_arrival=t_sched, need_future=False,
+                       trace=ctx)
                 n_sub += 1
+                if ctx is not None:
+                    trace_map[t_sched] = ctx.trace_id
             except ServeOverload:
                 n_rej += 1
             except RuntimeError:
@@ -206,6 +249,10 @@ def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
     stages = daemon.stage_window(stages_at_start, daemon.stage_counts())
     if stages:
         report["stages"] = stages
+    if trace_sample > 0 and completed_batches:
+        all_arr = np.concatenate([a for a, _t in completed_batches])
+        report["trace_exemplars"] = _top_exemplars(
+            all_arr, latencies, trace_map, trace_exemplars)
     handle = daemon._registry.current(daemon.model_id)
     if handle is not None:
         report["engine"] = handle.engine_name
@@ -214,7 +261,8 @@ def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
 
 
 def _run_socket(connect: str, *, rate: float, duration: float,
-                senders: int, seed: int) -> dict:
+                senders: int, seed: int, trace_sample: int = 0,
+                trace_exemplars: int = 5) -> dict:
     from . import serve_wire
 
     host, _, port_s = connect.rpartition(":")
@@ -231,6 +279,13 @@ def _run_socket(connect: str, *, rate: float, duration: float,
     err_counts = [0] * senders
     rej_counts = [0] * senders
     reconnects = [0] * senders
+    # sampled requests carry a wire trace (v2 frames); each sender
+    # records (latency, trace_id) pairs for the exemplar join
+    sampled_lists: list[list] = [[] for _ in range(senders)]
+    if trace_sample > 0:
+        from ..obs import tracing
+    else:
+        tracing = None
     t_start = time.perf_counter() + 0.05
     # a sender may reconnect until the schedule has fully played out
     # (plus grace for the last round-trips): failover drills measure
@@ -278,11 +333,17 @@ def _run_socket(connect: str, *, rate: float, duration: float,
                 dt = t_sched - time.perf_counter()
                 if dt > 0:
                     time.sleep(dt)  # see _run_inproc: never spin
+                ctx = (tracing.mint() if tracing is not None
+                       and k % trace_sample == 0 else None)
                 sent = False
                 while not sent:
                     try:
-                        client.score_rows(rows[k % n_unique][None, :])
-                        lats.append(time.perf_counter() - t_sched)
+                        client.score_rows(rows[k % n_unique][None, :],
+                                          trace=ctx)
+                        lat = time.perf_counter() - t_sched
+                        lats.append(lat)
+                        if ctx is not None:
+                            sampled_lists[s].append((lat, ctx.trace_id))
                         ladder.ok()  # a COMPLETED round-trip — the only
                         #              reset (never a bare connect)
                         sent = True
@@ -338,6 +399,12 @@ def _run_socket(connect: str, *, rate: float, duration: float,
         "senders": senders,
         **_percentiles(latencies),
     }
+    if trace_sample > 0:
+        sampled = sorted((p for lst in sampled_lists for p in lst),
+                         reverse=True)[:max(trace_exemplars, 0)]
+        report["trace_exemplars"] = [
+            {"trace_id": tid, "ms": round(lat * 1e3, 3)}
+            for lat, tid in sampled]
     # the daemon's lifetime stage decomposition over the wire (STATS):
     # not windowed to this run (the daemon may serve other traffic), but
     # still names the stage a remote p99 excursion lives in
@@ -437,6 +504,10 @@ def render_report(report: dict) -> str:
         parts = [f"{s} {stages[s]['mean_ms']}/{stages[s]['p99_ms']}ms"
                  for s in STAGES if s in stages]
         lines.append("  stages (mean/p99): " + "  ".join(parts))
+    exemplars = report.get("trace_exemplars")
+    if exemplars:
+        lines.append("  slowest traces: " + "  ".join(
+            f"{e['trace_id']}={e['ms']}ms" for e in exemplars))
     return "\n".join(lines)
 
 

@@ -76,10 +76,12 @@ class _Member:
     backoff state, last pushed burn, artifact generation."""
 
     def __init__(self, member_id: str, host: str, port: int,
-                 generation: int, cfg: FleetConfig):
+                 generation: int, cfg: FleetConfig,
+                 host_id: str = ""):
         self.member_id = member_id
         self.host = host
         self.port = port
+        self.host_id = host_id   # fleet placement id for hop attribution
         self.generation = generation
         self.burn = 0.0
         self.backoff = _Backoff(cfg.backoff_base_ms / 1e3,
@@ -139,14 +141,22 @@ class FleetRouter:
         self._hedges = 0
         self._sheds = 0
         self._errors = 0
+        # distributed-tracing ingress sampling: 1-in-N requests mint a
+        # TraceContext here (obs/tracing.py) unless the wire frame
+        # already carried one.  0 = off — no minting, no journaling, no
+        # clock reads on the untraced path.  The FleetManager wires this
+        # from ServingConfig.trace_sample.
+        self.trace_sample = 0
+        self._ingress = 0
 
     # -- membership (manager-facing) -----------------------------------
 
     def add(self, member_id: str, host: str, port: int, *,
-            generation: int = 0) -> None:
+            generation: int = 0, host_id: str = "") -> None:
         with self._lock:
             self._members[member_id] = _Member(
-                member_id, host, port, generation, self.cfg)
+                member_id, host, port, generation, self.cfg,
+                host_id=host_id)
             self._rebuild_ring()
 
     def remove(self, member_id: str) -> None:
@@ -234,21 +244,62 @@ class FleetRouter:
 
     # -- request paths --------------------------------------------------
 
-    def _roundtrip(self, attempt_fn, key: str):
+    @staticmethod
+    def _hop(hops, attempt: int, m: _Member, outcome: str,
+             t_hop: float) -> None:
+        """Record one attempt's span — only when the request is sampled
+        (`hops` is None otherwise: no clock math on the untraced path)."""
+        if hops is None:
+            return
+        hops.append({"attempt": attempt, "member": m.member_id,
+                     "host": m.host_id or m.host, "outcome": outcome,
+                     "ms": round((time.perf_counter() - t_hop) * 1e3, 4)})
+
+    def _journal_route(self, trace, hops, t0: float, outcome: str,
+                       rows: int = 0) -> None:
+        """The router's terminal `route_trace` event: every hop span of
+        this trace plus the router-side residual (`queue_ms` = e2e minus
+        the hops — candidate selection, backoff waits, hedge gaps), so
+        ``sum(hop.ms) + queue_ms == e2e_ms`` by construction — the
+        client-observed latency decomposes exactly."""
+        if trace is None or not trace.sampled or hops is None:
+            return
+        from .. import obs
+        e2e_ms = (time.perf_counter() - t0) * 1e3
+        hop_ms = sum(h["ms"] for h in hops)
+        obs.event("route_trace", trace_id=trace.trace_id, hops=hops,
+                  hedged=len(hops) > 1,
+                  queue_ms=round(max(e2e_ms - hop_ms, 0.0), 4),
+                  e2e_ms=round(e2e_ms, 4), outcome=outcome,
+                  rows=int(rows))
+
+    def _roundtrip(self, attempt_fn, key: str, trace=None,
+                   t_ingress: Optional[float] = None, n_rows: int = 0):
         """Route with per-request timeout + one hedged retry: try the
         primary; on transport death / timeout put it in backoff and hedge
         to the next candidate.  Overload from the primary sheds once to
-        the least-burned alternative before surfacing."""
+        the least-burned alternative before surfacing.
+
+        `attempt_fn(client, trace)` receives the per-attempt trace
+        context (attempt index stamped in) so each hop's wire frame
+        carries its own ordinal; a sampled trace journals a terminal
+        `route_trace` with one span per attempt."""
         from .. import chaos
         from . import serve_wire
 
         chaos.maybe_fail(ROUTE_SITE, key=key)
+        t0 = time.perf_counter() if t_ingress is None else t_ingress
+        hops = [] if (trace is not None and trace.sampled) else None
         cands = self.candidates(key)
         if not cands:
+            self._journal_route(trace, hops, t0, "no_member", n_rows)
             raise NoHealthyMember("no healthy fleet member in rotation")
         last_err: Optional[BaseException] = None
         hedged = False
         for i, m in enumerate(cands[:2]):   # primary + ONE hedge
+            hop_trace = trace.with_attempt(i) if trace is not None \
+                else None
+            t_hop = time.perf_counter()
             # connect (checkout) and the request proper are SEPARATE
             # failure domains: the accepts-then-dies zombie (a kill()'d
             # member whose listener lingers) connects fine and dies on
@@ -261,9 +312,10 @@ class FleetRouter:
                 m.drain_pool()
                 last_err = e
                 hedged = True
+                self._hop(hops, i, m, "connect_error", t_hop)
                 continue
             try:
-                out = attempt_fn(client)
+                out = attempt_fn(client, hop_trace)
             except serve_wire.WireOverload as e:
                 # member alive but shedding: it is NOT a transport
                 # failure — no backoff, but try the other candidate once
@@ -271,11 +323,14 @@ class FleetRouter:
                 last_err = e
                 with self._lock:
                     self._sheds += 1
+                self._hop(hops, i, m, "overload", t_hop)
                 continue
             except serve_wire.WireError as e:
                 # application-level error from a healthy member: the
                 # request itself is bad — hedging elsewhere won't help
                 m.checkin(client)
+                self._hop(hops, i, m, "error", t_hop)
+                self._journal_route(trace, hops, t0, "error", n_rows)
                 raise e
             except (ConnectionError, socket.timeout, OSError) as e:
                 m.invalidate(client)
@@ -283,32 +338,57 @@ class FleetRouter:
                 m.drain_pool()
                 last_err = e
                 hedged = True
+                self._hop(hops, i, m,
+                          ("timeout" if isinstance(e, socket.timeout)
+                           else "connect_error"), t_hop)
                 continue
             m.checkin(client)
             # the ONLY ladder reset: a COMPLETED round-trip — never a
             # bare successful connect (see the zombie note above)
             m.backoff.ok()
+            self._hop(hops, i, m, "ok", t_hop)
             with self._lock:
                 self._routed += 1
                 if i > 0:
                     self._hedges += 1
+            self._journal_route(trace, hops, t0, "ok", n_rows)
             return out
         with self._lock:
             self._errors += 1
         if isinstance(last_err, serve_wire.WireOverload):
+            self._journal_route(trace, hops, t0, "overload", n_rows)
             raise last_err
+        self._journal_route(trace, hops, t0, "route_failed", n_rows)
         raise ConnectionError(
             f"fleet route failed (hedged={hedged}): {last_err}")
 
-    def score_rows(self, rows, *, model_id: str = "default"):
+    def _maybe_mint(self, trace):
+        """Ingress sampling: 1-in-`trace_sample` traceless requests get
+        a fresh sampled TraceContext.  A client-supplied trace always
+        wins — the caller's sampling decision is authoritative."""
+        if trace is not None or self.trace_sample <= 0:
+            return trace
+        with self._lock:
+            self._ingress += 1
+            if self._ingress % self.trace_sample:
+                return None
+        from ..obs import tracing
+        return tracing.mint()
+
+    def score_rows(self, rows, *, model_id: str = "default", trace=None,
+                   t_ingress: Optional[float] = None):
+        trace = self._maybe_mint(trace)
+        n = int(getattr(rows, "shape", (1,))[0]) if hasattr(
+            rows, "shape") and getattr(rows, "ndim", 1) > 1 else 1
         return self._roundtrip(
-            lambda c: c.score_rows(rows), key=model_id)
+            lambda c, t: c.score_rows(rows, trace=t), key=model_id,
+            trace=trace, t_ingress=t_ingress, n_rows=n)
 
     def stats(self, *, model_id: str = "default") -> dict:
-        return self._roundtrip(lambda c: c.stats(), key=model_id)
+        return self._roundtrip(lambda c, _t: c.stats(), key=model_id)
 
     def ping(self, *, model_id: str = "default") -> bool:
-        return self._roundtrip(lambda c: c.ping(), key=model_id)
+        return self._roundtrip(lambda c, _t: c.ping(), key=model_id)
 
     def router_stats(self) -> dict:
         with self._lock:
@@ -393,11 +473,15 @@ class RouterServer:
         try:
             while not self._closing.is_set():
                 try:
-                    op, dtype, n_rows, n_cols, scale, offset, payload = \
-                        serve_wire.read_request(conn)
+                    (op, dtype, n_rows, n_cols, scale, offset, payload,
+                     trace) = serve_wire.read_request(conn,
+                                                      with_trace=True)
                 except (ConnectionError, socket.timeout, OSError,
                         ValueError):
                     return
+                # ingress stamp at frame receipt: the route_trace e2e
+                # covers everything the client waited for past the wire
+                t_ingress = time.perf_counter()
                 try:
                     if op == serve_wire.OP_PING:
                         serve_wire.write_response(
@@ -406,7 +490,8 @@ class RouterServer:
                         rows = serve_wire.decode_rows(
                             payload, dtype, n_rows, n_cols, scale,
                             offset)
-                        out = self.router.score_rows(rows)
+                        out = self.router.score_rows(
+                            rows, trace=trace, t_ingress=t_ingress)
                         body = np.ascontiguousarray(
                             out, dtype=np.float32).tobytes()
                         serve_wire.write_response(

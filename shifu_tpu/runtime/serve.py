@@ -321,10 +321,11 @@ class ScoringDaemon:
         # a plain Lock, not the Condition default RLock: submit() takes it
         # once per request on the hot path and never recursively
         self._cond = threading.Condition(threading.Lock())
-        # [(row, t_arrival, future|None, t_enqueued, trace_seq)] —
+        # [(row, t_arrival, future|None, t_enqueued, trace_seq, trace)] —
         # t_enqueued splits sender lag (admission) from queue wait;
         # trace_seq is the admitted-request ordinal for the sampled
-        # request_trace journal (0 = untraced)
+        # request_trace journal (0 = untraced); trace is the distributed
+        # TraceContext a wire frame carried in (None off the fleet path)
         self._queue: list = []
         self._running = False
         self._accepting = False
@@ -397,7 +398,7 @@ class ScoringDaemon:
         # anything a timed-out worker left behind fails loudly
         with self._cond:
             leftovers, self._queue = self._queue, []
-        for _row, _t, fut, _te, _ts in leftovers:
+        for _row, _t, fut, _te, _ts, _tc in leftovers:
             if fut is not None:
                 fut.set_exception(RuntimeError("serving daemon stopped"))
         self._publish_metrics()
@@ -417,7 +418,7 @@ class ScoringDaemon:
             self._running = False
             leftovers, self._queue = self._queue, []
             self._cond.notify_all()
-        for _row, _t, fut, _te, _ts in leftovers:
+        for _row, _t, fut, _te, _ts, _tc in leftovers:
             if fut is not None:
                 fut.set_exception(RuntimeError("serving daemon killed"))
         self._threads.clear()
@@ -431,12 +432,18 @@ class ScoringDaemon:
     # -- request admission ---------------------------------------------
 
     def submit(self, row, t_arrival: Optional[float] = None,
-               need_future: bool = True) -> Optional[Future]:
+               need_future: bool = True, trace=None) -> Optional[Future]:
         """Admit one feature row; returns a Future of its (H,) scores.
 
         `t_arrival` (a time.perf_counter() timestamp) lets an open-loop
         driver charge latency from the SCHEDULED arrival, so a sender
         running behind cannot hide queueing delay (coordinated omission).
+
+        `trace` is the distributed TraceContext the wire server decoded
+        from a version-2 frame (obs/tracing.py).  A sampled trace FORCES
+        this request into the request_trace journal regardless of the
+        local `trace_sample` cadence — the ingress sampling decision
+        owns the trace; its member-side hops must not go dark.
         """
         if getattr(row, "shape", None) != self._row_shape:
             # coerce odd inputs up front: a malformed row must be rejected
@@ -463,9 +470,11 @@ class ScoringDaemon:
             trace_seq = (self._admitted
                          if sample > 0 and self._admitted % sample == 0
                          else 0)
+            if trace is not None and trace.sampled and not trace_seq:
+                trace_seq = self._admitted
             # the enqueue stamp closes the `admission` stage (validation +
             # lock + append) and opens `queue`; one clock read per request
-            q.append((row, t, fut, time.perf_counter(), trace_seq))
+            q.append((row, t, fut, time.perf_counter(), trace_seq, trace))
             n = len(q)
             # wake the dispatcher only on the transitions that matter: an
             # idle worker (empty -> 1) or a full batch; every other submit
@@ -475,12 +484,13 @@ class ScoringDaemon:
         return fut
 
     def score(self, row, timeout: Optional[float] = None,
-              t_arrival: Optional[float] = None) -> np.ndarray:
+              t_arrival: Optional[float] = None, trace=None) -> np.ndarray:
         """Synchronous single-request scoring through the batcher.
         `t_arrival` extends the lifecycle chain upstream: the wire server
         passes the frame-read stamp so socket transfer/parse time rides
-        the admission stage instead of vanishing."""
-        fut = self.submit(row, t_arrival=t_arrival)
+        the admission stage instead of vanishing; `trace` carries the
+        frame's distributed trace context into the batcher."""
+        fut = self.submit(row, t_arrival=t_arrival, trace=trace)
         return fut.result(timeout=timeout)
 
     def score_batch(self, rows: np.ndarray) -> np.ndarray:
@@ -562,7 +572,8 @@ class ScoringDaemon:
 
     def _process(self, batch: list, t_window: float, t_take: float) -> None:
         n = len(batch)
-        rows, arrival_ts, futures, enq_ts, trace_seqs = zip(*batch)
+        rows, arrival_ts, futures, enq_ts, trace_seqs, trace_ctxs = \
+            zip(*batch)
         x = np.stack(rows) if n > 1 else rows[0][None, :]
         handle = self._registry.acquire(self.model_id)
         err: Optional[Exception] = None
@@ -608,10 +619,11 @@ class ScoringDaemon:
                     fut.set_exception(err)
             with self._cond:
                 self._errors += n
-            self._journal_traces(trace_seqs, arrivals, np.asarray(
-                enq_ts, np.float64), t_window, t_take, t_exec, t_done,
-                t_done, n, padded, handle,
-                error=f"{type(err).__name__}: {err}"[:200])
+            self._journal_traces(trace_seqs, trace_ctxs, arrivals,
+                                 np.asarray(enq_ts, np.float64), t_window,
+                                 t_take, t_exec, t_done, t_done, n,
+                                 padded, handle,
+                                 error=f"{type(err).__name__}: {err}"[:200])
             return
         if any(f is not None for f in futures):
             for fut, s in zip(futures, scores):
@@ -645,21 +657,26 @@ class ScoringDaemon:
             self._batches += 1
             self._batch_rows += n
         if any(trace_seqs):
-            self._journal_traces(trace_seqs, arrivals, enqs, t_window,
-                                 t_take, t_exec, t_done, t_reply, n,
-                                 padded, handle)
+            self._journal_traces(trace_seqs, trace_ctxs, arrivals, enqs,
+                                 t_window, t_take, t_exec, t_done,
+                                 t_reply, n, padded, handle)
         if self._on_batch is not None:
             try:
                 self._on_batch(scores, arrivals, t_done)
             except Exception:
                 pass  # a driver's bookkeeping bug must not kill dispatch
 
-    def _journal_traces(self, trace_seqs, arrivals, enqs, t_window, t_take,
-                        t_exec, t_done, t_reply, n: int, padded: int,
-                        handle, error: Optional[str] = None) -> None:
+    def _journal_traces(self, trace_seqs, trace_ctxs, arrivals, enqs,
+                        t_window, t_take, t_exec, t_done, t_reply, n: int,
+                        padded: int, handle,
+                        error: Optional[str] = None) -> None:
         """Journal one `request_trace` event per sampled request of this
         batch: the full stage decomposition in ms, summing exactly to
-        e2e_ms (shared stamps — no gap, no overlap is possible)."""
+        e2e_ms (shared stamps — no gap, no overlap is possible).  A
+        request that arrived with a distributed TraceContext joins the
+        fleet trace by `trace_id` + `hop` (the router's attempt index),
+        so a hedged request's two member-side decompositions line up
+        under one trace in `shifu-tpu timeline`."""
         from .. import obs
 
         for i, seq in enumerate(trace_seqs):
@@ -682,6 +699,10 @@ class ScoringDaemon:
                 "engine": handle.engine_name,
                 "model_version": handle.version,
             }
+            ctx = trace_ctxs[i]
+            if ctx is not None:
+                fields["trace_id"] = ctx.trace_id
+                fields["hop"] = int(ctx.attempt)
             if error is not None:
                 fields["error"] = error
             obs.event("request_trace", **fields)

@@ -40,6 +40,13 @@ import numpy as np
 
 MAGIC = 0x57565253  # b"SRVW" little-endian
 VERSION = 1
+# version 2 = a version-1 frame plus a 20-byte trace-context extension
+# (obs/tracing.WIRE_EXT: trace_id + attempt + sampled) between the fixed
+# header and the payload.  `plen` still counts the payload ONLY, so a v1
+# reader that ignored the version would still frame correctly; servers
+# accept both versions and clients emit v2 only when a trace rides along
+# (docs/SERVING.md "Wire protocol").
+VERSION_TRACED = 2
 
 OP_SCORE = 1
 OP_SWAP = 2
@@ -130,14 +137,21 @@ MAX_SCORE_PAYLOAD = 64 << 20   # 64 MiB ≈ 16k rows x 1k f32 features
 MAX_CONTROL_PAYLOAD = 1 << 20  # SWAP/STATS/PING bodies are tiny JSON
 
 
-def read_request(sock: socket.socket):
+def read_request(sock: socket.socket, with_trace: bool = False):
     """One request frame -> (opcode, dtype, n_rows, n_cols, scale,
-    offset, payload); raises ConnectionError on clean close."""
+    offset, payload); raises ConnectionError on clean close.  With
+    ``with_trace=True`` an 8th element is appended: the frame's
+    TraceContext (version-2 frames) or None (version-1) — default stays
+    a 7-tuple so existing callers are untouched."""
     hdr = _recv_exact(sock, _REQ.size)
     magic, ver, op, dtype, n_rows, n_cols, scale, offset, plen = \
         _REQ.unpack(hdr)
-    if magic != MAGIC or ver != VERSION:
+    if magic != MAGIC or ver not in (VERSION, VERSION_TRACED):
         raise WireError(f"bad frame magic/version {magic:#x}/{ver}")
+    trace = None
+    if ver == VERSION_TRACED:
+        from ..obs import tracing
+        trace = tracing.unpack(_recv_exact(sock, tracing.WIRE_EXT_BYTES))
     if op == OP_SCORE:
         itemsize = 1 if dtype == DTYPE_INT8 else 4
         want = n_rows * n_cols * itemsize
@@ -149,6 +163,8 @@ def read_request(sock: socket.socket):
     elif plen > MAX_CONTROL_PAYLOAD:
         raise WireError(f"oversized control payload {plen}")
     payload = _recv_exact(sock, plen) if plen else b""
+    if with_trace:
+        return op, dtype, n_rows, n_cols, scale, offset, payload, trace
     return op, dtype, n_rows, n_cols, scale, offset, payload
 
 
@@ -251,7 +267,7 @@ class ServeServer:
             with conn:
                 while True:
                     try:
-                        frame = read_request(conn)
+                        frame = read_request(conn, with_trace=True)
                     except (ConnectionError, OSError):
                         return
                     except WireError as e:
@@ -274,7 +290,7 @@ class ServeServer:
                 self._conns.discard(conn)
 
     def _handle(self, conn, t_arrival, op, dtype, n_rows, n_cols, scale,
-                offset, payload) -> None:
+                offset, payload, trace=None) -> None:
         daemon = self.daemon
         if op == OP_PING:
             write_response(conn, 0)
@@ -306,7 +322,7 @@ class ServeServer:
                                offset)
             if n_rows == 1:
                 scores = daemon.score(rows[0], timeout=self._timeout,
-                                      t_arrival=t_arrival)
+                                      t_arrival=t_arrival, trace=trace)
                 scores = np.asarray(scores)[None, :]
             else:
                 scores = daemon.score_batch(rows)
@@ -347,11 +363,16 @@ class ServeClient:
 
     def _roundtrip(self, op: int, dtype: int = DTYPE_F32,
                    n_rows: int = 0, n_cols: int = 0, scale: float = 1.0,
-                   offset: float = 0.0, payload: bytes = b""):
+                   offset: float = 0.0, payload: bytes = b"",
+                   trace=None):
+        # a traceless request is a byte-identical v1 frame — tracing off
+        # costs the wire nothing
+        ver = VERSION if trace is None else VERSION_TRACED
+        ext = b"" if trace is None else trace.pack()
         with self._lock:
-            self._sock.sendall(_REQ.pack(MAGIC, VERSION, op, dtype,
+            self._sock.sendall(_REQ.pack(MAGIC, ver, op, dtype,
                                          n_rows, n_cols, scale, offset,
-                                         len(payload)) + payload)
+                                         len(payload)) + ext + payload)
             hdr = _recv_exact(self._sock, _RSP.size)
             magic, ver, status, _pad, rn, rc, plen = _RSP.unpack(hdr)
             if magic != MAGIC or ver != VERSION:
@@ -371,14 +392,15 @@ class ServeClient:
         return True
 
     def score_rows(self, rows: np.ndarray, dtype: int = DTYPE_INT8,
-                   clip: float = DEFAULT_INT8_CLIP) -> np.ndarray:
+                   clip: float = DEFAULT_INT8_CLIP,
+                   trace=None) -> np.ndarray:
         x = np.asarray(rows, np.float32)
         if x.ndim == 1:
             x = x[None, :]
         payload, scale, offset = encode_rows(x, dtype=dtype, clip=clip)
         body, rn, rc = self._roundtrip(
             OP_SCORE, dtype=dtype, n_rows=x.shape[0], n_cols=x.shape[1],
-            scale=scale, offset=offset, payload=payload)
+            scale=scale, offset=offset, payload=payload, trace=trace)
         return np.frombuffer(body, np.float32).reshape(rn, rc)
 
     def swap(self, export_dir: str, engine: Optional[str] = None) -> dict:

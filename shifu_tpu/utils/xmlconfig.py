@@ -141,6 +141,9 @@ KEY_SERVING_HOST = "shifu.serving.host"
 # objectives (p99 ms / error-rate fraction / availability fraction, 0
 # disables each), and the multiwindow burn-rate knobs
 KEY_SERVING_TRACE_SAMPLE = "shifu.serving.trace-sample"
+# distributed tracing (obs/tracing.py): p99-exemplar count the loadtest
+# report carries (trace_ids of the N slowest requests)
+KEY_SERVING_TRACE_EXEMPLARS = "shifu.serving.trace-exemplars"
 KEY_SERVING_SLO_P99_MS = "shifu.serving.slo.p99-ms"
 KEY_SERVING_SLO_ERROR_RATE = "shifu.serving.slo.error-rate"
 KEY_SERVING_SLO_AVAILABILITY = "shifu.serving.slo.availability"
@@ -172,6 +175,10 @@ KEY_FLEET_MEMBER_MODE = "shifu.fleet.member-mode"
 KEY_FLEET_MEMBER_PORT_BASE = "shifu.fleet.member-port-base"
 KEY_FLEET_SYNC_ARTIFACTS = "shifu.fleet.sync-artifacts"
 KEY_FLEET_REJOIN_STANDBY = "shifu.fleet.rejoin-standby"
+# fleet timeline (obs/timeline.py): skew-corrected journal merge on/off
+# and the clamp on any single host's estimated clock offset
+KEY_FLEET_TIMELINE_SKEW_CORRECT = "shifu.fleet.timeline-skew-correct"
+KEY_FLEET_TIMELINE_MAX_OFFSET_S = "shifu.fleet.timeline-max-offset-s"
 
 
 def parse_sharding_rules(value: str) -> tuple:
@@ -283,6 +290,8 @@ def serving_config_from_conf(conf: Mapping[str, str], base: Any = None) -> Any:
         kw["host"] = conf[KEY_SERVING_HOST].strip()
     if KEY_SERVING_TRACE_SAMPLE in conf:
         kw["trace_sample"] = int(conf[KEY_SERVING_TRACE_SAMPLE])
+    if KEY_SERVING_TRACE_EXEMPLARS in conf:
+        kw["trace_exemplars"] = int(conf[KEY_SERVING_TRACE_EXEMPLARS])
     if KEY_SERVING_SLO_P99_MS in conf:
         kw["slo_p99_ms"] = float(conf[KEY_SERVING_SLO_P99_MS])
     if KEY_SERVING_SLO_ERROR_RATE in conf:
@@ -325,14 +334,18 @@ def fleet_config_from_conf(conf: Mapping[str, str], base: Any = None) -> Any:
                    KEY_FLEET_SCALE_EVERY_S: "scale_every_s",
                    KEY_FLEET_SCALE_UP_BURN: "scale_up_burn",
                    KEY_FLEET_SCALE_DOWN_BURN: "scale_down_burn",
-                   KEY_FLEET_SCALE_COOLDOWN_S: "scale_cooldown_s"}
+                   KEY_FLEET_SCALE_COOLDOWN_S: "scale_cooldown_s",
+                   KEY_FLEET_TIMELINE_MAX_OFFSET_S:
+                       "timeline_max_offset_s"}
     for key, field in _int_keys.items():
         if key in conf:
             kw[field] = int(conf[key])
     _str_keys = {KEY_FLEET_HOSTS: "hosts",
                  KEY_FLEET_MEMBER_MODE: "member_mode"}
     _bool_keys = {KEY_FLEET_SYNC_ARTIFACTS: "sync_artifacts",
-                  KEY_FLEET_REJOIN_STANDBY: "rejoin_standby"}
+                  KEY_FLEET_REJOIN_STANDBY: "rejoin_standby",
+                  KEY_FLEET_TIMELINE_SKEW_CORRECT:
+                      "timeline_skew_correct"}
     for key, field in _float_keys.items():
         if key in conf:
             kw[field] = float(conf[key])

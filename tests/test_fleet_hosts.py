@@ -126,9 +126,9 @@ def _serving_cfg(**kw) -> ServingConfig:
 
 
 def _mgr(tmp_path, export="stub://v0", loader=_tag_loader,
-         **fleet_kw) -> FleetManager:
+         serving_kw=None, **fleet_kw) -> FleetManager:
     return FleetManager(export, fleet=_fleet_cfg(**fleet_kw),
-                        serving=_serving_cfg(),
+                        serving=_serving_cfg(**(serving_kw or {})),
                         root_dir=str(tmp_path / "fleet"),
                         loader=loader)
 
@@ -363,9 +363,17 @@ def test_fleet_verify_events_fail_shapes():
 def test_host_kill_drill_promotes_on_surviving_host(tmp_path):
     """ISSUE-14 drill (a): kill a WHOLE host mid-open-loop-load.  The
     standby on the surviving host promotes (anti-affinity), the load
-    finishes with zero client errors, and fleet-verify passes."""
+    finishes with zero client errors, and fleet-verify passes.
+
+    ISSUE-16 extension: the fleet runs with ingress tracing at
+    trace_sample=1 — the kill must reconstruct as exactly ONE
+    `incident` with the causal chain lease-expiry -> failover ->
+    promotion -> recovery, and at least one request spanning the kill
+    carries BOTH hop spans (the failed attempt on the dead member and
+    the winning hedge) under one trace_id."""
     obs.configure(str(tmp_path / "tele"))
-    mgr = _mgr(tmp_path)   # 2 members + 1 standby across local:2
+    # 2 members + 1 standby across local:2, every request traced
+    mgr = _mgr(tmp_path, serving_kw={"trace_sample": 1})
     mgr.start()
     front = RouterServer(mgr.router, manager=mgr).start()
     try:
@@ -404,6 +412,30 @@ def test_host_kill_drill_promotes_on_surviving_host(tmp_path):
         assert failovers[0]["host"] == "local-1"
         assert failovers[0]["standby_host"] == "local-0"
         assert fleet_verify_events(evs)["verdict"] == "PASS"
+        # ISSUE-16: the kill reads as exactly ONE incident with the
+        # full causal chain on the merged timeline
+        from shifu_tpu.obs import timeline as timeline_mod
+        merged = timeline_mod.merged_fleet_events(str(tmp_path / "tele"))
+        incidents = [i for i in timeline_mod.reconstruct_incidents(merged)
+                     if i["kind"] == "fleet_failover"]
+        assert len(incidents) == 1
+        inc = incidents[0]
+        assert [s["step"] for s in inc["chain"]] == \
+            ["lease_expiry", "failover", "promotion", "recovery"]
+        assert inc["resolved"] and inc["recovery_s"] >= 0
+        assert inc["root"]["member"] == "member-1"
+        # ... and a request spanning the kill hedged: one trace, two
+        # hop spans — the dead member's failed attempt + the winner
+        routes = [e for e in evs if e["kind"] == "route_trace"]
+        assert len(routes) == report["completed"] + 1  # + the probe row
+        hedged = [r for r in routes if r["hedged"]]
+        assert hedged, "no request spanned the kill"
+        spanning = [r for r in hedged
+                    if len(r["hops"]) == 2
+                    and r["hops"][0]["outcome"] != "ok"
+                    and r["hops"][1]["outcome"] == "ok"]
+        assert spanning, hedged
+        assert inc["affected_traces"]   # the incident names them
     finally:
         front.close()
         mgr.stop()

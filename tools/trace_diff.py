@@ -11,13 +11,20 @@ device_profile event dict).
 
 Usage:
     python tools/trace_diff.py <run_A> <run_B> [--epoch N] [--json]
-        [--fail-above PCT]
+        [--fail-above PCT] [--serving]
 
 By default the LAST device_profile of each journal is compared (`--epoch`
 selects a specific captured epoch).  `--fail-above 50` exits 1 when any
 kernel seen on both sides grew more than 50% in device time (or the
 device total did) — wire it after perf_gate when a round needs per-kernel
 accountability, not just a verdict.
+
+`--serving` diffs the serving plane instead of the device plane: each
+side's last `loadtest_report` (p50/p99/rate + per-stage means) and its
+`route_trace` aggregates (hedge rate, mean hop/queue/e2e) from the
+journal tail.  An axis absent on either side gets status SKIP, never a
+verdict — perf_gate semantics: a journal predating the tracing layer
+must not fail the gate, it just can't vouch for the new axes.
 """
 
 from __future__ import annotations
@@ -66,6 +73,123 @@ def load_rollup(path: str, epoch: int | None = None) -> dict:
     return profiles[-1]
 
 
+# serving axes where a BIGGER number is the good direction (everything
+# else — latencies, hedge rate — regresses upward)
+_HIGHER_IS_BETTER = frozenset(("achieved_scores_per_sec",))
+# volume axes: informational only, never gated
+_UNGATED = frozenset(("route.count",))
+
+
+def _serving_axes(report: dict, routes: list) -> dict:
+    """{axis: value} from one side's last loadtest_report + route_trace
+    events — the serving-plane analog of a kernel rollup."""
+    axes: dict = {}
+    for k in ("p50_ms", "p99_ms", "achieved_scores_per_sec"):
+        v = report.get(k)
+        if isinstance(v, (int, float)):
+            axes[k] = float(v)
+    for stage, s in (report.get("stages") or {}).items():
+        if isinstance(s, dict) and isinstance(s.get("mean_ms"),
+                                              (int, float)):
+            axes[f"stage.{stage}.mean_ms"] = float(s["mean_ms"])
+    if routes:
+        axes["route.count"] = float(len(routes))
+        axes["route.hedge_rate"] = round(
+            sum(1 for r in routes if r.get("hedged")) / len(routes), 4)
+        hops = [h.get("ms") for r in routes for h in (r.get("hops") or [])
+                if isinstance(h.get("ms"), (int, float))]
+        if hops:
+            axes["route.hop_ms_mean"] = round(sum(hops) / len(hops), 4)
+        for field, axis in (("queue_ms", "route.queue_ms_mean"),
+                            ("e2e_ms", "route.e2e_ms_mean")):
+            vals = [r[field] for r in routes
+                    if isinstance(r.get(field), (int, float))]
+            if vals:
+                axes[axis] = round(sum(vals) / len(vals), 4)
+    return axes
+
+
+def load_serving_axes(path: str) -> dict:
+    """One side's serving decomposition: a telemetry/job dir (journal
+    tail) or a loadtest `--json` report file."""
+    if os.path.isfile(path) and not path.endswith(".jsonl"):
+        with open(path) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict) and (doc.get("stages")
+                                      or doc.get("p50_ms") is not None):
+            return _serving_axes(doc, [])
+        raise ValueError(f"{path}: not a loadtest report JSON")
+    from shifu_tpu.obs import render as obs_render
+    jpath = obs_render.find_journal(path)
+    if jpath is None:
+        raise ValueError(f"{path}: no telemetry journal found")
+    events, _n, _trunc = obs_render._load_events_tail(jpath)
+    report: dict = {}
+    routes: list = []
+    for ev in events:
+        if ev.get("kind") == "loadtest_report":
+            report = ev
+        elif ev.get("kind") == "route_trace":
+            routes.append(ev)
+    axes = _serving_axes(report, routes)
+    if not axes:
+        raise ValueError(
+            f"{path}: no loadtest_report or route_trace events — run "
+            "`shifu-tpu loadtest` (or sample traces with "
+            "shifu.serving.trace-sample) first")
+    return axes
+
+
+def _diff_serving(args) -> int:
+    try:
+        a = load_serving_axes(args.run_a)
+        b = load_serving_axes(args.run_b)
+    except (OSError, ValueError) as e:
+        print(f"trace-diff: {e}", file=sys.stderr, flush=True)
+        return EXIT_USAGE
+
+    limit = (1.0 + args.fail_above / 100.0) \
+        if args.fail_above is not None else None
+    rows = []
+    blamed = []
+    for axis in sorted(set(a) | set(b)):
+        va, vb = a.get(axis), b.get(axis)
+        row = {"axis": axis, "a": va, "b": vb,
+               "delta": None, "ratio": None, "status": "SKIP"}
+        if va is not None and vb is not None:
+            row["delta"] = round(vb - va, 4)
+            row["ratio"] = round(vb / va, 4) if va > 0 else None
+            row["status"] = "OK"
+            if limit is not None and va > 0 and axis not in _UNGATED:
+                worse = (vb < va / limit if axis in _HIGHER_IS_BETTER
+                         else vb > va * limit)
+                if worse:
+                    row["status"] = "REGRESSION"
+                    blamed.append(axis)
+        rows.append(row)
+    verdict = "REGRESSION" if blamed else "PASS"
+    report = {"a": args.run_a, "b": args.run_b, "mode": "serving",
+              "axes": rows, "blamed": blamed, "verdict": verdict}
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(f"trace-diff: {verdict} — serving plane, "
+              f"{len(rows)} axis(es), "
+              f"{sum(1 for r in rows if r['status'] == 'SKIP')} skipped")
+        print(f"  {'axis':<28} {'A':>12} {'B':>12} {'delta':>10} "
+              f"{'ratio':>7} {'status':>10}")
+        for r in rows:
+            ratio = f"x{r['ratio']}" if r["ratio"] is not None else "-"
+            print(f"  {r['axis'][:28]:<28} "
+                  f"{r['a'] if r['a'] is not None else '-':>12} "
+                  f"{r['b'] if r['b'] is not None else '-':>12} "
+                  f"{r['delta'] if r['delta'] is not None else '-':>10} "
+                  f"{ratio:>7} {r['status']:>10}")
+        if blamed:
+            print("  blamed: " + ", ".join(blamed))
+    return EXIT_PASS if verdict == "PASS" else EXIT_REGRESSION
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="trace_diff",
@@ -84,7 +208,14 @@ def main(argv=None) -> int:
                         "time")
     p.add_argument("--json", action="store_true",
                    help="machine-readable report instead of text")
+    p.add_argument("--serving", action="store_true",
+                   help="diff the serving plane (loadtest stage means + "
+                        "route_trace hop/queue aggregates) instead of "
+                        "device kernels; missing axes SKIP, never fail")
     args = p.parse_args(argv)
+
+    if args.serving:
+        return _diff_serving(args)
 
     from shifu_tpu.obs import tracefmt
 
