@@ -1105,6 +1105,43 @@ def main() -> None:
         except Exception as e:
             extras["serving_error"] = str(e)[:200]
 
+        # drift observatory accounting overhead (ISSUE 18): what the
+        # per-batch sketch update (ONE flattened bincount over the wire
+        # grid + a 64-bin score histogram) costs relative to scoring the
+        # same batches.  Recorded ONLY — not a perf_gate axis: the
+        # enabled-path guarantee lives in the tier-1 overhead-guard test;
+        # this is the measured number operators read before enabling.
+        try:
+            from shifu_tpu.obs import sketch as sketch_mod
+            from shifu_tpu.obs.drift import DriftMonitor
+
+            d_rng = np.random.default_rng(7)
+            d_batches = [d_rng.standard_normal(
+                (256, num_features)).astype(np.float32)
+                for _ in range(32)]
+            d_fs = sketch_mod.FeatureSketch(
+                num_features, *sketch_mod.default_grid(num_features))
+            d_ss = sketch_mod.ScoreSketch()
+            d_fs.update(d_batches[0])
+            d_scores = [np.asarray(scorer.compute_batch(b))[:, 0]
+                        for b in d_batches]
+            d_ss.update(d_scores[0])
+            mon = DriftMonitor(
+                sketch_mod.build_profile(d_fs, d_ss), "bench", 1, "")
+            t0 = time.perf_counter()
+            for b, s in zip(d_batches, d_scores):
+                mon.observe_batch(b, s)
+            t_account = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for b in d_batches:
+                scorer.compute_batch(b)
+            t_score = time.perf_counter() - t0
+            if t_score > 0:
+                extras["drift_accounting_overhead_pct"] = round(
+                    100.0 * t_account / t_score, 3)
+        except Exception as e:
+            extras["drift_error"] = str(e)[:200]
+
         # fleet rollup (ISSUE 12): a 2-member in-proc fleet on the SAME
         # artifact, driven through the router's wire face at 2x the
         # single-daemon capacity just measured.  The ratio

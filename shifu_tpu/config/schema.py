@@ -601,6 +601,65 @@ class EmbedConfig:
 # ---------------------------------------------------------------------------
 
 @dataclass(frozen=True)
+class DriftConfig:
+    """Model-quality / data-drift observatory knobs (`shifu.drift.*` XML
+    keys, obs/drift.py — docs/OBSERVABILITY.md "Drift observatory").
+
+    Nested under ServingConfig so it threads unchanged through the
+    daemon, fleet members and the loadtest probe.  Drift only engages
+    when the served artifact actually carries a `baseline_profile.json`;
+    `enabled` is the operator kill switch on top of that."""
+
+    # kill switch: False silences the whole drift plane — no sketch
+    # accumulation, no tick thread, zero drift events (the overhead
+    # guard's contract).
+    enabled: bool = True
+    # fast/slow trailing windows (seconds): an alert objective must
+    # violate in BOTH to fire (transient bursts don't page) and the
+    # fast window alone resolves it (recovery is quick).
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    # per-feature PSI threshold on the int8 wire grid, folded to 17
+    # groups; conventional reading: < 0.1 stable, 0.1-0.25 moderate,
+    # > 0.25 significant.  0 disables the feature_psi objective.
+    psi_threshold: float = 0.25
+    # KL(baseline || live) threshold for the score distribution;
+    # 0 disables the score_kl objective.
+    score_kl_threshold: float = 0.1
+    # how many worst features a drift_report / drift_alert names
+    top_k: int = 5
+    # fast window must hold at least this many rows before any
+    # judgment (quiet traffic never pages; idle unlatch below this).
+    min_rows: int = 200
+    # labeled-feedback path (wire FEEDBACK frame -> live AUC /
+    # auc_decay); off rejects FEEDBACK frames with STATUS_ERROR.
+    feedback: bool = True
+    # score-bin resolution of the feedback AUC accumulator
+    feedback_bins: int = 1024
+
+    def validate(self) -> None:
+        if self.fast_window_s <= 0 \
+                or self.slow_window_s < self.fast_window_s:
+            raise ConfigError(
+                "drift windows need 0 < fast_window_s <= slow_window_s: "
+                f"{self.fast_window_s}/{self.slow_window_s}")
+        if self.psi_threshold < 0:
+            raise ConfigError(
+                f"drift.psi-threshold must be >= 0: {self.psi_threshold}")
+        if self.score_kl_threshold < 0:
+            raise ConfigError("drift.score-kl-threshold must be >= 0: "
+                              f"{self.score_kl_threshold}")
+        if self.top_k < 1:
+            raise ConfigError(f"drift.top-k must be >= 1: {self.top_k}")
+        if self.min_rows < 1:
+            raise ConfigError(
+                f"drift.min-rows must be >= 1: {self.min_rows}")
+        if self.feedback_bins < 2:
+            raise ConfigError("drift.feedback-bins must be >= 2: "
+                              f"{self.feedback_bins}")
+
+
+@dataclass(frozen=True)
 class ServingConfig:
     """Knobs for the persistent scoring daemon (`shifu-tpu serve`).
 
@@ -662,6 +721,10 @@ class ServingConfig:
     slo_fast_window_s: float = 60.0
     slo_slow_window_s: float = 300.0
     slo_burn_threshold: float = 2.0
+    # model-quality / data-drift observatory (`shifu.drift.*` keys,
+    # obs/drift.py); engages only when the artifact carries a
+    # baseline_profile.json.
+    drift: DriftConfig = field(default_factory=DriftConfig)
 
     def validate(self) -> None:
         if self.engine not in ("auto", "native", "numpy", "stablehlo",
@@ -710,6 +773,7 @@ class ServingConfig:
         if self.slo_burn_threshold < 1:
             raise ConfigError("serving.slo.burn-threshold must be >= 1: "
                               f"{self.slo_burn_threshold}")
+        self.drift.validate()
 
 
 @dataclass(frozen=True)

@@ -39,6 +39,7 @@ TOPOLOGY = "topology.json"
 WEIGHTS = "weights.npz"
 STABLEHLO = "scoring.mlir"
 JAX_EXPORT = "scoring.jaxexport"
+BASELINE_PROFILE = "baseline_profile.json"
 
 
 def _key_name(entry: Any) -> str:
@@ -109,8 +110,16 @@ def export_stablehlo(forward_fn, params, num_features: int, path: str,
 
 def save_artifact(params: Any, job: JobConfig, export_dir: str,
                   forward_fn=None, algorithm: str = "tensorflow",
-                  extra_inputs: Optional[dict] = None) -> str:
+                  extra_inputs: Optional[dict] = None,
+                  baseline_profile: Optional[dict] = None) -> str:
     """Write the full scoring artifact; returns export_dir.
+
+    `baseline_profile` (obs/sketch.build_profile — the frozen stats
+    epoch from the train loop) is written as `baseline_profile.json`
+    BEFORE the sync manifest so its digest rides `sync_manifest.json`
+    and `fleet-verify` can audit that every fleet member served the
+    same baseline.  None (checkpoint-recovery re-exports, external
+    artifacts) just means the drift observatory stays dormant.
 
     `algorithm` defaults to "tensorflow" for byte-level sidecar parity with
     the reference (ssgd_monitor.py:476-490) so an unmodified Shifu eval step
@@ -187,6 +196,12 @@ def save_artifact(params: Any, job: JobConfig, export_dir: str,
         sidecar["properties"][name] = arr.tolist()
     with open(os.path.join(export_dir, SIDE_CAR), "w") as f:
         json.dump(sidecar, f, indent=4)
+
+    if baseline_profile is not None:
+        from ..obs import sketch as _sketch
+        _sketch.validate_profile(baseline_profile)
+        with open(os.path.join(export_dir, BASELINE_PROFILE), "w") as f:
+            json.dump(baseline_profile, f)
 
     if forward_fn is not None:
         export_stablehlo(forward_fn, params, job.schema.feature_count,

@@ -332,6 +332,24 @@ def build_parser() -> argparse.ArgumentParser:
     fv.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
 
+    dr = sub.add_parser(
+        "drift", help="model-quality / data-drift panel for a serving "
+                      "daemon: per-feature PSI vs the frozen baseline "
+                      "profile, score-distribution divergence, live AUC "
+                      "decay from labeled feedback, and firing drift "
+                      "alerts (journal tail only — no jax import; "
+                      "docs/OBSERVABILITY.md 'Drift observatory')")
+    dr.add_argument("job_dir",
+                    help="serving job dir, telemetry dir, or "
+                         "journal.jsonl path (train dirs render the "
+                         "journaled baseline-profile summary)")
+    dr.add_argument("--json", action="store_true",
+                    help="machine-readable drift dict instead of text")
+    dr.add_argument("--model", default=None,
+                    help="restrict to one model_id (default: all)")
+    dr.add_argument("--feature", default=None,
+                    help="restrict the PSI table to one named feature")
+
     tl = sub.add_parser(
         "timeline", help="skew-corrected causal fleet timeline: merge "
                          "every member's journal into one ordered "
@@ -391,6 +409,23 @@ def build_parser() -> argparse.ArgumentParser:
     lt.add_argument("--trace-exemplars", type=int, default=5,
                     help="how many slowest-trace exemplars to report "
                          "(default 5)")
+    lt.add_argument("--drift-after", type=float, default=0.0,
+                    help="drift drill: after this many seconds, draw "
+                         "requests from a pool whose --drift-features "
+                         "columns are shifted by --drift-shift "
+                         "(0 = off, default; docs/OBSERVABILITY.md "
+                         "'Drift observatory')")
+    lt.add_argument("--drift-shift", type=float, default=2.0,
+                    help="feature shift applied after --drift-after, in "
+                         "raw feature units (default 2.0 — ~2 sigma on "
+                         "the synthetic standard-normal pool)")
+    lt.add_argument("--drift-features", default=None,
+                    help="comma-separated feature indices to shift "
+                         "(default: 0,1)")
+    lt.add_argument("--feedback", action="store_true",
+                    help="ship synthetic labeled feedback after the run "
+                         "(calibrated labels pre-drift, coin-flips "
+                         "post-drift) so the daemon's live AUC decays")
     lt.add_argument("--json", action="store_true",
                     help="machine-readable report instead of text")
 
@@ -1038,7 +1073,10 @@ def run_train(args) -> int:
         params = jax.device_get(replicate(params))
     if chief:
         # make_forward_fn inside: meshless rebuild for single-host export
-        _export_and_pack(params, job, job.runtime.final_model_path, board)
+        # (the training loop's frozen reference profile rides along as
+        # baseline_profile.json — the drift observatory's anchor)
+        _export_and_pack(params, job, job.runtime.final_model_path, board,
+                         baseline_profile=result.baseline_profile)
         _write_metrics_jsonl(result, fsio_lib.join(out_dir, "metrics.jsonl"))
         if result.history:
             last = result.history[-1]
@@ -1268,6 +1306,32 @@ def run_top(args) -> int:
             time.sleep(max(args.interval, 0.1))
     except KeyboardInterrupt:
         return EXIT_OK
+
+
+def run_drift(args) -> int:
+    """`shifu-tpu drift <dir>`: the model-quality / data-drift panel —
+    per-feature PSI vs the frozen baseline profile, score-distribution
+    divergence, and live AUC decay from labeled feedback, straight off
+    the journal tail (obs/render.drift_summary).  Never imports jax —
+    safe to point at a LIVE daemon from any machine reading the dir."""
+    from ..obs import render as obs_render
+
+    try:
+        summary = obs_render.drift_summary(
+            args.job_dir, model=getattr(args, "model", None),
+            feature=getattr(args, "feature", None))
+    except Exception as e:
+        print(f"drift: {e}", file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    if summary is None:
+        print(f"no telemetry journal found under {args.job_dir} "
+              f"(expected <dir>/telemetry/journal.jsonl — a `shifu-tpu "
+              f"serve` daemon with a baseline profile writes drift "
+              f"reports there)", file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    print(json.dumps(summary) if args.json
+          else obs_render.render_drift_text(summary))
+    return EXIT_OK
 
 
 def run_cache(args) -> int:
@@ -1688,13 +1752,20 @@ def run_loadtest(args) -> int:
                                       p99_target_ms=args.p99_target_ms,
                                       senders=args.senders, config=config)
         else:
+            feats = getattr(args, "drift_features", None)
+            if feats:
+                feats = [int(v) for v in str(feats).split(",") if v]
             report = lt.run_loadtest(
                 args.model, connect=args.connect,
                 engine=args.engine, rate=args.rate,
                 duration=args.duration, senders=args.senders,
                 config=config,
                 trace_sample=getattr(args, "trace_sample", 0),
-                trace_exemplars=getattr(args, "trace_exemplars", 5))
+                trace_exemplars=getattr(args, "trace_exemplars", 5),
+                drift_after=getattr(args, "drift_after", 0.0),
+                drift_shift=getattr(args, "drift_shift", 2.0),
+                drift_features=feats,
+                feedback=getattr(args, "feedback", False))
     except (ValueError, OSError, KeyError, RuntimeError) as e:
         print(f"loadtest: {e}", file=sys.stderr, flush=True)
         return EXIT_FAIL
@@ -1852,7 +1923,8 @@ def run_eval(args) -> int:
     return EXIT_OK
 
 
-def _export_and_pack(params, job, out_dir, console) -> str:
+def _export_and_pack(params, job, out_dir, console,
+                     baseline_profile=None) -> str:
     """The one export sequence (artifact + best-effort native pack) shared
     by the train tail and the export recovery command — divergence here
     would give the recovery path different artifacts than training.
@@ -1873,7 +1945,8 @@ def _export_and_pack(params, job, out_dir, console) -> str:
             import tempfile
             local_dir = tempfile.mkdtemp(prefix="shifu_tpu_export_")
         export_dir = save_artifact(params, job, local_dir,
-                                   forward_fn=make_forward_fn(job))
+                                   forward_fn=make_forward_fn(job),
+                                   baseline_profile=baseline_profile)
         try:
             from ..runtime import pack_native
             pack_native(export_dir)
@@ -2042,6 +2115,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # likewise journal/scrape tail only — no jax import, safe to
         # point at a live daemon from any machine
         return run_top(args)
+    if args.command == "drift":
+        # likewise journal tail only — no jax import
+        return run_drift(args)
     if args.command == "chaos-verify":
         # likewise journal/plan reads only — no jax import
         return run_chaos_verify(args)

@@ -33,9 +33,9 @@ one-shot trace), rendered by `shifu-tpu trace`.
 
 from __future__ import annotations
 
-from . import (aggregate, devprof, goodput, introspect,  # noqa: F401
-               journal, metrics, render, slo, spans, tracefmt,
-               timeline, tracing)
+from . import (aggregate, devprof, drift, goodput,  # noqa: F401
+               introspect, journal, metrics, render, sketch, slo,
+               spans, tracefmt, timeline, tracing)
 from ._sinks import (ENV_METRICS_DIR, SCRAPE_FILE, configure,  # noqa: F401
                      configure_from_env, event, flush, get_journal,
                      metrics_dir, reset_for_tests, resolve_metrics_dir,

@@ -166,6 +166,8 @@ def serving_rollup(paths: list,
     down = 0
     active: list[dict] = []
     firing: set = set()
+    drift_worst: Optional[dict] = None  # fleet-wide worst PSI + where
+    drift_firing: set = set()
     route_traces = 0
     hedges = 0
     incidents = 0
@@ -192,6 +194,14 @@ def serving_rollup(paths: list,
             active.append(a)
             if a.get("objective"):
                 firing.add(str(a["objective"]))
+        dr = d.get("drift") or {}
+        if isinstance(dr.get("worst"), (int, float)) and (
+                drift_worst is None or dr["worst"] > drift_worst["psi"]):
+            drift_worst = {"psi": dr["worst"],
+                           "feature": dr.get("worst_feature"),
+                           "dir": d.get("dir")}
+        for obj in dr.get("firing") or []:
+            drift_firing.add(str(obj))
     # per-host grouping off the lease's host stamp (the cross-host fleet
     # writes it; dirs without one group under "-"): live/down counts per
     # placement, so a whole-host loss reads as ONE row going dark
@@ -212,6 +222,8 @@ def serving_rollup(paths: list,
             "queue_depth": queue,
             "active_alerts": len(active),
             "firing": sorted(firing),
+            "drift_worst": drift_worst,
+            "drift_firing": sorted(drift_firing),
             "route_traces": route_traces,
             "hedges": hedges,
             "incidents": incidents,

@@ -799,6 +799,8 @@ def top_summary(path: str,
     slo_profiles = 0
     tier_last: Optional[dict] = None
     dedup_last: Optional[dict] = None
+    drift_last: Optional[dict] = None
+    drift_alerts: list[dict] = []
     mode = "train"
     for rec in events:
         kind = rec.get("kind")
@@ -806,6 +808,10 @@ def top_summary(path: str,
             reports.append(rec)
         elif kind == "slo_alert":
             alerts.append(rec)
+        elif kind == "drift_report":
+            drift_last = rec
+        elif kind == "drift_alert":
+            drift_alerts.append(rec)
         elif kind == "serve_start":
             serve_start = rec
         elif kind == "loadtest_report":
@@ -907,6 +913,31 @@ def top_summary(path: str,
         if last.get("stages"):
             out["stages"] = last["stages"]
         out["slo"] = _slo_state_from_alerts(alerts, last.get("slo"))
+        # drift observatory row: the last drift_report's worst offender +
+        # live AUC decay, and the currently-firing drift objectives
+        # (newest transition wins — same discipline as slo alerts)
+        if drift_last is not None or drift_alerts:
+            firing: dict[str, dict] = {}
+            for a in drift_alerts:
+                obj = str(a.get("objective", "?"))
+                if a.get("state") == "firing":
+                    firing[obj] = a
+                elif a.get("state") == "resolved":
+                    firing.pop(obj, None)
+            dr = drift_last or {}
+            out["drift"] = {
+                "worst": dr.get("worst_psi"),
+                "worst_feature": ((dr.get("worst") or [{}])[0]
+                                  .get("feature")),
+                "score_kl": dr.get("score_kl"),
+                "auc_live": dr.get("auc_live"),
+                "auc_decay": dr.get("auc_decay"),
+                "rows_fast": dr.get("rows_fast"),
+                "baseline_digest": dr.get("baseline_digest"),
+                "firing": sorted(firing),
+                "alerts_total": sum(1 for a in drift_alerts
+                                    if a.get("state") == "firing"),
+            }
         out["request_traces"] = traces
         if route_traces:
             out["route_traces"] = route_traces
@@ -1055,6 +1086,24 @@ def render_top_text(summary: dict) -> str:
                             if objectives else
                             f" ({slo.get('alerts_total', 0)} alert(s) "
                             "this run)"))
+    dr = summary.get("drift")
+    if dr:
+        worst = dr.get("worst")
+        decay = dr.get("auc_decay")
+        bits = ["drift: "
+                + ("PSI "
+                   + (format(worst, ".3f")
+                      if isinstance(worst, (int, float)) else "-")
+                   + (f" ({dr.get('worst_feature')})"
+                      if dr.get("worst_feature") else ""))]
+        if dr.get("score_kl") is not None:
+            bits.append(f"score KL {dr['score_kl']}")
+        if isinstance(decay, (int, float)):
+            bits.append(f"auc live {dr.get('auc_live')} "
+                        f"(decay {decay:+.4f})")
+        if dr.get("firing"):
+            bits.append("FIRING " + ",".join(dr["firing"]))
+        lines.append("  ".join(bits))
     if summary.get("request_traces"):
         lines.append(f"sampled request traces: "
                      f"{summary['request_traces']}"
@@ -1119,6 +1168,159 @@ def render_top_text(summary: dict) -> str:
     return "\n".join(lines)
 
 
+# -- `shifu-tpu drift`: the model-quality / data-drift view ------------------
+
+def drift_summary(path: str, model: Optional[str] = None,
+                  feature: Optional[str] = None) -> Optional[dict]:
+    """One `shifu-tpu drift` frame for a serving telemetry dir — journal
+    tail ONLY (no jax, bounded read; the same contract as `top`): per
+    model, the latest `drift_report` (per-feature PSI table, score KL,
+    live AUC vs the frozen baseline's), the currently-firing drift
+    objectives (newest `drift_alert` transition wins), and the alert
+    history.  Train dirs answer too: the journaled `baseline_profile`
+    summary renders when no serving reports exist yet.
+
+    `model` filters to one model_id; `feature` filters the PSI table to
+    one named feature (exact match).  None when no journal is found."""
+    jpath = find_journal(path)
+    if jpath is None:
+        return None
+    events, total_events, tail_only = _load_events_tail(jpath)
+    reports: dict[str, dict] = {}        # model -> latest drift_report
+    alerts: dict[str, list] = {}         # model -> [drift_alert ...]
+    invalid: list[dict] = []
+    baseline: Optional[dict] = None
+    for rec in events:
+        kind = rec.get("kind")
+        if kind == "drift_report":
+            reports[str(rec.get("model", "default"))] = rec
+        elif kind == "drift_alert":
+            alerts.setdefault(str(rec.get("model", "default")),
+                              []).append(rec)
+        elif kind == "baseline_profile":
+            baseline = rec
+        elif kind == "drift_baseline_invalid":
+            invalid.append(rec)
+    models: dict[str, dict] = {}
+    for mid in sorted(set(reports) | set(alerts)):
+        if model is not None and mid != model:
+            continue
+        rep = reports.get(mid) or {}
+        firing: dict[str, dict] = {}
+        for a in alerts.get(mid, []):
+            obj = str(a.get("objective", "?"))
+            if a.get("state") == "firing":
+                firing[obj] = a
+            elif a.get("state") == "resolved":
+                firing.pop(obj, None)
+        worst = rep.get("worst") or []
+        if feature is not None:
+            worst = [w for w in worst if w.get("feature") == feature]
+        models[mid] = {
+            "report": {k: rep.get(k) for k in
+                       ("ts", "version", "baseline_digest", "rows_fast",
+                        "rows_slow", "feedback_rows_fast", "worst_psi",
+                        "score_kl", "mean_shift_max",
+                        "mean_shift_feature", "auc_live", "auc_decay",
+                        "train_auc")} if rep else None,
+            "worst": worst,
+            "firing": [
+                {k: a.get(k) for k in
+                 ("objective", "ts", "features", "score_kl")}
+                for a in firing.values()],
+            "alerts_total": sum(1 for a in alerts.get(mid, [])
+                                if a.get("state") == "firing"),
+        }
+    out: dict = {"journal": jpath, "events": total_events,
+                 "models": models}
+    if tail_only:
+        out["events_tail_only"] = True
+    if baseline is not None:
+        out["baseline"] = {k: baseline.get(k) for k in
+                           ("epoch", "rows", "num_features", "train_auc",
+                            "train_error", "score_mean")}
+    if invalid:
+        out["baseline_invalid"] = len(invalid)
+    return out
+
+
+def render_drift_text(summary: dict) -> str:
+    """Human rendering of `drift_summary`: per-model drift panel — the
+    PSI offender table, score divergence, and the live-AUC decay row."""
+    lines = [f"journal: {summary['journal']} "
+             f"({summary.get('events')} events)"]
+    base = summary.get("baseline")
+    if base:
+        lines.append(
+            f"baseline: epoch {base.get('epoch')}  rows {base.get('rows')}"
+            f"  features {base.get('num_features')}"
+            + (f"  train_auc {base.get('train_auc')}"
+               if base.get("train_auc") is not None else ""))
+    if summary.get("baseline_invalid"):
+        lines.append(f"WARNING: {summary['baseline_invalid']} invalid "
+                     "baseline-profile load(s) — drift dormant there")
+    models = summary.get("models") or {}
+    if not models:
+        lines.append("no drift reports — daemon without a baseline "
+                     "profile, drift disabled (shifu.drift.enabled), or "
+                     "nothing served yet")
+    for mid, m in models.items():
+        rep = m.get("report")
+        firing = m.get("firing") or []
+        head = f"model {mid}"
+        if rep:
+            head += (f" v{rep.get('version')}  baseline "
+                     f"{rep.get('baseline_digest')}  rows "
+                     f"{rep.get('rows_fast')}/{rep.get('rows_slow')} "
+                     "(fast/slow)")
+        lines.append(head + ("  FIRING "
+                             + ",".join(sorted(a.get("objective", "?")
+                                               for a in firing))
+                             if firing else "  ok"))
+        if rep:
+            kl = rep.get("score_kl")
+            bits = ["  score KL "
+                    + (format(kl, ".4f")
+                       if isinstance(kl, (int, float)) else "-")]
+            if rep.get("mean_shift_max") is not None:
+                bits.append(f"mean shift {rep['mean_shift_max']} sigma "
+                            f"({rep.get('mean_shift_feature')})")
+            lines.append("  ".join(bits))
+            if rep.get("auc_live") is not None:
+                decay = rep.get("auc_decay")
+                lines.append(
+                    f"  auc live {rep.get('auc_live')}"
+                    + (f" vs train {rep.get('train_auc')}"
+                       if rep.get("train_auc") is not None else "")
+                    + (f"  decay {decay:+.4f}"
+                       if isinstance(decay, (int, float)) else "")
+                    + f"  ({rep.get('feedback_rows_fast')} labeled rows "
+                    "in window)")
+            elif rep.get("feedback_rows_fast") is not None:
+                lines.append("  auc live: - (no labeled feedback in "
+                             "window — wire FEEDBACK frames or "
+                             "ServeClient.feedback())")
+        worst = m.get("worst") or []
+        if worst:
+            lines.append(f"  {'feature':<24} {'psi_fast':>9} "
+                         f"{'psi_slow':>9}")
+            for w in worst:
+                def f(v):
+                    return (format(v, ".4f")
+                            if isinstance(v, (int, float)) else "-")
+                lines.append(f"  {str(w.get('feature'))[:24]:<24} "
+                             f"{f(w.get('psi_fast')):>9} "
+                             f"{f(w.get('psi_slow')):>9}")
+        for a in firing:
+            feats = [f.get("feature") for f in (a.get("features") or [])]
+            lines.append(
+                f"  ALERT {a.get('objective')}"
+                + (f": {', '.join(map(str, feats))}" if feats else "")
+                + (f" (score KL {a.get('score_kl')})"
+                   if a.get("score_kl") is not None else ""))
+    return "\n".join(lines)
+
+
 def render_top_fleet_text(rollup: dict) -> str:
     """The multi-daemon `shifu-tpu top` frame (obs/aggregate.py
     serving_rollup): fleet totals + one row per daemon."""
@@ -1139,6 +1341,14 @@ def render_top_fleet_text(rollup: dict) -> str:
             f"  hedged {fleet.get('hedges', 0)}"
             f"  incidents {fleet.get('incidents', 0)}"
             f" ({fleet.get('incidents_open', 0)} open)")
+    dw = fleet.get("drift_worst")
+    if dw or fleet.get("drift_firing"):
+        lines.append(
+            "  drift: worst PSI "
+            + (f"{dw['psi']:.3f} ({dw.get('feature')} @ "
+               f"{str(dw.get('dir'))[-28:]})" if dw else "-")
+            + (("  FIRING " + ",".join(fleet["drift_firing"]))
+               if fleet.get("drift_firing") else ""))
     hosts = fleet.get("hosts") or {}
     if [h for h in hosts if h != "-"]:
         # the cross-host view: one cell per placement, dark hosts loud
@@ -1150,10 +1360,14 @@ def render_top_fleet_text(rollup: dict) -> str:
                          + (" DOWN" if dn and dn == n else ""))
         lines.append("  hosts: " + "  ".join(cells))
     lines.append(f"  {'daemon':<28} {'rate/s':>10} {'p99_ms':>8} "
-                 f"{'queue':>6} {'alerts':>7} {'slo':>8}")
+                 f"{'queue':>6} {'alerts':>7} {'psi':>7} {'slo':>8}")
     for d in rollup.get("daemons") or []:
         sv = d.get("serving") or {}
         active = (d.get("slo") or {}).get("active") or []
+        dr = d.get("drift") or {}
+        psi = dr.get("worst")
+        psi_s = (format(psi, ".3f") if isinstance(psi, (int, float))
+                 else "-") + ("!" if dr.get("firing") else "")
         rate = sv.get("scores_per_sec")
         if d.get("down"):
             # the stale-frame fix: a dead member renders DOWN with its
@@ -1161,7 +1375,7 @@ def render_top_fleet_text(rollup: dict) -> str:
             lines.append(
                 f"  {str(d.get('dir'))[-28:]:<28} "
                 f"{'-':>10} {'-':>8} {'-':>6} {len(active):>7} "
-                f"{'DOWN':>8}  (stale {d.get('stale_s')}s)")
+                f"{'-':>7} {'DOWN':>8}  (stale {d.get('stale_s')}s)")
             continue
         lines.append(
             f"  {str(d.get('dir'))[-28:]:<28} "
@@ -1170,5 +1384,6 @@ def render_top_fleet_text(rollup: dict) -> str:
             + f" {sv.get('p99_ms') if sv.get('p99_ms') is not None else '-':>8}"
             f" {sv.get('queue_depth') if sv.get('queue_depth') is not None else '-':>6}"
             f" {len(active):>7}"
+            f" {psi_s:>7}"
             f" {'FIRING' if active else 'ok':>8}")
     return "\n".join(lines)

@@ -109,3 +109,50 @@ class StreamingMetrics:
         neg_below = np.concatenate([[0.0], np.cumsum(self._neg)[:-1]])
         credit = neg_below + 0.5 * self._neg
         return float(np.sum(self._pos * credit) / (wp * wn))
+
+    def merge(self, other: "StreamingMetrics") -> "StreamingMetrics":
+        """Fold another accumulator into this one.  Every piece of
+        state is additive, so merge(a, b) == a single pass over the
+        concatenated chunks — the property windowed drift AUC and the
+        fleet rollup lean on (obs/drift.py)."""
+        if other.bins != self.bins:
+            raise ValueError(
+                f"cannot merge StreamingMetrics with bins={other.bins} "
+                f"into bins={self.bins}")
+        self._pos += other._pos
+        self._neg += other._neg
+        self._err_sum += other._err_sum
+        self._nonzero += other._nonzero
+        self._rows += other._rows
+        return self
+
+    def state_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """The live (pos, neg) bin-weight arrays (no copy) — windowed
+        consumers snapshot these and subtract cumulative states."""
+        return self._pos, self._neg
+
+    def state_dict(self) -> dict:
+        """JSON-serializable state (sparse: only nonzero bins), exact
+        round-trip through `from_state`."""
+        nz_p = np.flatnonzero(self._pos)
+        nz_n = np.flatnonzero(self._neg)
+        return {
+            "bins": int(self.bins),
+            "pos_idx": nz_p.tolist(),
+            "pos_w": self._pos[nz_p].tolist(),
+            "neg_idx": nz_n.tolist(),
+            "neg_w": self._neg[nz_n].tolist(),
+            "err_sum": float(self._err_sum),
+            "nonzero": int(self._nonzero),
+            "rows": int(self._rows),
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "StreamingMetrics":
+        m = cls(bins=int(state["bins"]))
+        m._pos[np.asarray(state["pos_idx"], np.int64)] = state["pos_w"]
+        m._neg[np.asarray(state["neg_idx"], np.int64)] = state["neg_w"]
+        m._err_sum = float(state["err_sum"])
+        m._nonzero = int(state["nonzero"])
+        m._rows = int(state["rows"])
+        return m

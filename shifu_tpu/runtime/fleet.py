@@ -963,7 +963,9 @@ class FleetManager:
                         obs.event("fleet_member_swap", member=member_id,
                                   generation=gen,
                                   host=getattr(m, "host_id", ""),
-                                  via="rejoin")
+                                  via="rejoin",
+                                  baseline_digest=r.get(
+                                      "baseline_digest"))
                 with self._lock:
                     m.state = STATE_STANDBY
                     self.standbys.append(m)
@@ -990,7 +992,7 @@ class FleetManager:
         from .. import obs
 
         t0 = time.perf_counter()
-        promoted_swap = None
+        promoted_swap = promoted_digest = None
         with self._lock:
             if self.members.get(member.member_id) is not member:
                 return  # already handled (monitor/drill race)
@@ -1021,6 +1023,7 @@ class FleetManager:
                     if r.get("ok"):
                         standby.generation = self._generation
                         promoted_swap = self._generation
+                        promoted_digest = r.get("baseline_digest")
                 self.members[standby.member_id] = standby
                 self._admit(standby)
                 if standby.generation != self._generation:
@@ -1050,7 +1053,7 @@ class FleetManager:
             obs.event("fleet_member_swap", member=standby.member_id,
                       generation=promoted_swap,
                       host=getattr(standby, "host_id", ""),
-                      via="promote")
+                      via="promote", baseline_digest=promoted_digest)
         try:
             obs.flush()
         except Exception:
@@ -1135,7 +1138,8 @@ class FleetManager:
                 swapped.append(m.member_id)
                 obs.event("fleet_member_swap", member=m.member_id,
                           generation=gen,
-                          host=getattr(m, "host_id", ""), via="fanout")
+                          host=getattr(m, "host_id", ""), via="fanout",
+                          baseline_digest=r.get("baseline_digest"))
             else:
                 failed.append({"member": m.member_id,
                                "error": r.get("error")})
@@ -1188,7 +1192,8 @@ class FleetManager:
             readmitted.append(m.member_id)
             obs.event("fleet_member_swap", member=m.member_id,
                       generation=gen, host=getattr(m, "host_id", ""),
-                      via="retry")
+                      via="retry",
+                      baseline_digest=r.get("baseline_digest"))
             obs.event("fleet_readmit", member=m.member_id,
                       generation=gen, path=target)
         return readmitted
@@ -1235,7 +1240,8 @@ class FleetManager:
                               member=grown.member_id,
                               generation=self._generation,
                               host=getattr(grown, "host_id", ""),
-                              via="scale")
+                              via="scale",
+                              baseline_digest=r.get("baseline_digest"))
             with self._lock:
                 self.members[grown.member_id] = grown
                 self._admit(grown)
@@ -1299,6 +1305,10 @@ def fleet_verify_events(events: list) -> dict:
     - no member's applied generation ever regresses
     - every `fleet_rejoin` follows that member's own failover — the
       split-brain guard's paper trail (nobody rejoins who never left)
+    - within a generation, every member that reported a baseline-profile
+      digest reported the SAME one — the drift observatory's "the whole
+      fleet alerts against one frozen baseline" guarantee (a member with
+      no digest is fine: artifact without a profile, drift disabled)
     """
     from collections import Counter
 
@@ -1375,6 +1385,19 @@ def fleet_verify_events(events: list) -> dict:
                    "detail": ("every rejoin had a prior failover"
                               if not ghost_rejoins else
                               f"rejoin without failover: {ghost_rejoins}")})
+
+    gen_digests: dict = {}
+    for e in applies:
+        d = e.get("baseline_digest")
+        if d:
+            gen_digests.setdefault(e.get("generation"), set()).add(d)
+    split = sorted(f"gen{g}: {sorted(ds)}"
+                   for g, ds in gen_digests.items() if len(ds) > 1)
+    checks.append({"check": "baseline_profile_consistent",
+                   "ok": not split,
+                   "detail": ("every generation served one baseline "
+                              "profile" if not split else
+                              f"digest split within generation: {split}")})
 
     ok = all(c["ok"] for c in checks)
     return {

@@ -52,6 +52,27 @@ def _make_rows(num_features: int, rng: np.random.Generator,
     return rng.standard_normal((n_unique, num_features)).astype(np.float32)
 
 
+def _shift_rows(rows: np.ndarray, features, shift: float) -> np.ndarray:
+    """The drift-drill traffic shaper: a copy of the request pool with
+    the selected feature columns translated by `shift` (in raw feature
+    units — the pool is standard normal, so `shift` reads as sigmas).
+    Un-listed columns are untouched, which is the drill's whole point:
+    the PSI engine must name exactly these columns."""
+    shifted = np.array(rows, copy=True)
+    for j in features:
+        shifted[:, int(j)] += np.float32(shift)
+    return shifted
+
+
+def _resolve_drift_features(features, num_features: int) -> list[int]:
+    feats = [int(j) for j in (features if features is not None else (0, 1))]
+    bad = [j for j in feats if not (0 <= j < num_features)]
+    if bad:
+        raise ValueError(f"drift feature index {bad} out of range for "
+                         f"{num_features} features")
+    return feats
+
+
 def _percentiles(latencies: np.ndarray) -> dict:
     if latencies.size == 0:
         return {"p50_ms": None, "p99_ms": None, "max_ms": None}
@@ -72,7 +93,11 @@ def run_loadtest(export_dir: Optional[str] = None, *,
                  config: Optional[ServingConfig] = None,
                  drain_timeout: float = 30.0,
                  trace_sample: int = 0,
-                 trace_exemplars: int = 5) -> dict:
+                 trace_exemplars: int = 5,
+                 drift_after: float = 0.0,
+                 drift_shift: float = 2.0,
+                 drift_features=None,
+                 feedback: bool = False) -> dict:
     """One open-loop run at a fixed offered rate; returns the report dict
     (offered/achieved scores/s, exact p50/p99/max latency, reject/error
     counts).  Exactly one of `export_dir` / `daemon` / `connect`.
@@ -81,12 +106,25 @@ def run_loadtest(export_dir: Optional[str] = None, *,
     for every Nth request and the report carries `trace_exemplars`: the
     trace_ids of the N SLOWEST sampled requests — a bad ramp's p99 is
     immediately traceable to its hop/stage decomposition in
-    `shifu-tpu timeline`.  0 = off: no minting, no per-request overhead."""
+    `shifu-tpu timeline`.  0 = off: no minting, no per-request overhead.
+
+    `drift_after` > 0 turns the run into a drift drill: requests
+    scheduled after that many seconds draw from a pool whose
+    `drift_features` columns (default the first two) are shifted by
+    `drift_shift` — the substrate the drift observatory's alert contract
+    is exercised against (docs/OBSERVABILITY.md "Drift observatory").
+    `feedback=True` additionally ships synthetic labeled feedback after
+    the run: score-calibrated labels for pre-drift traffic, coin-flip
+    labels for post-drift traffic, so the live AUC visibly decays."""
     if connect is not None:
         return _run_socket(connect, rate=rate, duration=duration,
                            senders=senders, seed=seed,
                            trace_sample=trace_sample,
-                           trace_exemplars=trace_exemplars)
+                           trace_exemplars=trace_exemplars,
+                           drift_after=drift_after,
+                           drift_shift=drift_shift,
+                           drift_features=drift_features,
+                           feedback=feedback)
     own_daemon = daemon is None
     if own_daemon:
         if export_dir is None:
@@ -98,7 +136,11 @@ def run_loadtest(export_dir: Optional[str] = None, *,
                            senders=senders, seed=seed,
                            drain_timeout=drain_timeout,
                            trace_sample=trace_sample,
-                           trace_exemplars=trace_exemplars)
+                           trace_exemplars=trace_exemplars,
+                           drift_after=drift_after,
+                           drift_shift=drift_shift,
+                           drift_features=drift_features,
+                           feedback=feedback)
     finally:
         if own_daemon:
             daemon.stop()
@@ -124,18 +166,32 @@ def _top_exemplars(arrivals: np.ndarray, latencies: np.ndarray,
 
 def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
                 senders: int, seed: int, drain_timeout: float,
-                trace_sample: int = 0, trace_exemplars: int = 5) -> dict:
+                trace_sample: int = 0, trace_exemplars: int = 5,
+                drift_after: float = 0.0, drift_shift: float = 2.0,
+                drift_features=None, feedback: bool = False) -> dict:
     rng = np.random.default_rng(seed)
     rows = _make_rows(daemon.num_features, rng)
     n_unique = len(rows)
     schedule = _poisson_schedule(rate, duration, rng)
     n = len(schedule)
+    drift_feats: list[int] = []
+    if drift_after > 0:
+        drift_feats = _resolve_drift_features(drift_features,
+                                              daemon.num_features)
+        shifted_rows = _shift_rows(rows, drift_feats, drift_shift)
 
     completed_batches: list = []   # [(arrivals_array, t_done)] — append is
     #                                GIL-atomic, no lock on the hot path
 
-    def on_batch(_scores, arrivals, t_done):
-        completed_batches.append((arrivals, t_done))
+    if feedback:
+        # the feedback path needs the scores back: keep the head-0 score
+        # per batch alongside the arrivals (still one append per batch)
+        def on_batch(scores, arrivals, t_done):
+            completed_batches.append((arrivals, t_done,
+                                      np.asarray(scores)[:, 0]))
+    else:
+        def on_batch(_scores, arrivals, t_done):
+            completed_batches.append((arrivals, t_done))
 
     prev_hook = daemon._on_batch
     daemon._on_batch = on_batch
@@ -151,6 +207,17 @@ def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
     # host with the daemon, so it must be as close to submit-only as
     # Python allows (plain floats, no per-request numpy indexing)
     row_views = list(rows)  # slice once; senders share the 1-D views
+    if drift_feats:
+        # drift drill: requests scheduled past the cut draw from the
+        # shifted pool — resolved here, OUTSIDE the timed region, so the
+        # sender loop stays submit-only
+        shifted_views = list(shifted_rows)
+        def _pick(k: int, off: float):
+            return (shifted_views if off >= drift_after
+                    else row_views)[k % n_unique]
+    else:
+        def _pick(k: int, _off: float):
+            return row_views[k % n_unique]
     offsets = schedule.tolist()
     # trace contexts are pre-minted OUTSIDE the timed region too: the
     # sampled sender path adds one tuple element, not an os.urandom call
@@ -164,7 +231,7 @@ def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
     per_sender = []
     for s in range(senders):
         idx = range(s, n, senders)  # thinned Poisson is still Poisson
-        per_sender.append([(offsets[k], row_views[k % n_unique],
+        per_sender.append([(offsets[k], _pick(k, offsets[k]),
                             ctx_for[k]) for k in idx])
     # stamp the epoch AFTER the (slow) precompute: a t_start taken before
     # it would put every sender behind schedule from the first request
@@ -210,22 +277,52 @@ def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
     # drain: every admitted request resolves (errors land in daemon stats)
     t_deadline = time.perf_counter() + drain_timeout
     while time.perf_counter() < t_deadline:
-        done = sum(len(a) for a, _t in completed_batches)
+        done = sum(len(b[0]) for b in completed_batches)
         errors = daemon._snapshot()["errors"] - errors_at_start
         if done + errors >= n_submitted:
             break
         time.sleep(0.005)
     daemon._on_batch = prev_hook
 
-    done_counts = [len(a) for a, _t in completed_batches]
+    feedback_rows = 0
+    if feedback and completed_batches:
+        # synthetic labeled feedback, shipped AFTER the run (a production
+        # label pipeline is hours-late anyway): pre-drift traffic gets
+        # score-calibrated Bernoulli labels (a well-calibrated model —
+        # live AUC ~= the baseline's), post-drift traffic gets coin-flip
+        # labels (the model's ranking no longer means anything on the
+        # shifted distribution), so auc_decay visibly opens up
+        t_cut = t_start + drift_after if drift_after > 0 else float("inf")
+        fb_rng = np.random.default_rng(seed + 1)
+        for b in completed_batches:
+            arrivals, scores = b[0], b[2]
+            s = np.clip(np.asarray(scores, dtype=np.float64), 0.0, 1.0)
+            u = fb_rng.random(s.shape)
+            labels = np.where(np.asarray(arrivals) < t_cut,
+                              u < s, u < 0.5)
+            try:
+                feedback_rows += daemon.feedback(s, labels)
+            except ValueError:
+                break  # feedback path disabled on the daemon
+        if feedback_rows:
+            # the labels landed after the last scheduled drift tick and
+            # an own-daemon caller stops us right after the report —
+            # flush one forced evaluation so auc_decay reaches the
+            # journal before the engine dies with the daemon
+            try:
+                daemon.drift_flush()
+            except Exception:
+                pass
+
+    done_counts = [len(b[0]) for b in completed_batches]
     n_completed = sum(done_counts)
     latencies = (np.concatenate(
-        [t_done - arrivals for arrivals, t_done in completed_batches])
+        [b[1] - b[0] for b in completed_batches])
         if completed_batches else np.empty(0))
     # achieved rate over the span requests actually completed in
     if completed_batches:
-        t_first = min(float(a.min()) for a, _t in completed_batches)
-        t_last = max(t for _a, t in completed_batches)
+        t_first = min(float(b[0].min()) for b in completed_batches)
+        t_last = max(b[1] for b in completed_batches)
         span = max(t_last - t_first, 1e-9)
     else:
         span = duration
@@ -243,6 +340,12 @@ def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
         "senders": senders,
         **_percentiles(latencies),
     }
+    if drift_after > 0:
+        report["drift_after_s"] = round(drift_after, 3)
+        report["drift_shift"] = round(drift_shift, 3)
+        report["drift_features"] = drift_feats
+    if feedback:
+        report["feedback_rows"] = int(feedback_rows)
     # per-stage latency decomposition of THIS run (queue / coalesce /
     # dispatch / device / reply): where the end-to-end percentile's time
     # went — the capacity-ramp readout that says WHAT saturates first
@@ -250,7 +353,7 @@ def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
     if stages:
         report["stages"] = stages
     if trace_sample > 0 and completed_batches:
-        all_arr = np.concatenate([a for a, _t in completed_batches])
+        all_arr = np.concatenate([b[0] for b in completed_batches])
         report["trace_exemplars"] = _top_exemplars(
             all_arr, latencies, trace_map, trace_exemplars)
     handle = daemon._registry.current(daemon.model_id)
@@ -262,7 +365,9 @@ def _run_inproc(daemon: ScoringDaemon, *, rate: float, duration: float,
 
 def _run_socket(connect: str, *, rate: float, duration: float,
                 senders: int, seed: int, trace_sample: int = 0,
-                trace_exemplars: int = 5) -> dict:
+                trace_exemplars: int = 5, drift_after: float = 0.0,
+                drift_shift: float = 2.0, drift_features=None,
+                feedback: bool = False) -> dict:
     from . import serve_wire
 
     host, _, port_s = connect.rpartition(":")
@@ -275,6 +380,13 @@ def _run_socket(connect: str, *, rate: float, duration: float,
     n_unique = len(rows)
     schedule = _poisson_schedule(rate, duration, rng)
     n = len(schedule)
+    drift_feats: list[int] = []
+    if drift_after > 0:
+        drift_feats = _resolve_drift_features(drift_features, num_features)
+        shifted_rows = _shift_rows(rows, drift_feats, drift_shift)
+    # feedback mode: each sender records (score, is_post_drift) pairs so
+    # the driver can ship labeled feedback over the wire after the run
+    fb_lists: list[list] = [[] for _ in range(senders)]
     lat_lists: list[list] = [[] for _ in range(senders)]
     err_counts = [0] * senders
     rej_counts = [0] * senders
@@ -335,13 +447,17 @@ def _run_socket(connect: str, *, rate: float, duration: float,
                     time.sleep(dt)  # see _run_inproc: never spin
                 ctx = (tracing.mint() if tracing is not None
                        and k % trace_sample == 0 else None)
+                post = bool(drift_feats) and schedule[k] >= drift_after
+                pool = shifted_rows if post else rows
                 sent = False
                 while not sent:
                     try:
-                        client.score_rows(rows[k % n_unique][None, :],
-                                          trace=ctx)
+                        out = client.score_rows(pool[k % n_unique][None, :],
+                                                trace=ctx)
                         lat = time.perf_counter() - t_sched
                         lats.append(lat)
+                        if feedback:
+                            fb_lists[s].append((float(out[0, 0]), post))
                         if ctx is not None:
                             sampled_lists[s].append((lat, ctx.trace_id))
                         ladder.ok()  # a COMPLETED round-trip — the only
@@ -385,6 +501,24 @@ def _run_socket(connect: str, *, rate: float, duration: float,
         t.join()
     span = max(time.perf_counter() - t0, 1e-9)
     latencies = np.asarray([v for lats in lat_lists for v in lats])
+    feedback_rows = 0
+    if feedback:
+        pairs = [p for lst in fb_lists for p in lst]
+        if pairs:
+            scores = np.clip(np.asarray([p[0] for p in pairs],
+                                        dtype=np.float64), 0.0, 1.0)
+            post = np.asarray([p[1] for p in pairs], dtype=bool)
+            u = np.random.default_rng(seed + 1).random(scores.shape)
+            # same synthesis as inproc: calibrated labels pre-drift,
+            # coin-flips post-drift (see _run_inproc)
+            labels = np.where(post, u < 0.5, u < scores)
+            try:
+                fb_client = serve_wire.ServeClient(host, port)
+                resp = fb_client.feedback(scores, labels)
+                fb_client.close()
+                feedback_rows = int(resp.get("rows", 0))
+            except (ConnectionError, OSError, serve_wire.WireError):
+                pass  # feedback disabled / daemon gone: report 0 rows
     report = {
         "mode": "socket",
         "target": f"{host}:{port}",
@@ -399,6 +533,12 @@ def _run_socket(connect: str, *, rate: float, duration: float,
         "senders": senders,
         **_percentiles(latencies),
     }
+    if drift_after > 0:
+        report["drift_after_s"] = round(drift_after, 3)
+        report["drift_shift"] = round(drift_shift, 3)
+        report["drift_features"] = drift_feats
+    if feedback:
+        report["feedback_rows"] = feedback_rows
     if trace_sample > 0:
         sampled = sorted((p for lst in sampled_lists for p in lst),
                          reverse=True)[:max(trace_exemplars, 0)]
@@ -508,6 +648,15 @@ def render_report(report: dict) -> str:
     if exemplars:
         lines.append("  slowest traces: " + "  ".join(
             f"{e['trace_id']}={e['ms']}ms" for e in exemplars))
+    if report.get("drift_after_s"):
+        fb = report.get("feedback_rows")
+        lines.append(
+            f"  drift drill: features {report.get('drift_features')} "
+            f"shifted +{report.get('drift_shift')} after "
+            f"{report['drift_after_s']}s"
+            + (f", {fb:,} labeled feedback rows shipped"
+               if fb is not None else "")
+            + "  (read with `shifu-tpu drift <dir>`)")
     return "\n".join(lines)
 
 

@@ -44,6 +44,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..config.schema import ServingConfig
+from ..obs import drift as drift_mod
 from ..obs import slo as slo_mod
 
 CHAOS_SITE = "runtime.serve"
@@ -347,6 +348,14 @@ class ScoringDaemon:
         self._slo = (slo_mod.SloEngine(objectives)
                      if objectives.enabled() else None)
         self._trace_trigger = slo_mod.ServeTraceTrigger()
+        # drift observatory (obs/drift.py): one DriftEngine per model,
+        # built from the artifact's frozen baseline_profile.json.  The
+        # dict stays EMPTY when the kill switch is off or the artifact
+        # carries no profile — the dispatch path then pays one dict.get.
+        self._drift: dict[str, drift_mod.DriftEngine] = {}
+        self._drift_lock = threading.Lock()
+        if self.config.drift.enabled and export_dir is not None:
+            self._init_drift(model_id, current, export_dir)
         # per-daemon publish baselines: the obs counters are
         # process-global and cumulative, so a second daemon in one
         # process must add its OWN deltas, not diff against the
@@ -381,6 +390,13 @@ class ScoringDaemon:
         if self._slo is not None:
             t = threading.Thread(target=self._slo_loop, daemon=True,
                                  name="serve-slo")
+            t.start()
+            self._threads.append(t)
+        if self.config.drift.enabled:
+            # the tick thread runs even with no baseline yet: a swap to
+            # a profile-carrying artifact engages drift without restart
+            t = threading.Thread(target=self._drift_loop, daemon=True,
+                                 name="serve-drift")
             t.start()
             self._threads.append(t)
         return self
@@ -510,6 +526,11 @@ class ScoringDaemon:
             self._registry.release(handle)
         with self._cond:
             self._direct_rows += out.shape[0]
+        drift_eng = self._drift.get(self.model_id)
+        if (drift_eng is not None
+                and drift_eng.monitor.version == handle.version):
+            # the direct path is live traffic too (multi-row wire frames)
+            drift_eng.monitor.observe_batch(np.asarray(rows), out)
         return out
 
     # -- hot swap ------------------------------------------------------
@@ -522,8 +543,21 @@ class ScoringDaemon:
             handle = self._registry.load(
                 export_dir, engine=engine or self.config.engine,
                 model_id=self.model_id)
-            return {"ok": True, "version": handle.version,
-                    "engine": handle.engine_name, "path": export_dir}
+            result = {"ok": True, "version": handle.version,
+                      "engine": handle.engine_name, "path": export_dir}
+            if self.config.drift.enabled:
+                # the new artifact's baseline replaces the old one (live
+                # sketches reset — traffic scored by the OLD version must
+                # not count against the NEW baseline); no profile drops
+                # the model back to drift-dormant.  The digest rides the
+                # swap result so fleet_member_swap events carry it and
+                # fleet-verify can audit generation-wide consistency.
+                eng_obj = self._init_drift(self.model_id, handle,
+                                           export_dir)
+                result["baseline_digest"] = (
+                    eng_obj.monitor.digest if eng_obj is not None
+                    else None)
+            return result
         except Exception as e:
             with self._cond:
                 self._swaps_failed += 1
@@ -531,6 +565,118 @@ class ScoringDaemon:
             return {"ok": False,
                     "error": f"{type(e).__name__}: {e}"[:300],
                     "kept_version": kept.version if kept else None}
+
+    # -- drift observatory ---------------------------------------------
+
+    def _init_drift(self, model_id: str, handle, export_dir: str):
+        """(Re)build the model's DriftEngine from the artifact's frozen
+        baseline, or drop it when the artifact ships none.  Returns the
+        engine or None."""
+        loaded = drift_mod.load_baseline(export_dir)
+        if loaded is None:
+            with self._drift_lock:
+                self._drift.pop(model_id, None)
+            return None
+        profile, digest = loaded
+        return self.set_drift_baseline(
+            profile, model_id=model_id,
+            version=handle.version if handle else 1, digest=digest)
+
+    def set_drift_baseline(self, profile: dict, model_id: str = "default",
+                           version: int = 1, digest: str = ""):
+        """Install (or replace) the drift baseline for a model — swap()
+        and __init__ call this with the artifact's profile; tests inject
+        synthetic baselines directly.  Returns the DriftEngine, or None
+        when drift is off or the profile doesn't match the scorer."""
+        if not self.config.drift.enabled:
+            return None
+        if int(profile.get("num_features", -1)) != self.num_features:
+            from .. import obs
+            obs.event("drift_baseline_invalid", model=model_id,
+                      error=f"profile has {profile.get('num_features')} "
+                            f"features, scorer has {self.num_features}")
+            with self._drift_lock:
+                self._drift.pop(model_id, None)
+            return None
+        mon = drift_mod.DriftMonitor(
+            profile, model_id=model_id, version=version, digest=digest,
+            feedback_bins=self.config.drift.feedback_bins)
+        eng = drift_mod.DriftEngine(mon, self.config.drift)
+        with self._drift_lock:
+            self._drift[model_id] = eng
+        return eng
+
+    def drift_baseline_digest(self, model_id: str = "default"):
+        """The served baseline's digest (None when drift is dormant) —
+        what fleet heartbeats/swaps report for the fleet-verify audit."""
+        eng = self._drift.get(model_id)
+        return eng.monitor.digest if eng is not None else None
+
+    def feedback(self, scores, labels, weights=None,
+                 model_id: str = "default") -> int:
+        """Labeled-feedback ingestion (the wire FEEDBACK frame /
+        `ServeClient.feedback`): (score, label[, weight]) rows feed the
+        trailing-window live-AUC accumulator.  Returns rows accepted (0
+        when the model has no baseline); raises ValueError when the
+        feedback path is disabled."""
+        if not (self.config.drift.enabled and self.config.drift.feedback):
+            raise ValueError(
+                "feedback path disabled (shifu.drift.feedback)")
+        eng = self._drift.get(model_id)
+        if eng is None:
+            return 0
+        return eng.monitor.observe_feedback(scores, labels, weights)
+
+    def _drift_tick_once(self, now: float,
+                         force_report: bool = False) -> None:
+        """One evaluation pass over every model's drift engine: journal
+        `drift_alert` transitions + `drift_report`s, export gauges."""
+        from .. import obs
+
+        wrote = False
+        for _model_id, eng in list(self._drift.items()):
+            try:
+                alerts, report = eng.tick(now, force_report=force_report)
+                eng.export_gauges()
+            except Exception:
+                continue  # the drift plane must never kill serving
+            for ev in alerts:
+                obs.counter("drift_alerts_total",
+                            "drift alert transitions journaled").inc(
+                    objective=ev["objective"], state=ev["state"])
+                obs.event("drift_alert", **ev)
+                wrote = True
+            if report is not None:
+                obs.event("drift_report", **report)
+                wrote = True
+        if wrote:
+            try:
+                obs.flush()
+            except Exception:
+                pass
+
+    def drift_flush(self) -> None:
+        """Force one drift evaluation + journaled report NOW — the
+        end-of-run flush for drills whose labeled feedback lands after
+        the last scheduled tick (loadtest --feedback stops an own-daemon
+        right after the report; without this the shipped labels would
+        never reach a journaled `drift_report`/auc_decay)."""
+        self._drift_tick_once(time.monotonic(), force_report=True)
+
+    def _drift_loop(self) -> None:
+        """The drift evaluation tick (cadence of the SLO loop): snapshot
+        live sketches, diff both trailing windows against the baseline,
+        journal `drift_alert` transitions + periodic `drift_report`s,
+        export the drift gauges."""
+        cfg = self.config.drift
+        tick = max(0.05, min(1.0, cfg.fast_window_s / 5.0))
+        while True:
+            t_next = time.monotonic() + tick
+            while time.monotonic() < t_next:
+                if not self._running:
+                    return
+                time.sleep(min(0.05, tick))
+            self._drift_tick_once(time.monotonic())
 
     # -- dispatch loop -------------------------------------------------
 
@@ -656,6 +802,14 @@ class ScoringDaemon:
             self._requests += n
             self._batches += 1
             self._batch_rows += n
+        drift_eng = self._drift.get(self.model_id)
+        if (drift_eng is not None
+                and drift_eng.monitor.version == handle.version):
+            # live sketch accumulation: un-padded rows + head-0 scores,
+            # one flattened bincount per batch (obs/sketch.py) — skipped
+            # entirely across a version mismatch (traffic scored by an
+            # old version must not count against the new baseline)
+            drift_eng.monitor.observe_batch(x[:n], scores)
         if any(trace_seqs):
             self._journal_traces(trace_seqs, trace_ctxs, arrivals, enqs,
                                  t_window, t_take, t_exec, t_done,
@@ -816,6 +970,9 @@ class ScoringDaemon:
             pass
         if self._slo is not None:
             snap["slo"] = self._slo.state()
+        drift_eng = self._drift.get(self.model_id)
+        if drift_eng is not None:
+            snap["drift"] = drift_eng.state()
         if self.config.trace_sample:
             snap["trace_sample"] = self.config.trace_sample
         return snap

@@ -21,7 +21,9 @@ frames pipeline freely on a persistent connection:
 
 opcodes: SCORE (payload = rows; response payload = f32 scores (N, H)),
 SWAP (payload = JSON {"export_dir", "engine"?}; response = JSON result),
-STATS (response = JSON daemon stats), PING (empty echo).  An error
+STATS (response = JSON daemon stats), PING (empty echo), FEEDBACK
+(payload = JSON {"scores", "labels", "weights"?, "model"?}; response =
+JSON {"ok", "rows"} — the drift observatory's live-AUC feed).  An error
 response carries status=1 and a UTF-8 message payload; status=2 is
 admission-limit backpressure (ServeOverload) — structurally distinct so
 clients can retry/shed without parsing messages.
@@ -52,6 +54,12 @@ OP_SCORE = 1
 OP_SWAP = 2
 OP_STATS = 3
 OP_PING = 4
+# labeled feedback for the drift observatory (obs/drift.py): payload =
+# JSON {"scores": [...], "labels": [...], "weights"?: [...],
+# "model"?: str}; response = JSON {"ok": true, "rows": N}.  Feeds the
+# trailing-window live-AUC accumulator behind `auc_decay`; rejected
+# with STATUS_ERROR when shifu.drift.feedback is off.
+OP_FEEDBACK = 5
 
 DTYPE_F32 = 0
 DTYPE_INT8 = 1
@@ -314,6 +322,20 @@ class ServeServer:
                           "error": f"{type(e).__name__}: {e}"[:300]}
             write_response(conn, 0, json.dumps(result).encode())
             return
+        if op == OP_FEEDBACK:
+            try:
+                req = json.loads(payload.decode() or "{}")
+                rows = daemon.feedback(
+                    req["scores"], req["labels"],
+                    weights=req.get("weights"),
+                    model_id=req.get("model", "default"))
+                result = {"ok": True, "rows": int(rows)}
+            except Exception as e:  # noqa: BLE001 — report, keep serving
+                write_response(conn, STATUS_ERROR,
+                               f"{type(e).__name__}: {e}"[:500].encode())
+                return
+            write_response(conn, 0, json.dumps(result).encode())
+            return
         if op != OP_SCORE:
             write_response(conn, 1, f"unknown opcode {op}".encode())
             return
@@ -409,6 +431,22 @@ class ServeClient:
             req["engine"] = engine
         body, _rn, _rc = self._roundtrip(OP_SWAP,
                                          payload=json.dumps(req).encode())
+        return json.loads(body.decode())
+
+    def feedback(self, scores, labels, weights=None,
+                 model_id: str = "default") -> dict:
+        """Ship labeled outcomes for rows this model scored (the drift
+        observatory's live-AUC feed).  Returns {"ok": True, "rows": N};
+        raises WireError when the daemon's feedback path is disabled."""
+        req = {"scores": np.asarray(scores, np.float64).ravel().tolist(),
+               "labels": np.asarray(labels, np.float64).ravel().tolist()}
+        if weights is not None:
+            req["weights"] = np.asarray(
+                weights, np.float64).ravel().tolist()
+        if model_id != "default":
+            req["model"] = model_id
+        body, _rn, _rc = self._roundtrip(
+            OP_FEEDBACK, payload=json.dumps(req).encode())
         return json.loads(body.decode())
 
     def stats(self) -> dict:

@@ -64,6 +64,12 @@ class TrainResult:
     history: list[EpochMetrics]
     job: JobConfig
     resumed_from_epoch: int = 0
+    # the frozen stats epoch (obs/sketch.build_profile): training-feature
+    # + score-distribution sketches from the LAST evaluated epoch, frozen
+    # into the export artifact as baseline_profile.json so the serving
+    # drift engine has something to diff live traffic against.  None when
+    # the run never evaluated (no valid rows) or features were unreadable.
+    baseline_profile: Optional[dict] = None
 
 
 def init_state(job: JobConfig, num_features: int,
@@ -271,7 +277,35 @@ def _restore_across_trunk_layout(manager, state: TrainState, job: JobConfig,
     return (state.replace(params=placed, step=step_val), extra, step)
 
 
-def _accumulate_streaming(triples) -> tuple[float, float]:
+def _baseline_feature_sketch(job: JobConfig, ds, cap: int = 1 << 18):
+    """FeatureSketch of the training partition on the int8 wire grid —
+    the feature half of the frozen baseline profile.  Stride-sampled to
+    at most `cap` rows (the grid is static, so a uniform stride is an
+    unbiased histogram sample).  Best-effort: None when features are not
+    materialized (exotic tiers) — the artifact just ships no profile."""
+    try:
+        feats = getattr(ds, "features", None)
+        if feats is None or feats.shape[0] == 0:
+            return None
+        scale, offset = pipe.wire_params(job.schema, job.data)
+        sk = obs.sketch.FeatureSketch(feats.shape[1], scale=scale,
+                                      offset=offset)
+        step = max(1, -(-int(feats.shape[0]) // int(cap)))
+        sk.update(np.asarray(feats[::step][:cap]))
+        return sk
+    except Exception:
+        return None
+
+
+def _baseline_feature_names(schema, num_features: int):
+    """Selected-column names for the profile (None when the schema
+    doesn't carry per-column metadata, e.g. synthetic datasets)."""
+    by_index = {c.index: c.name for c in schema.columns}
+    names = [by_index.get(i, f"f{i}") for i in schema.selected_indices]
+    return names if len(names) == num_features else None
+
+
+def _accumulate_streaming(triples, score_sink=None) -> tuple[float, float]:
     """THE eval accumulation: one StreamingMetrics over (scores, labels,
     weights) chunks, shared by the single-host and multihost branches of
     `evaluate` — the two used to carry their own copies, so eval
@@ -290,13 +324,18 @@ def _accumulate_streaming(triples) -> tuple[float, float]:
         lat.observe(time.perf_counter() - t0)
         sm.update(s, t, w)
         rows.inc(int(np.count_nonzero(np.asarray(w))))
+        if score_sink is not None:
+            # baseline score sketch: only rows that counted (zero-weight
+            # padding would skew the frozen score distribution)
+            score_sink(np.asarray(s)[np.asarray(w) > 0])
         t0 = time.perf_counter()
     return sm.weighted_error(), sm.auc()
 
 
 def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
              eval_step, mesh: Optional[Mesh] = None,
-             batch_size: Optional[int] = None) -> tuple[float, float]:
+             batch_size: Optional[int] = None,
+             score_sink=None) -> tuple[float, float]:
     """(weighted_error, auc) over the full dataset — every row counted, the
     tail padded with zero-weight rows (reference evaluates the full valid set
     per epoch, ssgd_monitor.py:281-284).
@@ -364,7 +403,7 @@ def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
             while pend:
                 yield fetch(pend.popleft())
 
-        return _accumulate_streaming(triples())
+        return _accumulate_streaming(triples(), score_sink)
 
     from jax.experimental import multihost_utils
     from jax.sharding import NamedSharding, PartitionSpec
@@ -401,7 +440,7 @@ def evaluate(state: TrainState, ds: pipe.TabularDataset, job: JobConfig,
                    np.asarray(t.addressable_data(0))[:, 0],
                    np.asarray(w.addressable_data(0))[:, 0])
 
-    return _accumulate_streaming(triples())
+    return _accumulate_streaming(triples(), score_sink)
 
 
 def train(job: JobConfig,
@@ -955,6 +994,12 @@ def train(job: JobConfig,
         return run()
 
     history: list[EpochMetrics] = []
+    # drift baseline (obs/sketch.py): the training-feature sketch is
+    # computed once (the features don't change across epochs); the score
+    # sketch refreshes at every evaluated epoch so the frozen profile
+    # reflects the exported model's actual output distribution
+    feat_sketch = None
+    baseline_profile: Optional[dict] = None
     # early stopping (TrainConfig.early_stop_patience): best valid error seen
     # and evaluated epochs since it improved by at least min_delta.  Counters
     # reset on resume — patience then applies to the remaining epochs.  The
@@ -1261,10 +1306,13 @@ def train(job: JobConfig,
 
         tv0 = time.perf_counter()
         if epoch % job.train.eval_every_epochs == 0 or epoch == job.train.epochs - 1:
+            score_sketch = obs.sketch.ScoreSketch()
             with obs.span("epoch/eval", epoch=epoch):
-                valid_error, valid_auc = evaluate(state, valid_ds, job,
-                                                  eval_step, mesh)
+                valid_error, valid_auc = evaluate(
+                    state, valid_ds, job, eval_step, mesh,
+                    score_sink=score_sketch.update)
         else:
+            score_sketch = None
             valid_error, valid_auc = float("nan"), float("nan")
         valid_time = time.perf_counter() - tv0
 
@@ -1294,6 +1342,22 @@ def train(job: JobConfig,
         if valid_auc == valid_auc:
             obs.gauge("valid_auc", "last evaluated valid AUC").set(valid_auc)
         obs.event("epoch", **dataclasses.asdict(m))
+        if score_sketch is not None and score_sketch.n > 0:
+            # the frozen stats epoch: journal a compact summary every
+            # evaluated epoch; the LAST one rides the export artifact as
+            # baseline_profile.json (obs/drift.py diffs live traffic
+            # against it)
+            if feat_sketch is None:
+                feat_sketch = _baseline_feature_sketch(job, train_ds)
+            if feat_sketch is not None:
+                baseline_profile = obs.sketch.build_profile(
+                    feat_sketch, score_sketch,
+                    feature_names=_baseline_feature_names(
+                        job.schema, feat_sketch.num_features),
+                    train_auc=valid_auc, train_error=m.train_error,
+                    epoch=epoch)
+                obs.event("baseline_profile",
+                          **obs.sketch.profile_summary(baseline_profile))
         # epoch-cadence flush: the scrape file must reflect a RUNNING job
         # (`shifu-tpu metrics` / a textfile collector mid-run), and a later
         # SIGKILL (liveness hard-kill) must not erase the whole run's
@@ -1502,4 +1566,5 @@ def train(job: JobConfig,
       obs.event("train_end", epochs_completed=len(history))
       obs.flush()
     return TrainResult(state=state, history=history, job=job,
-                       resumed_from_epoch=start_epoch)
+                       resumed_from_epoch=start_epoch,
+                       baseline_profile=baseline_profile)

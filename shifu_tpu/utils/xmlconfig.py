@@ -150,6 +150,20 @@ KEY_SERVING_SLO_AVAILABILITY = "shifu.serving.slo.availability"
 KEY_SERVING_SLO_FAST_WINDOW_S = "shifu.serving.slo.fast-window-s"
 KEY_SERVING_SLO_SLOW_WINDOW_S = "shifu.serving.slo.slow-window-s"
 KEY_SERVING_SLO_BURN_THRESHOLD = "shifu.serving.slo.burn-threshold"
+# drift observatory (DriftConfig nested under ServingConfig —
+# obs/drift.py, docs/OBSERVABILITY.md "Drift observatory"): kill
+# switch, fast/slow trailing windows, per-feature PSI + score-KL
+# thresholds, worst-feature fan-out, minimum-rows gate, and the
+# labeled-feedback (live AUC) path
+KEY_DRIFT_ENABLED = "shifu.drift.enabled"
+KEY_DRIFT_FAST_WINDOW_S = "shifu.drift.fast-window-s"
+KEY_DRIFT_SLOW_WINDOW_S = "shifu.drift.slow-window-s"
+KEY_DRIFT_PSI_THRESHOLD = "shifu.drift.psi-threshold"
+KEY_DRIFT_SCORE_KL_THRESHOLD = "shifu.drift.score-kl-threshold"
+KEY_DRIFT_TOP_K = "shifu.drift.top-k"
+KEY_DRIFT_MIN_ROWS = "shifu.drift.min-rows"
+KEY_DRIFT_FEEDBACK = "shifu.drift.feedback"
+KEY_DRIFT_FEEDBACK_BINS = "shifu.drift.feedback-bins"
 # serving fleet (FleetConfig — runtime/fleet.py, docs/SERVING.md "Fleet"):
 # member/standby counts, heartbeat lease cadence + miss tolerance, the
 # router's per-request/connect timeouts + reconnect backoff + overload
@@ -305,6 +319,40 @@ def serving_config_from_conf(conf: Mapping[str, str], base: Any = None) -> Any:
     if KEY_SERVING_SLO_BURN_THRESHOLD in conf:
         kw["slo_burn_threshold"] = float(
             conf[KEY_SERVING_SLO_BURN_THRESHOLD])
+    drift = drift_config_from_conf(conf, base.drift)
+    if drift is not base.drift:
+        kw["drift"] = drift
+    return dataclasses.replace(base, **kw) if kw else base
+
+
+def drift_config_from_conf(conf: Mapping[str, str], base: Any = None) -> Any:
+    """DriftConfig from `shifu.drift.*` keys over `base` (default: the
+    dataclass defaults) — called by serving_config_from_conf so serve,
+    fleet members and loadtest all see the same drift knobs."""
+    import dataclasses
+
+    from ..config.schema import DriftConfig
+
+    base = base or DriftConfig()
+    kw: dict[str, Any] = {}
+    _float_keys = {KEY_DRIFT_FAST_WINDOW_S: "fast_window_s",
+                   KEY_DRIFT_SLOW_WINDOW_S: "slow_window_s",
+                   KEY_DRIFT_PSI_THRESHOLD: "psi_threshold",
+                   KEY_DRIFT_SCORE_KL_THRESHOLD: "score_kl_threshold"}
+    _int_keys = {KEY_DRIFT_TOP_K: "top_k",
+                 KEY_DRIFT_MIN_ROWS: "min_rows",
+                 KEY_DRIFT_FEEDBACK_BINS: "feedback_bins"}
+    _bool_keys = {KEY_DRIFT_ENABLED: "enabled",
+                  KEY_DRIFT_FEEDBACK: "feedback"}
+    for key, field in _float_keys.items():
+        if key in conf:
+            kw[field] = float(conf[key])
+    for key, field in _int_keys.items():
+        if key in conf:
+            kw[field] = int(conf[key])
+    for key, field in _bool_keys.items():
+        if key in conf:
+            kw[field] = parse_bool(conf[key])
     return dataclasses.replace(base, **kw) if kw else base
 
 
