@@ -1180,6 +1180,90 @@ def main() -> None:
                     ach / (n_fleet * single), 4)
         except Exception as e:
             extras["fleet_error"] = str(e)[:200]
+
+        # serving cold-start drill (ISSUE 19): time-from-spawn and
+        # time-from-promotion to the FIRST healthy wire response on a
+        # `local:2` host plane, AOT-packed artifact vs live-jit — the
+        # artifact-level proof that shipping compiled executables moves
+        # fleet cold-start from compile-bound to deserialize-bound.
+        # tools/perf_gate.py gates `serving_cold_start_ms` (the AOT
+        # number) round-over-round (--cold-start-factor).
+        try:
+            from shifu_tpu import obs as _obs
+            from shifu_tpu.config.schema import FleetConfig
+            from shifu_tpu.config.schema import ServingConfig as _SCfg
+            from shifu_tpu.export.aot import try_load_aot
+            from shifu_tpu.obs import introspect as _intro
+            from shifu_tpu.runtime import fleet as fleet_mod
+            from shifu_tpu.runtime.serve import bucket_ladder
+            from shifu_tpu.runtime.serve_wire import ServeClient
+            from shifu_tpu.train.step import make_forward_fn
+
+            cs_dir = tempfile.mkdtemp(prefix="bench_aot_artifact_")
+            cs_ladder = bucket_ladder(8, 64)
+            save_artifact(jax.device_get(state2.params), job, cs_dir,
+                          forward_fn=make_forward_fn(job),
+                          aot_pack=True, aot_buckets=cs_ladder)
+            # pack verdict: does this host deserialize it? (fingerprint
+            # + digest gate in export/aot.py — miss means the drill's
+            # "aot" leg silently measured the jit fallback)
+            extras["serving_aot_pack"] = (
+                "hit" if try_load_aot(cs_dir) is not None else "miss")
+
+            def _cold_start(engine: str) -> tuple:
+                """(spawn_ms, promote_ms, live_compiles) for one leg."""
+                scfg = _SCfg(engine=engine, report_every_s=0.0,
+                             min_batch_bucket=8, max_batch=64)
+                mgr = fleet_mod.FleetManager(
+                    cs_dir,
+                    fleet=FleetConfig(n_daemons=1, standbys=1,
+                                      hosts="local:2"),
+                    serving=scfg).start()
+                try:
+                    row = np.zeros((1, num_features), np.float32)
+                    c0 = _intro.stats().get(
+                        "jax_scorer", {}).get("compiles", 0)
+                    # scale-up leg: a fresh member, spawn -> first
+                    # healthy response (what scale_tick "up" pays when
+                    # the standby pool is empty)
+                    t0 = time.perf_counter()
+                    m = mgr._spawn()
+                    with ServeClient(m.host, m.port) as c:
+                        c.score_rows(row)
+                    spawn_ms = (time.perf_counter() - t0) * 1e3
+                    m.stop()
+                    # failover leg: DOWN verdict -> standby promoted ->
+                    # first healthy response from the promoted member
+                    victim = next(iter(mgr.members.values()))
+                    t1 = time.perf_counter()
+                    mgr.failover(victim)
+                    promoted = next(iter(mgr.members.values()))
+                    with ServeClient(promoted.host, promoted.port) as c:
+                        c.score_rows(row)
+                    promote_ms = (time.perf_counter() - t1) * 1e3
+                    compiles = _intro.stats().get(
+                        "jax_scorer", {}).get("compiles", 0) - c0
+                finally:
+                    mgr.stop()
+                _obs.event("cold_start", engine=engine,
+                           spawn_ms=round(spawn_ms, 2),
+                           promote_ms=round(promote_ms, 2),
+                           live_compiles=compiles, hosts="local:2")
+                return round(spawn_ms, 2), round(promote_ms, 2), compiles
+
+            jit_spawn, jit_promote, _jc = _cold_start("jax")
+            aot_spawn, aot_promote, aot_compiles = _cold_start("aot")
+            extras["serving_cold_start_ms"] = aot_spawn
+            extras["serving_cold_start_ms_aot"] = aot_spawn
+            extras["serving_cold_start_ms_jit"] = jit_spawn
+            extras["serving_promote_ms_aot"] = aot_promote
+            extras["serving_promote_ms_jit"] = jit_promote
+            # zero live XLA compiles in the AOT serve window is the
+            # whole point — surface the count so a regression (pack
+            # miss -> silent jit fallback) is visible in the report
+            extras["serving_cold_start_compiles_aot"] = aot_compiles
+        except Exception as e:
+            extras["serving_cold_start_error"] = str(e)[:200]
     except Exception:
         pass
 
@@ -1559,6 +1643,9 @@ _HEADLINE_OPTIONAL = (
     "score_single_row_per_sec_native_median",
     "serving_scores_per_sec",
     "serving_p99_ms",
+    "serving_cold_start_ms",
+    "serving_cold_start_ms_jit",
+    "serving_aot_pack",
     "fleet_scaling_efficiency",
     "fleet_scores_per_sec",
     "parse_rows_per_sec",

@@ -668,8 +668,10 @@ class ServingConfig:
     utils/xmlconfig.serving_config_from_conf) layers the same way train
     keys do, with CLI flags as the top override."""
 
-    # scoring engine tier: auto / native / numpy / stablehlo / jax
-    # (same ladder as `shifu-tpu score --engine`)
+    # scoring engine tier: auto / native / numpy / stablehlo / jax / aot
+    # (same ladder as `shifu-tpu score --engine`; `aot` forces the
+    # artifact's pre-compiled executable pack, degrading to jax when the
+    # pack is absent or fingerprint-incompatible)
     engine: str = "auto"
     # adaptive micro-batcher: a LONE request is dispatched after at most
     # this budget (ms); under load batches fill to max_batch and dispatch
@@ -725,12 +727,25 @@ class ServingConfig:
     # obs/drift.py); engages only when the artifact carries a
     # baseline_profile.json.
     drift: DriftConfig = field(default_factory=DriftConfig)
+    # export-time opt-in (`shifu.serving.aot-pack` / `--aot-pack`):
+    # compile the scorer for every rung of the padded bucket ladder at
+    # save_artifact time and ship the serialized executables inside the
+    # artifact (export/aot.py) — a fleet member then cold-starts by
+    # deserializing instead of compiling.  Load side needs no flag: a
+    # pack that matches the host fingerprint is used, anything else
+    # falls back to jit.
+    aot_pack: bool = False
+    # warm EVERY bucket of the ladder (largest-first, small thread pool)
+    # before a load/swap flips the registry pointer — so a post-failover
+    # burst at any batch size never compiles in the hot path.  False
+    # restores the old single 1-row warm.
+    prewarm_ladder: bool = True
 
     def validate(self) -> None:
         if self.engine not in ("auto", "native", "numpy", "stablehlo",
-                               "jax"):
+                               "jax", "aot"):
             raise ConfigError(f"serving.engine must be one of auto/native/"
-                              f"numpy/stablehlo/jax: {self.engine!r}")
+                              f"numpy/stablehlo/jax/aot: {self.engine!r}")
         if self.latency_budget_ms <= 0:
             raise ConfigError("serving.latency_budget_ms must be > 0: "
                               f"{self.latency_budget_ms}")

@@ -111,7 +111,9 @@ def export_stablehlo(forward_fn, params, num_features: int, path: str,
 def save_artifact(params: Any, job: JobConfig, export_dir: str,
                   forward_fn=None, algorithm: str = "tensorflow",
                   extra_inputs: Optional[dict] = None,
-                  baseline_profile: Optional[dict] = None) -> str:
+                  baseline_profile: Optional[dict] = None,
+                  aot_pack: bool = False,
+                  aot_buckets: Optional[tuple] = None) -> str:
     """Write the full scoring artifact; returns export_dir.
 
     `baseline_profile` (obs/sketch.build_profile — the frozen stats
@@ -124,6 +126,15 @@ def save_artifact(params: Any, job: JobConfig, export_dir: str,
     `algorithm` defaults to "tensorflow" for byte-level sidecar parity with
     the reference (ssgd_monitor.py:476-490) so an unmodified Shifu eval step
     routes the model to its generic scorer the same way.
+
+    `aot_pack` (the `shifu.serving.aot-pack` key / `--aot-pack` flag)
+    additionally compiles the scorer for every rung of the serving
+    bucket ladder and ships the serialized executables in `aot/`
+    (export/aot.py) — written BEFORE the sync manifest, so the pack is
+    digest-verified by the per-host fleet sync like any other artifact
+    file.  `aot_buckets` overrides the rung grid (default: the
+    ServingConfig-default ladder).  Requires `forward_fn`; best-effort
+    like the StableHLO export.
 
     `extra_inputs` maps auxiliary input names to constant values; they are
     recorded as additional sidecar inputnames whose VALUES live in the
@@ -206,6 +217,17 @@ def save_artifact(params: Any, job: JobConfig, export_dir: str,
     if forward_fn is not None:
         export_stablehlo(forward_fn, params, job.schema.feature_count,
                          os.path.join(export_dir, STABLEHLO))
+        if aot_pack:
+            from ..runtime.serve import bucket_ladder
+            from .aot import build_aot_pack
+            if aot_buckets is None:
+                from ..config.schema import ServingConfig
+                _sc = ServingConfig()
+                aot_buckets = bucket_ladder(_sc.min_batch_bucket,
+                                            _sc.max_batch)
+            build_aot_pack(export_dir, forward_fn, params,
+                           job.schema.feature_count, job.model.num_heads,
+                           tuple(aot_buckets))
     try:
         # digest manifest for cross-host fleet pulls (runtime/fleet.py
         # sync_artifact verifies against it); best-effort — a local-only

@@ -443,14 +443,21 @@ def _unflatten(flat: dict[str, np.ndarray]) -> dict:
 
 def load_scorer(export_dir: str):
     """Scorer for an artifact, best tier first: op-list interpreter when the
-    program exists, the serialized compiled graph (StableHloScorer — no model
-    classes needed) when present, JaxScorer (model rebuild) as last resort."""
+    program exists, the AOT executable pack (export/aot.py — fingerprint
+    match means zero compiles) when shipped, the serialized compiled graph
+    (StableHloScorer — no model classes needed) when present, JaxScorer
+    (model rebuild) as last resort."""
     from .artifact import JAX_EXPORT
 
     with open(os.path.join(export_dir, TOPOLOGY)) as f:
         topo = json.load(f)
     if topo.get("program"):
         return Scorer(export_dir)
+    from .aot import has_pack, try_load_aot
+    if has_pack(export_dir):
+        scorer = try_load_aot(export_dir)
+        if scorer is not None:
+            return scorer  # mismatch journaled aot_fallback; jit below
     if os.path.exists(os.path.join(export_dir, JAX_EXPORT)):
         try:
             return StableHloScorer(export_dir)

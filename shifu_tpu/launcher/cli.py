@@ -211,7 +211,8 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--output", default="-", help="output file (- = stdout)")
     s.add_argument("--native", action="store_true", help="use the C++ engine")
     s.add_argument("--engine", default="auto",
-                   choices=["auto", "native", "numpy", "stablehlo", "jax"],
+                   choices=["auto", "native", "numpy", "stablehlo", "jax",
+                            "aot"],
                    help="scoring engine tier (auto = best available)")
     s.add_argument("--globalconfig", default=None,
                    help="Hadoop-style XML (shifu.security.* for secured HDFS)")
@@ -223,7 +224,8 @@ def build_parser() -> argparse.ArgumentParser:
                       "wire front-end (docs/SERVING.md)")
     sv.add_argument("model", help="artifact dir (the export output)")
     sv.add_argument("--engine", default=None,
-                    choices=["auto", "native", "numpy", "stablehlo", "jax"],
+                    choices=["auto", "native", "numpy", "stablehlo", "jax",
+                            "aot"],
                     help="scoring engine tier (default: serving.engine / "
                          "auto)")
     sv.add_argument("--port", type=int, default=-1,
@@ -289,7 +291,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="router bind host (default 127.0.0.1)")
     fl.add_argument("--engine", default=None,
                     choices=["auto", "native", "numpy", "stablehlo",
-                             "jax"],
+                             "jax", "aot"],
                     help="member scoring engine tier")
     fl.add_argument("--budget-ms", type=float, default=0,
                     help="member micro-batcher latency budget "
@@ -389,7 +391,8 @@ def build_parser() -> argparse.ArgumentParser:
     lt.add_argument("--duration", type=float, default=5.0,
                     help="seconds of offered load (default 5)")
     lt.add_argument("--engine", default="auto",
-                    choices=["auto", "native", "numpy", "stablehlo", "jax"],
+                    choices=["auto", "native", "numpy", "stablehlo", "jax",
+                            "aot"],
                     help="engine tier for --model mode")
     lt.add_argument("--senders", type=int, default=2,
                     help="open-loop sender threads (the Poisson stream is "
@@ -439,6 +442,12 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--output", required=True, help="artifact output dir")
     x.add_argument("--globalconfig", default=None,
                    help="Hadoop-style XML (same layering as train)")
+    x.add_argument("--aot-pack", action="store_true",
+                   help="also compile + serialize the serving bucket-"
+                        "ladder executables into aot/ (export/aot.py; "
+                        "same opt-in as the shifu.serving.aot-pack key) "
+                        "— fleet members then cold-start without XLA "
+                        "compiles")
 
     e = sub.add_parser(
         "eval", help="score labeled rows and report AUC/error (the Shifu "
@@ -454,7 +463,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also write per-row scores to this file")
     e.add_argument("--native", action="store_true", help="use the C++ engine")
     e.add_argument("--engine", default="auto",
-                   choices=["auto", "native", "numpy", "stablehlo", "jax"],
+                   choices=["auto", "native", "numpy", "stablehlo", "jax",
+                            "aot"],
                    help="scoring engine tier (auto = best available)")
     e.add_argument("--globalconfig", default=None,
                    help="Hadoop-style XML (shifu.security.* for secured HDFS)")
@@ -1075,8 +1085,10 @@ def run_train(args) -> int:
         # make_forward_fn inside: meshless rebuild for single-host export
         # (the training loop's frozen reference profile rides along as
         # baseline_profile.json — the drift observatory's anchor)
+        aot_pack, aot_buckets = _export_aot_opts(args)
         _export_and_pack(params, job, job.runtime.final_model_path, board,
-                         baseline_profile=result.baseline_profile)
+                         baseline_profile=result.baseline_profile,
+                         aot_pack=aot_pack, aot_buckets=aot_buckets)
         _write_metrics_jsonl(result, fsio_lib.join(out_dir, "metrics.jsonl"))
         if result.history:
             last = result.history[-1]
@@ -1923,8 +1935,32 @@ def run_eval(args) -> int:
     return EXIT_OK
 
 
+def _export_aot_opts(args) -> tuple:
+    """(aot_pack, aot_buckets) for the export sequence: opt-in via the
+    `shifu.serving.aot-pack` key in --globalconfig or the export
+    command's --aot-pack flag; the rung grid comes from the SAME conf's
+    serving ladder keys so the pack matches what the fleet will serve."""
+    from ..utils import xmlconfig
+
+    cfg = None
+    if getattr(args, "globalconfig", None):
+        try:
+            conf = xmlconfig.parse_configuration_xml(args.globalconfig)
+            cfg = xmlconfig.serving_config_from_conf(conf)
+        except Exception:
+            cfg = None
+    if not (getattr(args, "aot_pack", False) or (cfg and cfg.aot_pack)):
+        return False, None
+    from ..config.schema import ServingConfig
+    from ..runtime.serve import bucket_ladder
+
+    sc = cfg or ServingConfig()
+    return True, bucket_ladder(sc.min_batch_bucket, sc.max_batch)
+
+
 def _export_and_pack(params, job, out_dir, console,
-                     baseline_profile=None) -> str:
+                     baseline_profile=None, aot_pack=False,
+                     aot_buckets=None) -> str:
     """The one export sequence (artifact + best-effort native pack) shared
     by the train tail and the export recovery command — divergence here
     would give the recovery path different artifacts than training.
@@ -1946,7 +1982,9 @@ def _export_and_pack(params, job, out_dir, console,
             local_dir = tempfile.mkdtemp(prefix="shifu_tpu_export_")
         export_dir = save_artifact(params, job, local_dir,
                                    forward_fn=make_forward_fn(job),
-                                   baseline_profile=baseline_profile)
+                                   baseline_profile=baseline_profile,
+                                   aot_pack=aot_pack,
+                                   aot_buckets=aot_buckets)
         try:
             from ..runtime import pack_native
             pack_native(export_dir)
@@ -1996,8 +2034,10 @@ def run_export(args) -> int:
     r_state, extra, step = restored
     print(f"exporting checkpoint step {step} "
           f"(epoch {(extra or {}).get('epoch', '?')})", flush=True)
+    aot_pack, aot_buckets = _export_aot_opts(args)
     _export_and_pack(jax.device_get(r_state.params), job, args.output,
-                     lambda s: print(s, flush=True))
+                     lambda s: print(s, flush=True),
+                     aot_pack=aot_pack, aot_buckets=aot_buckets)
     return EXIT_OK
 
 
@@ -2066,9 +2106,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # repeat compiles (supervisor restarts, re-runs of the same job)
         # deserialize from the persistent cache instead of recompiling.
         # Only for commands that compile: status/attach/kill/provision are
-        # file/CLI operations and must not pay the jax import
+        # file/CLI operations and must not pay the jax import.  Serving
+        # paths drop the persistence floor to 0: padded-bucket scorer
+        # programs compile in tens of ms — below the 0.5s train-path
+        # floor, which would silently skip exactly the compiles a member
+        # restart pays again (hit/miss verdicts ride every xla_compile
+        # event through the observe_compile seam)
         from ..utils.compilecache import enable_persistent_cache
-        enable_persistent_cache()
+        serving_cmd = args.command in ("serve", "loadtest", "fleet")
+        enable_persistent_cache(
+            min_compile_time_secs=0.0 if serving_cmd else 0.5)
     if args.command == "train":
         # daemonized dispatcher: record the terminal state for `status`
         # even when the run unwinds via SystemExit (the provision branch

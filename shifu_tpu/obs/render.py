@@ -275,6 +275,10 @@ def profile_summary(path: str) -> Optional[dict]:
     tier_reports = 0
     dedup_last: Optional[dict] = None
     offload_fallbacks = 0
+    aot_loads: list[dict] = []
+    aot_fallbacks: list[dict] = []
+    aot_packs: list[dict] = []
+    prewarm_last: Optional[dict] = None
     recovery = {"restore_s": 0.0, "restores": 0, "fallbacks": 0,
                 "cache_fallbacks": 0, "preemption_graces": 0, "resumes": 0}
     for rec in events:
@@ -349,6 +353,14 @@ def profile_summary(path: str) -> Optional[dict]:
             dedup_last = rec
         elif kind == "embed_offload_fallback":
             offload_fallbacks += 1
+        elif kind == "aot_load":
+            aot_loads.append(rec)
+        elif kind == "aot_fallback":
+            aot_fallbacks.append(rec)
+        elif kind == "aot_pack":
+            aot_packs.append(rec)
+        elif kind == "model_prewarm":
+            prewarm_last = rec
 
     totals: dict[str, float] = {}
     fracs, mfus = [], []
@@ -430,6 +442,27 @@ def profile_summary(path: str) -> Optional[dict]:
     if offload_fallbacks:
         embed["offload_fallbacks"] = offload_fallbacks
     out["embed"] = embed or None
+    # AOT serving-executable plane (docs/SERVING.md "Cold start & AOT
+    # pack"): packed grids built, executables deserialized (the
+    # zero-compile loads), and every fallback with its reason — a
+    # fallback row here is the first place a fingerprint drift shows up
+    aot: dict = {}
+    if aot_packs:
+        aot["packs"] = len(aot_packs)
+        aot["pack_buckets"] = aot_packs[-1].get("buckets")
+    if aot_loads:
+        last = aot_loads[-1]
+        aot["loads"] = len(aot_loads)
+        aot["last_load"] = {k: last.get(k) for k in
+                            ("path", "buckets", "bucket_ms", "wall_ms")}
+    if aot_fallbacks:
+        aot["fallbacks"] = len(aot_fallbacks)
+        aot["last_fallback"] = {
+            k: aot_fallbacks[-1].get(k) for k in ("path", "reason")}
+    if prewarm_last is not None:
+        aot["prewarm"] = {k: prewarm_last.get(k) for k in
+                          ("engine", "buckets", "wall_ms")}
+    out["aot"] = aot or None
     return out
 
 
@@ -515,6 +548,29 @@ def render_profile_text(summary: dict) -> str:
                 parts.append("cache " + "/".join(
                     f"{k}={v}" for k, v in sorted(cache.items())))
             lines.append(" ".join(parts))
+    aot = summary.get("aot") or {}
+    if aot:
+        bits = []
+        if aot.get("packs"):
+            bits.append(f"{aot['packs']} pack(s) built "
+                        f"(buckets {aot.get('pack_buckets')})")
+        last_load = aot.get("last_load") or {}
+        if aot.get("loads"):
+            bits.append(
+                f"{aot['loads']} zero-compile load(s), last "
+                f"{last_load.get('wall_ms')} ms over buckets "
+                f"{last_load.get('buckets')}")
+        if aot.get("fallbacks"):
+            lf = aot.get("last_fallback") or {}
+            bits.append(f"{aot['fallbacks']} FALLBACK(s) to jit, last: "
+                        f"{lf.get('reason')}")
+        if bits:
+            lines.append("aot executables: " + "; ".join(bits))
+        pw = aot.get("prewarm") or {}
+        if pw:
+            lines.append(
+                f"  pre-warm [{pw.get('engine')}]: ladder "
+                f"{pw.get('buckets')} in {pw.get('wall_ms')} ms")
     device = summary.get("device") or {}
     if device:
         bits = []
@@ -801,6 +857,10 @@ def top_summary(path: str,
     dedup_last: Optional[dict] = None
     drift_last: Optional[dict] = None
     drift_alerts: list[dict] = []
+    aot_load_last: Optional[dict] = None
+    aot_loads = 0
+    aot_fallback_last: Optional[dict] = None
+    aot_fallbacks = 0
     mode = "train"
     for rec in events:
         kind = rec.get("kind")
@@ -832,6 +892,12 @@ def top_summary(path: str,
             tier_last = rec
         elif kind == "embed_dedup_report":
             dedup_last = rec
+        elif kind == "aot_load":
+            aot_load_last = rec
+            aot_loads += 1
+        elif kind == "aot_fallback":
+            aot_fallback_last = rec
+            aot_fallbacks += 1
     if serve_start is not None or reports or loadtests:
         mode = "serving"
     out: dict = {"journal": jpath, "mode": mode, "events": total_events}
@@ -938,6 +1004,16 @@ def top_summary(path: str,
                 "alerts_total": sum(1 for a in drift_alerts
                                     if a.get("state") == "firing"),
             }
+        # AOT executable rows (ISSUE 19): zero-compile loads vs journaled
+        # fallbacks — read straight from the journal tail, no jax needed
+        if aot_loads or aot_fallbacks:
+            out["aot"] = {"loads": aot_loads, "fallbacks": aot_fallbacks}
+            if aot_load_last is not None:
+                out["aot"]["buckets"] = aot_load_last.get("buckets")
+                out["aot"]["load_ms"] = aot_load_last.get("wall_ms")
+            if aot_fallback_last is not None:
+                out["aot"]["last_fallback_reason"] = \
+                    aot_fallback_last.get("reason")
         out["request_traces"] = traces
         if route_traces:
             out["route_traces"] = route_traces
@@ -1104,6 +1180,21 @@ def render_top_text(summary: dict) -> str:
         if dr.get("firing"):
             bits.append("FIRING " + ",".join(dr["firing"]))
         lines.append("  ".join(bits))
+    aot = summary.get("aot")
+    if aot:
+        bits = []
+        if aot.get("loads"):
+            bits.append(
+                f"{aot['loads']} zero-compile load(s)"
+                + (f" of buckets {aot.get('buckets')}"
+                   if aot.get("buckets") else "")
+                + (f" in {aot.get('load_ms')} ms"
+                   if aot.get("load_ms") is not None else ""))
+        if aot.get("fallbacks"):
+            bits.append(f"{aot['fallbacks']} FALLBACK(s) to jit"
+                        + (f" ({aot.get('last_fallback_reason')})"
+                           if aot.get("last_fallback_reason") else ""))
+        lines.append("aot: " + "  ".join(bits))
     if summary.get("request_traces"):
         lines.append(f"sampled request traces: "
                      f"{summary['request_traces']}"

@@ -409,7 +409,14 @@ class FleetMember:
         # plane); NOT the wire bind — that stays `self.host`
         self.host_id = host_id
         self._fleet = fleet
-        registry = serve.ModelRegistry(loader=loader) if loader else None
+        # a custom-loader registry still gets the daemon's bucket grid so
+        # its loads pre-warm the full ladder exactly like an owned one
+        registry = serve.ModelRegistry(
+            loader=loader,
+            warm_ladder=(serve.bucket_ladder(serving.min_batch_bucket,
+                                             serving.max_batch)
+                         if serving.prewarm_ladder else None)) \
+            if loader else None
         if registry is not None and export_dir is not None:
             registry.load(export_dir, engine=serving.engine,
                           model_id=model_id)

@@ -65,8 +65,22 @@ def load_engine(export_dir: str, engine: str = "auto"):
     """Build one scoring engine for an artifact — the tier ladder shared
     by `shifu-tpu score/eval` (launcher/cli.py delegates here) and the
     serving daemon's model loads: native (C++ op-list) / numpy (op-list
-    interpreter) / stablehlo (serialized compiled graph) / jax (model
-    rebuild) / auto (export.load_scorer's best-available order)."""
+    interpreter) / aot (pre-compiled executable pack) / stablehlo
+    (serialized compiled graph) / jax (model rebuild) / auto
+    (export.load_scorer's best-available order).
+
+    `aot` sits ABOVE the jit tiers: a fingerprint-matched pack
+    deserializes its bucket executables with zero compiles (journaled
+    `aot_load`); any mismatch or damage journals `aot_fallback` and
+    degrades to JaxScorer — an explicit `--engine aot` is a preference,
+    never a refused load."""
+    if engine == "aot":
+        from ..export.aot import try_load_aot
+        scorer = try_load_aot(export_dir)
+        if scorer is not None:
+            return scorer
+        from ..export.scorer import JaxScorer
+        return JaxScorer(export_dir)
     if engine == "native":
         from .native_scorer import NativeScorer
         return NativeScorer(export_dir)
@@ -136,15 +150,21 @@ class ModelRegistry:
     """Versioned multi-model registry with atomic hot-swap.
 
     `load()` is both initial load and swap: the new scorer is built and
-    WARMED (one-row score, so a jit engine's first live request never pays
-    the compile) before the pointer flips; the old version keeps serving
-    until that instant and is retired/closed after its in-flight batches
-    release.  Every load attempt passes the `runtime.serve` chaos probe —
-    an injected (or real) failure leaves the previous version installed
-    and is journaled as `model_swap_failed`."""
+    WARMED before the pointer flips; the old version keeps serving until
+    that instant and is retired/closed after its in-flight batches
+    release.  With `warm_ladder` set (the daemon's padded bucket grid),
+    a static-shape engine is warmed at EVERY rung — largest-first on a
+    small thread pool — so no live request ever meets an uncompiled
+    shape, on initial load, hot-swap, or a standby's spawn alike;
+    engines without static shapes keep the single 1-row warm.  Every
+    load attempt passes the `runtime.serve` chaos probe — an injected
+    (or real) failure leaves the previous version installed and is
+    journaled as `model_swap_failed`."""
 
-    def __init__(self, loader: Optional[Callable] = None):
+    def __init__(self, loader: Optional[Callable] = None,
+                 warm_ladder: Optional[tuple] = None):
         self._loader = loader or load_engine
+        self._warm_ladder = tuple(warm_ladder) if warm_ladder else None
         self._lock = threading.RLock()
         # serializes load(): two concurrent swaps of one model_id would
         # otherwise both snapshot the same predecessor and the
@@ -190,9 +210,7 @@ class ModelRegistry:
                     f"{n_feat} — a swapped model must keep the wire schema")
             n_heads = None
             if warm and n_feat:
-                out = scorer.compute_batch(np.zeros((1, n_feat),
-                                                    np.float32))
-                n_heads = int(out.shape[1])
+                n_heads = self._warm_scorer(scorer, n_feat, model_id, obs)
                 if old is not None and old.num_heads is not None \
                         and n_heads != old.num_heads:
                     raise ValueError(
@@ -233,6 +251,54 @@ class ModelRegistry:
                   old_version=old.version if old else None,
                   path=export_dir, engine=handle.engine_name)
         return handle
+
+    def _warm_scorer(self, scorer, n_feat: int, model_id: str,
+                     obs) -> int:
+        """Warm the not-yet-installed scorer and return its head count.
+
+        Static-shape engines with a configured ladder get the FULL-ladder
+        pre-warm: every padded bucket compiled/loaded largest-first on a
+        small thread pool, BEFORE the caller flips the registry pointer —
+        the serve window then contains zero live XLA compiles (the AOT
+        tier deserializes here; jit tiers pay their compiles here instead
+        of on the first matching request).  Warm rows are reported with
+        `n_valid=0`, so pre-warm traffic never inflates
+        `score_rows_total` or the per-row serving rates.  Other engines
+        keep the single 1-row warm.  Any warm failure propagates — the
+        load fails and the previous version keeps serving."""
+        ladder = self._warm_ladder
+        if not (ladder and getattr(scorer, "static_shapes", False)):
+            out = scorer.compute_batch(np.zeros((1, n_feat), np.float32))
+            return int(out.shape[1])
+        sizes = sorted({int(b) for b in ladder}, reverse=True)
+        bucket_ms: dict[str, float] = {}
+        ms_lock = threading.Lock()
+
+        def warm_one(b: int) -> int:
+            t_b = time.perf_counter()
+            out = scorer.compute_batch(np.zeros((b, n_feat), np.float32),
+                                       n_valid=0)
+            with ms_lock:
+                bucket_ms[str(b)] = round(
+                    (time.perf_counter() - t_b) * 1e3, 3)
+            return int(out.shape[1])
+
+        from concurrent.futures import ThreadPoolExecutor
+        t0 = time.perf_counter()
+        workers = min(4, len(sizes))
+        if workers > 1:
+            with ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix="serve-prewarm") as pool:
+                heads = list(pool.map(warm_one, sizes))
+        else:
+            heads = [warm_one(sizes[0])]
+        obs.event("model_prewarm", model=model_id,
+                  engine=getattr(scorer, "engine",
+                                 type(scorer).__name__.lower()),
+                  buckets=sizes[::-1], bucket_ms=bucket_ms,
+                  wall_ms=round((time.perf_counter() - t0) * 1e3, 3))
+        return heads[0]
 
     def acquire(self, model_id: str = "default") -> _ModelHandle:
         with self._lock:
@@ -302,10 +368,18 @@ class ScoringDaemon:
         self.config = config or ServingConfig()
         self.config.validate()
         self.model_id = model_id
+        # the padded-bucket grid, computed BEFORE the registry so an
+        # owned registry pre-warms every rung of it on load/swap
+        # (prewarm_ladder=False restores the single 1-row warm)
+        self._ladder = bucket_ladder(self.config.min_batch_bucket,
+                                     self.config.max_batch)
         # an injected registry is the CALLER's (it may back other
         # daemons / models); only a registry we built is ours to close
         self._owns_registry = registry is None
-        self._registry = registry or ModelRegistry(loader=loader)
+        self._registry = registry or ModelRegistry(
+            loader=loader,
+            warm_ladder=(self._ladder if self.config.prewarm_ladder
+                         else None))
         if export_dir is not None:
             self._registry.load(export_dir, engine=self.config.engine,
                                 model_id=model_id)
@@ -316,8 +390,6 @@ class ScoringDaemon:
         self.num_features = int(current.scorer.num_features)
         self._row_shape = (self.num_features,)
         self._on_batch = on_batch
-        self._ladder = bucket_ladder(self.config.min_batch_bucket,
-                                     self.config.max_batch)
         self._budget_s = self.config.latency_budget_ms / 1000.0
         # a plain Lock, not the Condition default RLock: submit() takes it
         # once per request on the hot path and never recursively

@@ -57,13 +57,26 @@ def observe_compile() -> str:
     return "miss" if fresh else "hit"
 
 
-def enable_persistent_cache(directory: str | None = None) -> str | None:
+def enable_persistent_cache(directory: str | None = None,
+                            min_compile_time_secs: float = 0.5
+                            ) -> str | None:
     """Enable JAX's persistent compilation cache (idempotent, best-effort).
 
     Precedence: explicit `directory` > JAX_COMPILATION_CACHE_DIR env >
     the default under ~/.cache.  SHIFU_TPU_NO_COMPILE_CACHE=1 disables.
     Returns the directory in use, or None when disabled/unavailable.
-    """
+
+    `min_compile_time_secs` is the persistence floor: compiles faster
+    than this are never written.  The 0.5s default fits the TRAIN path
+    (multi-second epoch programs; skipping tiny helper jits keeps the
+    cache dir from filling with entries that cost more to look up than
+    to recompile).  The SERVING paths pass 0: the padded-bucket scorer
+    programs compile in tens of milliseconds each, exactly the band the
+    default silently skips — and a fleet member's cold-start is the sum
+    of those "too small to persist" compiles.  Tradeoff of 0: every
+    compile writes an entry, so the cache dir grows with each distinct
+    shape; acceptable for the bounded bucket ladder, wasteful for
+    unbounded-shape workloads."""
     if os.environ.get(ENV_DISABLE):
         return None
     path = directory or os.environ.get(ENV_DIR) or os.path.expanduser(
@@ -74,9 +87,11 @@ def enable_persistent_cache(directory: str | None = None) -> str | None:
         import jax
         jax.config.update("jax_compilation_cache_dir", path)
         # default thresholds skip small/fast programs; job programs are the
-        # multi-second compiles this cache exists for
+        # multi-second compiles this cache exists for, serving bucket
+        # programs the sub-second ones (callers pick the floor)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_secs))
         with _lock:
             _active_dir = path
             _seen_entries = _list_entries(path)

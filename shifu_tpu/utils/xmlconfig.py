@@ -150,6 +150,11 @@ KEY_SERVING_SLO_AVAILABILITY = "shifu.serving.slo.availability"
 KEY_SERVING_SLO_FAST_WINDOW_S = "shifu.serving.slo.fast-window-s"
 KEY_SERVING_SLO_SLOW_WINDOW_S = "shifu.serving.slo.slow-window-s"
 KEY_SERVING_SLO_BURN_THRESHOLD = "shifu.serving.slo.burn-threshold"
+# cold-start plane (export/aot.py, docs/SERVING.md "Cold start & AOT
+# pack"): export-time AOT executable packing opt-in, and the
+# full-ladder pre-warm a load/swap runs before its pointer flips
+KEY_SERVING_AOT_PACK = "shifu.serving.aot-pack"
+KEY_SERVING_PREWARM_LADDER = "shifu.serving.prewarm-ladder"
 # drift observatory (DriftConfig nested under ServingConfig —
 # obs/drift.py, docs/OBSERVABILITY.md "Drift observatory"): kill
 # switch, fast/slow trailing windows, per-feature PSI + score-KL
@@ -319,6 +324,10 @@ def serving_config_from_conf(conf: Mapping[str, str], base: Any = None) -> Any:
     if KEY_SERVING_SLO_BURN_THRESHOLD in conf:
         kw["slo_burn_threshold"] = float(
             conf[KEY_SERVING_SLO_BURN_THRESHOLD])
+    if KEY_SERVING_AOT_PACK in conf:
+        kw["aot_pack"] = parse_bool(conf[KEY_SERVING_AOT_PACK])
+    if KEY_SERVING_PREWARM_LADDER in conf:
+        kw["prewarm_ladder"] = parse_bool(conf[KEY_SERVING_PREWARM_LADDER])
     drift = drift_config_from_conf(conf, base.drift)
     if drift is not base.drift:
         kw["drift"] = drift

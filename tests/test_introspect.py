@@ -309,7 +309,8 @@ def test_status_quick_summary_carries_goodput(tmp_path, monkeypatch):
 
 def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7,
               cold=300.0, hbm=1 << 30, serving=250_000.0,
-              serving_p99=6.0, sparse=1.3, ft_mfu=0.31, fleet_eff=0.8):
+              serving_p99=6.0, sparse=1.3, ft_mfu=0.31, fleet_eff=0.8,
+              cold_start=40.0):
     return {"value": value, "unit": "samples/sec/chip",
             "goodput": {"goodput_fraction_mean": goodput_frac},
             "xla_compiles": {"total": compiles},
@@ -320,7 +321,8 @@ def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7,
             "serving_p99_ms": serving_p99,
             "ladder_deepfm_4mvocab_sparse_speedup": sparse,
             "ft_transformer_mfu": ft_mfu,
-            "fleet_scaling_efficiency": fleet_eff}
+            "fleet_scaling_efficiency": fleet_eff,
+            "serving_cold_start_ms": cold_start}
 
 
 @pytest.mark.perf
@@ -439,6 +441,17 @@ def test_perf_gate_fails_each_axis():
     r = perf_gate.run_gate(_artifact(fleet_eff=0.5),
                            _artifact(fleet_eff=0.5))
     assert r["verdict"] == "PASS"
+    # serving cold-start explosion (above the 3x --cold-start-factor
+    # default): a lost AOT pack degrades spawn-to-ready back to live
+    # jit compiles (ISSUE 19)
+    r = perf_gate.run_gate(_artifact(cold_start=400.0), base)
+    assert r["verdict"] == "REGRESSION"
+    assert [c for c in r["checks"]
+            if c["name"] == "serving_cold_start_ms"][0]["status"] \
+        == "REGRESSION"
+    # ...shared-host deserialize wobble inside the factor passes
+    r = perf_gate.run_gate(_artifact(cold_start=80.0), base)
+    assert r["verdict"] == "PASS"
     # e2e ceiling ratchet floor (ISSUE 11): a healthy 0.7 baseline holds
     # the limit at the 0.5 floor, so a bleed to 0.45 fails even though
     # it is within the 0.2 absolute drop...
@@ -462,7 +475,7 @@ def test_perf_gate_fails_each_axis():
     # still gates the axes it carries
     r = perf_gate.run_gate({"value": 100.0}, base)
     assert r["verdict"] == "PASS"
-    assert [c["status"] for c in r["checks"]] == ["OK"] + ["SKIP"] * 10
+    assert [c["status"] for c in r["checks"]] == ["OK"] + ["SKIP"] * 11
 
 
 @pytest.mark.perf
@@ -503,7 +516,7 @@ def test_perf_gate_cli_pass_fail_and_check_only(tmp_path):
         _artifact(value=10.0, goodput_frac=0.1, compiles=100, ceiling=0.1,
                   cold=10.0, hbm=8 << 30, serving=10_000.0,
                   serving_p99=90.0, sparse=0.5, ft_mfu=0.05,
-                  fleet_eff=0.1)))
+                  fleet_eff=0.1, cold_start=900.0)))
 
     def run(*args):
         return subprocess.run([sys.executable, gate, *args],

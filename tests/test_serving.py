@@ -98,9 +98,13 @@ class StubScorer:
 
 
 def _stub_daemon(stub, **cfg_kw) -> ScoringDaemon:
-    registry = ModelRegistry(loader=lambda _d, _e: stub)
+    cfg = _cfg(**cfg_kw)
+    ladder = bucket_ladder(cfg.min_batch_bucket, cfg.max_batch)
+    registry = ModelRegistry(
+        loader=lambda _d, _e: stub,
+        warm_ladder=ladder if cfg.prewarm_ladder else None)
     registry.load("stub://", model_id="default")
-    return ScoringDaemon(registry=registry, config=_cfg(**cfg_kw))
+    return ScoringDaemon(registry=registry, config=cfg)
 
 
 # ------------------------------------------------------------- batcher
@@ -149,7 +153,7 @@ def test_adaptive_batching_coalesces_under_load():
     assert max(batch_sizes) > 1
 
 
-def test_padded_buckets_bound_static_shapes():
+def test_padded_buckets_bound_static_shapes(artifacts):
     """A static-shape engine only ever sees bucket-ladder batch sizes
     (the jit-cache bound), and padding never leaks into results."""
     stub = StubScorer(delay=0.02)
@@ -161,9 +165,34 @@ def test_padded_buckets_bound_static_shapes():
         results = [f.result(timeout=30) for f in futs]
     for i, r in enumerate(results):
         assert r[0] == pytest.approx(float(i))
-    ladder = set(bucket_ladder(8, 4096)) | {1}  # warm call is direct
+    rungs = bucket_ladder(8, 4096)
+    ladder = set(rungs)  # pre-warm covers rungs; no 1-row warm anymore
     for _t, rows in stub.calls:
         assert rows in ladder, f"non-bucket batch shape {rows}"
+    # the full-ladder pre-warm hits every rung exactly once, up front
+    warm = sorted(rows for _t, rows in stub.calls[:len(rungs)])
+    assert warm == sorted(rungs)
+
+    # On a real jit engine the pre-warm bounds the compile cache to
+    # exactly the ladder's executables: one compile per rung at load,
+    # zero compiles while serving traffic afterwards.
+    import os
+
+    from shifu_tpu.obs import introspect
+
+    dir_a, _ = artifacts
+    if not os.path.exists(os.path.join(dir_a, "scoring.jaxexport")):
+        pytest.skip("jax.export serialization unavailable")
+    cfg = _cfg(engine="jax", min_batch_bucket=8, max_batch=64,
+               latency_budget_ms=1.0)
+    before = introspect.stats().get("jax_scorer", {}).get("compiles", 0)
+    with ScoringDaemon(dir_a, config=cfg) as daemon:
+        loaded = introspect.stats().get("jax_scorer", {}).get("compiles", 0)
+        assert loaded - before == len(bucket_ladder(8, 64))
+        for i in range(23):
+            daemon.score(np.full(12, 0.1 * i, np.float32), timeout=30)
+    after = introspect.stats().get("jax_scorer", {}).get("compiles", 0)
+    assert after == loaded, "live traffic compiled outside the ladder"
 
 
 def test_padding_not_counted_as_scored_traffic(artifacts):
@@ -181,7 +210,9 @@ def test_padding_not_counted_as_scored_traffic(artifacts):
             daemon.score(np.zeros(12, np.float32), timeout=30)
     rows_total = obs.default_registry().counter(
         "score_rows_total").value(engine="stablehlo")
-    assert rows_total == 4  # warm call + 3 requests, no pad rows
+    # 3 requests only: the full-ladder pre-warm reports n_valid=0, so
+    # warm traffic (like pad rows) never counts as scored traffic.
+    assert rows_total == 3
 
 
 def test_daemon_matches_direct_scorer(artifacts):

@@ -355,6 +355,11 @@ def test_trace_diff_serving_mode_skip_and_regression(tmp_path, capsys):
         {"ts": 2.0, "seq": 2, "kind": "route_trace", "trace_id": "a" * 16,
          "hops": [{"ms": 1.0, "outcome": "ok"}], "hedged": False,
          "queue_ms": 0.2, "e2e_ms": 1.2, "outcome": "ok"},
+        {"ts": 3.0, "seq": 3, "kind": "cold_start", "engine": "aot",
+         "spawn_ms": 40.0, "promote_ms": 25.0, "live_compiles": 0},
+        # an engine leg the B side never drilled: must SKIP, not fail
+        {"ts": 3.5, "seq": 4, "kind": "cold_start", "engine": "jax",
+         "spawn_ms": 900.0, "promote_ms": 30.0, "live_compiles": 5},
     ])
     _write_journal(str(b / "journal.jsonl"), [
         {"ts": 1.0, "seq": 1, "kind": "loadtest_report", "p50_ms": 2.0,
@@ -362,6 +367,8 @@ def test_trace_diff_serving_mode_skip_and_regression(tmp_path, capsys):
          # a stage the A side never measured: must SKIP, not fail
          "stages": {"queue": {"mean_ms": 0.5},
                     "device": {"mean_ms": 0.4}}},
+        {"ts": 2.0, "seq": 2, "kind": "cold_start", "engine": "aot",
+         "spawn_ms": 44.0, "promote_ms": 26.0, "live_compiles": 0},
     ])
     rc = td.main([str(a), str(b), "--serving", "--json",
                   "--fail-above", "50"])
@@ -372,6 +379,11 @@ def test_trace_diff_serving_mode_skip_and_regression(tmp_path, capsys):
     assert rows["p99_ms"]["status"] == "OK"              # within 50%
     assert rows["stage.device.mean_ms"]["status"] == "SKIP"
     assert rows["route.hop_ms_mean"]["status"] == "SKIP"  # B has none
+    # the cold-start drill's per-engine legs (ISSUE 19): aot on both
+    # sides diffs (10% growth, within the gate); jax only on A SKIPs
+    assert rows["cold_start.aot.spawn_ms"]["status"] == "OK"
+    assert rows["cold_start.aot.promote_ms"]["status"] == "OK"
+    assert rows["cold_start.jax.spawn_ms"]["status"] == "SKIP"
     assert report["blamed"] == ["p50_ms"]
     # without the gate the same diff PASSES (axes informational)
     assert td.main([str(a), str(b), "--serving"]) == td.EXIT_PASS

@@ -3,7 +3,7 @@ BENCH baseline and exit nonzero on regression.
 
 The repo's first *enforceable* perf trajectory (ISSUE 3): every round the
 driver captures a `BENCH_r*.json`; this gate compares a freshly produced
-`bench_full.json` against the newest of those baselines on eleven axes —
+`bench_full.json` against the newest of those baselines on twelve axes —
 
 - **throughput / step time**: the headline resident-tier
   samples/sec/chip (`value`) must not fall below
@@ -69,6 +69,13 @@ driver captures a `BENCH_r*.json`; this gate compares a freshly produced
   (tunnel-drift-immune): a serialized router, a lost connection
   pool, or a head-of-line lock would collapse it toward 1/n while
   single-daemon capacity survives.
+- **serving cold-start**: `serving_cold_start_ms` (time-from-spawn to
+  the first healthy wire response on the AOT leg of bench.py's
+  `local:2` fleet drill, ISSUE 19) must not exceed `baseline *
+  --cold-start-factor` (default 3.0) — a lost AOT pack (fingerprint
+  drift, broken manifest, a disabled pre-warm) silently degrades the
+  leg to live jit compiles and multiplies the spawn-to-ready time,
+  while steady-state throughput axes never notice.
 
 The e2e ceiling axis additionally carries a ratchet FLOOR
 (`--e2e-ceiling-floor`, default 0.5): once a non-degraded baseline
@@ -175,7 +182,8 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
              sparse_floor: float = 1.0,
              ft_mfu_floor: float = 0.25,
              fleet_eff_floor: float = 0.6,
-             e2e_ceiling_floor: float = 0.5) -> dict:
+             e2e_ceiling_floor: float = 0.5,
+             cold_start_factor: float = 3.0) -> dict:
     """The comparison itself (pure — unit-tested on synthetic pairs).
     Returns {"checks": [...], "verdict": "PASS"|"REGRESSION"}."""
     checks: list[dict] = []
@@ -338,6 +346,23 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
         check("fleet_scaling_efficiency", ffe, bfe, ffe >= limit,
               round(limit, 4))
 
+    # serving cold-start: spawn-to-first-healthy-response on the AOT
+    # leg of bench.py's fleet drill (ISSUE 19).  Upper bound,
+    # factor-style like p99: the number is wall-clock on a shared host,
+    # so the wide factor catches the real failure — a silently lost AOT
+    # pack (fingerprint drift, a broken manifest) drops the leg back to
+    # live jit compiles and multiplies the time, while run-to-run
+    # deserialize noise stays inside the band.  SKIP when either side
+    # predates the drill.
+    fcs = _num(fresh, "serving_cold_start_ms")
+    bcs = _num(baseline, "serving_cold_start_ms")
+    if fcs is None or bcs is None or bcs <= 0:
+        check("serving_cold_start_ms", fcs, bcs, None, None)
+    else:
+        limit = bcs * cold_start_factor
+        check("serving_cold_start_ms", fcs, bcs, fcs <= limit,
+              round(limit, 2))
+
     regressed = [c for c in checks if c["status"] == "REGRESSION"]
     return {"checks": checks,
             "verdict": "REGRESSION" if regressed else "PASS"}
@@ -408,6 +433,11 @@ def main(argv=None) -> int:
                         "min(this, baseline) (the fleet's scores/s over "
                         "n_daemons x single-daemon capacity, ISSUE 12; "
                         "SKIP when either side lacks the field)")
+    p.add_argument("--cold-start-factor", type=float, default=3.0,
+                   help="fresh serving_cold_start_ms must be <= baseline * "
+                        "this factor (the AOT-packed fleet cold-start "
+                        "drill, ISSUE 19; SKIP when either side lacks the "
+                        "field)")
     p.add_argument("--e2e-ceiling-floor", type=float, default=0.5,
                    help="ratchet floor on e2e_cached_disk_fraction_of_"
                         "ceiling: a non-degraded baseline at/above this "
@@ -460,7 +490,8 @@ def main(argv=None) -> int:
                       sparse_floor=args.sparse_floor,
                       ft_mfu_floor=args.ft_mfu_floor,
                       fleet_eff_floor=args.fleet_eff_floor,
-                      e2e_ceiling_floor=args.e2e_ceiling_floor)
+                      e2e_ceiling_floor=args.e2e_ceiling_floor,
+                      cold_start_factor=args.cold_start_factor)
     report["fresh"] = args.fresh
     report["baseline"] = baseline_path
     _journal("perf_gate", verdict=report["verdict"],

@@ -20,9 +20,10 @@ device total did) — wire it after perf_gate when a round needs per-kernel
 accountability, not just a verdict.
 
 `--serving` diffs the serving plane instead of the device plane: each
-side's last `loadtest_report` (p50/p99/rate + per-stage means) and its
-`route_trace` aggregates (hedge rate, mean hop/queue/e2e) from the
-journal tail.  An axis absent on either side gets status SKIP, never a
+side's last `loadtest_report` (p50/p99/rate + per-stage means), its
+`route_trace` aggregates (hedge rate, mean hop/queue/e2e), and its
+`cold_start` drill results (per-engine spawn/promote-to-first-response,
+ISSUE 19 — the aot-vs-jit spread) from the journal tail.  An axis absent on either side gets status SKIP, never a
 verdict — perf_gate semantics: a journal predating the tracing layer
 must not fail the gate, it just can't vouch for the new axes.
 """
@@ -80,9 +81,10 @@ _HIGHER_IS_BETTER = frozenset(("achieved_scores_per_sec",))
 _UNGATED = frozenset(("route.count",))
 
 
-def _serving_axes(report: dict, routes: list) -> dict:
+def _serving_axes(report: dict, routes: list,
+                  cold_starts: list = ()) -> dict:
     """{axis: value} from one side's last loadtest_report + route_trace
-    events — the serving-plane analog of a kernel rollup."""
+    + cold_start events — the serving-plane analog of a kernel rollup."""
     axes: dict = {}
     for k in ("p50_ms", "p99_ms", "achieved_scores_per_sec"):
         v = report.get(k)
@@ -106,6 +108,17 @@ def _serving_axes(report: dict, routes: list) -> dict:
                     if isinstance(r.get(field), (int, float))]
             if vals:
                 axes[axis] = round(sum(vals) / len(vals), 4)
+    # fleet cold-start drill (ISSUE 19): the LAST cold_start event per
+    # engine wins — spawn/promote wall to the first healthy response.
+    # Latency-style axes (regress upward); the aot-vs-jit spread is the
+    # AOT pack's measured value on that host.
+    for ev in cold_starts:
+        eng = ev.get("engine")
+        if not isinstance(eng, str):
+            continue
+        for k in ("spawn_ms", "promote_ms"):
+            if isinstance(ev.get(k), (int, float)):
+                axes[f"cold_start.{eng}.{k}"] = float(ev[k])
     return axes
 
 
@@ -126,16 +139,19 @@ def load_serving_axes(path: str) -> dict:
     events, _n, _trunc = obs_render._load_events_tail(jpath)
     report: dict = {}
     routes: list = []
+    cold_starts: list = []
     for ev in events:
         if ev.get("kind") == "loadtest_report":
             report = ev
         elif ev.get("kind") == "route_trace":
             routes.append(ev)
-    axes = _serving_axes(report, routes)
+        elif ev.get("kind") == "cold_start":
+            cold_starts.append(ev)
+    axes = _serving_axes(report, routes, cold_starts)
     if not axes:
         raise ValueError(
-            f"{path}: no loadtest_report or route_trace events — run "
-            "`shifu-tpu loadtest` (or sample traces with "
+            f"{path}: no loadtest_report, route_trace or cold_start "
+            "events — run `shifu-tpu loadtest` (or sample traces with "
             "shifu.serving.trace-sample) first")
     return axes
 
