@@ -1326,6 +1326,111 @@ def main() -> None:
     except Exception:
         pass
 
+    phases.mark("pod_scaling")
+    try:
+        # pod data-plane scaling dryrun (ISSUE 20): per-host sharded
+        # ingest at n_hosts in {1, 2, 4}, each rank a real
+        # `shifu-tpu data-dryrun` child under the pod env contract
+        # (SHIFU_TPU_PROCESS_ID / SHIFU_TPU_NUM_PROCESSES) — the same
+        # shard formula, chaos probe, and `pod_epoch_close` journal rows
+        # the train loop and `shifu-tpu pod-verify` use.  Ranks run
+        # SEQUENTIALLY (this rig has 1 CPU core; concurrent ranks would
+        # measure the scheduler, not the plane) and the per-rank cost is
+        # the JOURNALED ingest wall (ingest_seconds_total inside the
+        # child), not process wall — which is dominated by interpreter
+        # + jax import.  Efficiency at width n = t1 / (n x slowest
+        # rank's ingest seconds): balanced shards -> ~1.0; a lopsided
+        # assignment or a per-host fixed ingest cost pulls it toward
+        # 1/n.  The recorded scalar is the MINIMUM across sweep widths
+        # (the conservative number tools/perf_gate.py ratchets with
+        # --train-eff-floor).
+        if _past_deadline(0.75):
+            extras["train_scaling_skipped"] = \
+                "soft deadline (SHIFU_TPU_BENCH_DEADLINE)"
+            raise _SkipTier()
+        import shutil
+        import subprocess
+        import sys as _sys
+        import tempfile
+
+        from shifu_tpu.data import synthetic as pd_syn
+        from shifu_tpu.obs import timeline as pd_timeline
+
+        pd_root = tempfile.mkdtemp(prefix="bench_pod_data_")
+        try:
+            pd_data = os.path.join(pd_root, "data")
+            os.makedirs(pd_data)
+            pd_schema = pd_syn.make_schema(num_features=num_features)
+            pd_syn.write_files(
+                pd_syn.make_rows(40_000, pd_schema, seed=11),
+                pd_data, num_files=8)
+            sweep = {}
+            for n in (1, 2, 4):
+                out_n = os.path.join(pd_root, f"out{n}")
+                for r in range(n):
+                    env = dict(os.environ,
+                               SHIFU_TPU_PROCESS_ID=str(r),
+                               SHIFU_TPU_NUM_PROCESSES=str(n),
+                               JAX_PLATFORMS="cpu")
+                    # mask the columnar cache + parent telemetry: the
+                    # sweep measures cold sharded parse, and each rank
+                    # journals into its own out_n sink
+                    env.pop("SHIFU_TPU_DATA_CACHE", None)
+                    env.pop("SHIFU_TPU_METRICS_DIR", None)
+                    proc = subprocess.run(
+                        [_sys.executable, "-m",
+                         "shifu_tpu.launcher.cli", "data-dryrun",
+                         "--data", pd_data, "--out", out_n,
+                         "--epochs", "1",
+                         "--features", str(num_features)],
+                        env=env, capture_output=True, timeout=300)
+                    if proc.returncode != 0:
+                        raise RuntimeError(
+                            f"data-dryrun rank {r}/{n} rc="
+                            f"{proc.returncode}: "
+                            f"{proc.stderr.decode()[-160:]}")
+                merged = pd_timeline.load_merged(out_n, tail_bytes=None)
+                closes = [e for e in (merged or {}).get("events", ())
+                          if e.get("kind") == "pod_epoch_close"
+                          and int(e.get("hosts") or 0) == n]
+                per_s, per_b = [], []
+                for r in range(n):
+                    mine = [e for e in closes
+                            if int(e.get("rank", -1)) == r]
+                    # counters are cumulative: the newest row's total is
+                    # the rank's whole-run ingest cost
+                    per_s.append(max(
+                        (float(e.get("ingest_s") or 0.0) for e in mine),
+                        default=0.0))
+                    per_b.append(max(
+                        (int(e.get("ingest_bytes") or 0) for e in mine),
+                        default=0))
+                sweep[n] = {"ingest_s": per_s, "ingest_bytes": per_b}
+            t1 = max(sweep[1]["ingest_s"], default=0.0)
+            effs = {}
+            for n in (2, 4):
+                tn = max(sweep[n]["ingest_s"], default=0.0)
+                if t1 > 0 and tn > 0:
+                    effs[n] = t1 / (n * tn)
+            if effs:
+                extras["train_scaling_efficiency"] = round(
+                    min(effs.values()), 4)
+                extras["train_scaling"] = {
+                    "hosts_swept": [1, 2, 4],
+                    "ingest_s_single": round(t1, 4),
+                    "efficiency_by_hosts": {
+                        str(n): round(v, 4) for n, v in effs.items()},
+                    "host_ingest_bytes_n4": sweep[4]["ingest_bytes"],
+                    "host_ingest_s_n4": [
+                        round(v, 4) for v in sweep[4]["ingest_s"]],
+                }
+        finally:
+            shutil.rmtree(pd_root, ignore_errors=True)
+    except _SkipTier:
+        pass
+    except Exception as e:
+        extras["train_scaling_error"] = str(e)[:200]
+
     phases.mark("e2e")
     try:
         # -- end-to-end from disk: the REAL product path ---------------------
@@ -1648,6 +1753,7 @@ _HEADLINE_OPTIONAL = (
     "serving_aot_pack",
     "fleet_scaling_efficiency",
     "fleet_scores_per_sec",
+    "train_scaling_efficiency",
     "parse_rows_per_sec",
     "per_batch_dispatch_samples_per_sec_per_chip",
     "device_hbm_peak_bytes",

@@ -192,6 +192,16 @@ class DataConfig:
     # device step synthesizing ones (bit-identical losses).  "elide" forces
     # (non-unit weights raise); "float32" disables.
     wire_weight_mode: str = "auto"
+    # pod-scale host shard assignment (data/pipeline.host_shard_assignment):
+    # how source files map onto hosts as a pure function of
+    # (process_index, process_count, seed, epoch).  "auto"/"static" = the
+    # fixed round-robin (i % num_hosts, the legacy scheme — stable across
+    # epochs, so per-host caches and out-of-core entries stay hot).
+    # "rotate" rotates the round-robin by a deterministic per-epoch offset
+    # (shard_rotation): across epochs every host visits every slice, and a
+    # host rejoining after an elastic reshape re-derives its slice from
+    # the same formula.  Epoch 0 is identical in all modes.
+    host_shard: str = "auto"
     # in-HBM format for the device-resident tier's feature blocks: "auto"
     # keeps the wire format (no silent precision change), "wire" says the
     # same explicitly, "int8" forces int8 residency — features quantize to
@@ -238,6 +248,10 @@ class DataConfig:
             raise ConfigError(
                 f"resident_format must be auto/wire/int8: "
                 f"{self.resident_format!r}")
+        if self.host_shard not in ("auto", "static", "rotate"):
+            raise ConfigError(
+                f"host_shard must be auto/static/rotate: "
+                f"{self.host_shard!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -436,10 +450,18 @@ class TrainConfig:
     # that dense optimizer traffic dominates; "on": require it (raise with
     # the specific blocker otherwise); "off": always dense.
     sparse_embedding_update: str = "auto"
+    # minimum acceptable train_scaling_efficiency for the pod data-plane
+    # scaling sweep (bench.py / tools/perf_gate.py 13th axis): achieved
+    # speedup over n_hosts divided by ideal.  0 disables the gate; the
+    # perf gate's own floor (0.6) still applies to recorded benchmarks.
+    scaling_gate: float = 0.6
 
     def validate(self) -> None:
         if self.epochs <= 0:
             raise ConfigError("epochs must be positive")
+        if not (0.0 <= self.scaling_gate <= 1.0):
+            raise ConfigError(
+                f"scaling_gate must be in [0, 1]: {self.scaling_gate}")
         if self.sparse_embedding_update not in ("auto", "on", "off"):
             raise ConfigError(
                 f"sparse_embedding_update must be auto/on/off: "

@@ -92,6 +92,19 @@ def _source_info(path: str):
     return st.st_size, st.st_mtime_ns, _sha1(os.path.abspath(path))[:16]
 
 
+def source_bytes(paths) -> int:
+    """Total on-disk bytes of the given source files (0 for any file the
+    filesystem can't stat) — the denominator of the pod data plane's
+    per-host ingest accounting: with N hosts each host's
+    `ingest_source_bytes_total` should approach source_bytes(all)/N."""
+    total = 0
+    for p in paths:
+        size, _mtime, _part = _source_info(p)
+        if size is not None:
+            total += int(size)
+    return total
+
+
 def cache_entry_name(path: str, delimiter: str,
                      version: Optional[int] = None) -> Optional[str]:
     """Deterministic cache file name for `path`'s current state, or None when

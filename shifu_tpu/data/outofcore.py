@@ -132,10 +132,15 @@ def load_datasets_out_of_core(
             "out-of-core datasets need a cache directory: set "
             "DataConfig.cache_dir or SHIFU_TPU_DATA_CACHE")
 
+    from .pipeline import host_shard_assignment  # shared pod shard formula
     paths: list[str] = []
     for p in data.paths:
         paths.extend(reader.list_data_files(p))
-    mine = [(i, p) for i, p in enumerate(paths) if i % num_hosts == host_index]
+    own = set(host_shard_assignment(
+        len(paths), host_index, num_hosts,
+        seed=data.shuffle_seed, epoch=0,
+        mode=getattr(data, "host_shard", "auto")))
+    mine = [(i, p) for i, p in enumerate(paths) if i in own]
 
     key = _entry_key(schema, data, mine, feature_dtype)
     entry_dir = os.path.join(

@@ -206,6 +206,7 @@ def _load_one_projected(item: tuple[int, str], schema: DataSchema,
                         parse_wall)
         rec["parse_s"] = round(parse_wall - inflate_s, 6)
         rec["inflate_s"] = round(inflate_s, 6)
+        rec["bytes"] = int(io_stats.get("source_bytes", 0))
         isec.inc(inflate_s, phase="inflate")
         isec.inc(parse_wall - inflate_s, phase="parse")
         obs.counter("ingest_source_bytes_total",
@@ -264,6 +265,8 @@ def _emit_ingest_report(stats: list, pool_width: int, wall_s: float,
             parse_s=round(sum(r["parse_s"] for r in files), 6),
             inflate_s=round(sum(r["inflate_s"] for r in files), 6),
             write_s=round(sum(r["write_s"] for r in files), 6),
+            source_bytes=int(sum(r.get("bytes", 0) for r in files)),
+            host_index=int(os.environ.get("SHIFU_TPU_PROCESS_ID", 0) or 0),
             tiers=tiers, per_file=per_file,
             per_file_truncated=len(files) > 32)
     except Exception:
@@ -319,19 +322,100 @@ def _pool_load_projected(mine: Sequence[tuple[int, str]], schema: DataSchema,
     return results, stats
 
 
+def shard_rotation(seed: int, epoch: int, num_hosts: int) -> int:
+    """Deterministic rotation offset of the host<->file-shard round-robin
+    for `epoch` — a pure function of (seed, epoch, num_hosts) so every
+    host (including one rejoining after an elastic reshape) derives the
+    same offset with no coordination.  Epoch 0 is pinned to 0: a cold
+    start is bit-identical to the legacy fixed round-robin, so cache and
+    out-of-core entry keys written before the rotating plane stay valid."""
+    if num_hosts <= 1 or epoch <= 0:
+        return 0
+    rng = np.random.default_rng(
+        np.random.PCG64([int(seed), int(epoch), int(num_hosts), 0x51A4D]))
+    return int(rng.integers(num_hosts))
+
+
+def host_shard_assignment(n_files: int, host_index: int, num_hosts: int,
+                          *, seed: int = 0, epoch: int = 0,
+                          mode: str = "static") -> list[int]:
+    """Global file indices host `host_index` owns for `epoch` — THE pure
+    shard-assignment function of the pod data plane (ISSUE 20): a function
+    of (process_index, process_count, seed, epoch) and nothing else.  Each
+    host reads/decompresses/projects only its ~1/N slice of the source
+    bytes; after an elastic reshape the surviving hosts re-derive the
+    assignment from the new NUM_PROCESSES at the next epoch boundary, and
+    a rejoining host picks its slice back up from the same formula.
+
+    mode "static" (and "auto"): the fixed round-robin `i % num_hosts` —
+    the legacy scheme, unchanged across epochs.
+    mode "rotate": the round-robin rotated by `shard_rotation(seed, epoch,
+    num_hosts)` — across epochs every host visits every slice (page-cache
+    diversity after a reshape) while epoch 0 stays identical to "static".
+
+    Either way the assignment is a PARTITION: every file owned by exactly
+    one host, global file INDICES preserved (row ids `(file_idx << 40) +
+    row` and the train/valid split keyed on them never depend on which
+    host reads a file)."""
+    if num_hosts <= 1:
+        return list(range(n_files))
+    r = (shard_rotation(seed, epoch, num_hosts)
+         if mode == "rotate" else 0)
+    return [i for i in range(n_files)
+            if (i + r) % num_hosts == host_index]
+
+
+def shard_assignment_digest(n_files: int, num_hosts: int, *, seed: int = 0,
+                            epoch: int = 0, mode: str = "static") -> str:
+    """Digest of the COMPLETE global file->host assignment for `epoch` —
+    identical on every host iff the gang agrees on (n_files, num_hosts,
+    seed, epoch, mode).  Journaled per epoch (host_skew row / the
+    data-dryrun's shard_assign event) and compared by `pod-verify`: a host
+    that desynced its shard view (stale file listing, wrong contract env)
+    shows up as a digest split instead of silently double- or un-reading
+    files."""
+    import hashlib
+    h = hashlib.blake2b(digest_size=16)
+    h.update(f"{n_files}:{num_hosts}:{seed}:{epoch}:{mode}".encode())
+    for host in range(num_hosts):
+        idx = host_shard_assignment(n_files, host, num_hosts, seed=seed,
+                                    epoch=epoch, mode=mode)
+        h.update(np.asarray(idx, np.int64).tobytes())
+        h.update(b";")
+    return h.hexdigest()
+
+
+def count_source_files(data: DataConfig) -> int:
+    """Number of source data files the config resolves to — the `n_files`
+    input of host_shard_assignment / shard_assignment_digest."""
+    n = 0
+    for p in data.paths:
+        n += len(reader.list_data_files(p))
+    return n
+
+
 def host_file_shard(data: DataConfig, host_index: int = 0,
-                    num_hosts: int = 1) -> list[tuple[int, str]]:
+                    num_hosts: int = 1, *,
+                    epoch: int = 0) -> list[tuple[int, str]]:
     """This host's (global file idx, path) list: paths expanded in config
-    order and round-robined by GLOBAL index (successor of
-    yarn/appmaster/TrainingDataSet.java:65-82).  The ONE source of the
-    shard scheme — load_datasets, StreamingLoader, and the cache-hot probe
-    must agree, or row ids (and the train/valid split keyed on them) would
-    diverge across entry points."""
+    order and assigned by GLOBAL index through `host_shard_assignment`
+    (successor of yarn/appmaster/TrainingDataSet.java:65-82).  The ONE
+    source of the shard scheme — load_datasets, StreamingLoader, the
+    out-of-core build, and the cache-hot probe must agree, or row ids (and
+    the train/valid split keyed on them) would diverge across entry
+    points.  Chaos site `data.host_shard` probes here: the elastic
+    training drill kills one host exactly where its slice is derived."""
+    from .. import chaos
+    chaos.maybe_fail("data.host_shard", epoch=epoch)
     paths: list[str] = []
     for p in data.paths:
         paths.extend(reader.list_data_files(p))
-    return [(i, p) for i, p in enumerate(paths)
-            if i % num_hosts == host_index]
+    own = host_shard_assignment(
+        len(paths), host_index, num_hosts,
+        seed=data.shuffle_seed, epoch=epoch,
+        mode=getattr(data, "host_shard", "auto"))
+    own_set = set(own)
+    return [(i, p) for i, p in enumerate(paths) if i in own_set]
 
 
 def load_datasets(
@@ -1052,6 +1136,41 @@ def epoch_order_digest(tier: str, num_rows: int, batch_size: int, *,
     else:
         return None  # "stream" and unknown tiers: no (seed, epoch) order
     return hashlib.blake2b(payload.tobytes(), digest_size=16).hexdigest()
+
+
+def interleaved_epoch_order(host_row_ids: Sequence[np.ndarray],
+                            local_batch_size: int, *,
+                            shuffle: bool = True, seed: int = 0,
+                            epoch: int = 0) -> np.ndarray:
+    """The pod data plane's deterministic global batch order, as row ids.
+
+    Global batch `b` of `epoch` is the rank-order concatenation of every
+    host's rows `local_perm[b*lbs : (b+1)*lbs]`, where `local_perm` is the
+    SAME `epoch_permutation(min_rows, ...)` stream on every host (same
+    (min_rows, seed, epoch) on each rank — exactly what the cross-host
+    order-digest agreement in the `host_skew` row pins).  A single process
+    emulating N shards through this function therefore reproduces a real
+    N-host run's global order bit-for-bit — the loss/AUC-identity contract
+    of the sharded ingest plane (tests/test_pod_data_plane.py).
+
+    `host_row_ids[h]` holds host h's global row ids in its local storage
+    order; rows past `min_rows` (imbalanced shards) and the batch-tail
+    remainder are dropped, matching the train loop's min-host-rows
+    agreement and drop-remainder semantics.  Returns a flat (steps *
+    n_hosts * lbs,) id array; reshape to (steps, n_hosts, lbs) for
+    per-batch views."""
+    if not host_row_ids:
+        return np.zeros((0,), np.int64)
+    min_rows = min(len(r) for r in host_row_ids)
+    steps = min_rows // local_batch_size
+    if steps == 0:
+        return np.zeros((0,), np.int64)
+    perm = epoch_permutation(min_rows, shuffle=shuffle, seed=seed,
+                             epoch=epoch)
+    take = perm[: steps * local_batch_size]
+    cols = [np.asarray(r, np.int64)[take].reshape(steps, local_batch_size)
+            for r in host_row_ids]
+    return np.stack(cols, axis=1).reshape(-1)
 
 
 class _DepthGate:

@@ -334,6 +334,50 @@ def build_parser() -> argparse.ArgumentParser:
     fv.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
 
+    pdv = sub.add_parser(
+        "pod-verify", help="audit a pod training run's per-rank journals: "
+                           "every epoch closed by a complete agreeing "
+                           "cohort (order + shard digests), per-host "
+                           "ingest stayed balanced, and every injected "
+                           "host kill was followed by recovery (the "
+                           "fleet-verify analog for the training gang, "
+                           "docs/DATA.md 'Multi-host data plane')")
+    pdv.add_argument("job_dir", help="pod job/telemetry dir (per-rank "
+                                     "journals are discovered one level "
+                                     "below the root journal)")
+    pdv.add_argument("--json", action="store_true",
+                     help="machine-readable report on stdout")
+    pdv.add_argument("--balance-limit", type=float, default=1.5,
+                     help="max per-rank ingest bytes as a multiple of the "
+                          "even share (default 1.5)")
+
+    dd = sub.add_parser(
+        "data-dryrun", help="pod data-plane dryrun rank: shard-local "
+                            "ingest, per-epoch order/shard digests "
+                            "journaled per rank, no device training — "
+                            "the gang child the elastic recovery drill "
+                            "and the bench scaling sweep dispatch under "
+                            "`supervise_pod` (docs/DATA.md)")
+    dd.add_argument("--data", required=True,
+                    help="directory (or file) of delimited part files; "
+                         "layout [target, f0..fN-1]")
+    dd.add_argument("--out", required=True, help="job dir for per-rank "
+                                                 "telemetry + progress")
+    dd.add_argument("--features", type=int, default=8,
+                    help="numeric feature count in the files (default 8)")
+    dd.add_argument("--epochs", type=int, default=3)
+    dd.add_argument("--batch-size", type=int, default=32)
+    dd.add_argument("--delimiter", default="|")
+    dd.add_argument("--seed", type=int, default=0,
+                    help="shuffle seed pinning permutations and digests")
+    dd.add_argument("--host-shard", default="auto",
+                    choices=["auto", "static", "rotate"],
+                    help="shard-assignment mode "
+                         "(data/pipeline.host_shard_assignment)")
+    dd.add_argument("--epoch-seconds", type=float, default=0.0,
+                    help="simulated per-epoch wall (sleep) so kill/"
+                         "liveness windows have something to land in")
+
     dr = sub.add_parser(
         "drift", help="model-quality / data-drift panel for a serving "
                       "daemon: per-feature PSI vs the frozen baseline "
@@ -1537,6 +1581,146 @@ def run_fleet_verify(args) -> int:
     return EXIT_OK if report["verdict"] == "PASS" else EXIT_FAIL
 
 
+def run_pod_verify(args) -> int:
+    """`shifu-tpu pod-verify <dir>`: audit a pod training run's merged
+    per-rank journals against the pod data-plane invariants
+    (launcher/pod.pod_verify_events — epoch coverage by complete cohorts,
+    cross-host order/shard digest agreement, ingest balance, recovery
+    after injected kills).  Exit 0 = every check holds."""
+    from ..obs import timeline as timeline_mod
+    from .pod import pod_verify_events
+
+    merged = timeline_mod.load_merged(args.job_dir, tail_bytes=None)
+    if merged is None:
+        print(f"no telemetry journal found under {args.job_dir}",
+              file=sys.stderr, flush=True)
+        return EXIT_FAIL
+    report = pod_verify_events(merged["events"],
+                               balance_limit=args.balance_limit)
+    report["journals"] = merged["journals"]
+    if getattr(args, "json", False):
+        print(json.dumps(report))
+    else:
+        counts = report["counts"]
+        print(f"pod-verify: {report['verdict']} — "
+              f"{counts['epochs']} epoch(s), "
+              f"{counts['close_rows']} close row(s) from "
+              f"{counts['ranks']} rank(s), "
+              f"{counts['injections']} injection(s)")
+        for c in report["checks"]:
+            mark = "ok " if c["ok"] else "FAIL"
+            print(f"  [{mark}] {c['check']}: {c['detail']}")
+    return EXIT_OK if report["verdict"] == "PASS" else EXIT_FAIL
+
+
+def _dryrun_progress_start(prog_dir: str, num_hosts: int) -> int:
+    """First epoch this attempt should run: min completed epoch across the
+    CURRENT gang's ranks + 1 (a rank file missing → that rank completed
+    nothing → start at 0).  The gang-wide min makes a restart re-run any
+    epoch a killed rank never closed, so the journal always ends with a
+    complete per-epoch cohort — rank-local resume would let the survivors'
+    head start leave holes `pod-verify` flags."""
+    start = None
+    for rank in range(num_hosts):
+        p = os.path.join(prog_dir, f"rank-{rank}.json")
+        try:
+            with open(p) as f:
+                done = int(json.load(f).get("epoch", -1))
+        except (OSError, ValueError):
+            done = -1
+        start = done if start is None else min(start, done)
+    return (start if start is not None else -1) + 1
+
+
+def _dryrun_progress_mark(prog_dir: str, rank: int, epoch: int) -> None:
+    os.makedirs(prog_dir, exist_ok=True)
+    tmp = os.path.join(prog_dir, f".rank-{rank}.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"epoch": int(epoch)}, f)
+    os.replace(tmp, os.path.join(prog_dir, f"rank-{rank}.json"))
+
+
+def run_data_dryrun(args) -> int:
+    """`shifu-tpu data-dryrun`: one pod data-plane rank — shard-local
+    ingest of this host's slice, per-epoch order/shard digests, one
+    `pod_epoch_close` journal row per epoch — with NO device training and
+    NO cross-process collectives, so it runs on any backend (the CPU
+    backend cannot run multi-process collectives; the data plane is pure
+    host work and needs none).  Rank identity comes from the pod env
+    contract (SHIFU_TPU_PROCESS_ID / SHIFU_TPU_NUM_PROCESSES) that
+    `supervise_pod` re-derives each attempt, so an elastic reshape
+    rebalances the shard assignment automatically.  Every digest is a pure
+    function of (seed, epoch, gang width), and the drill dataset's equal
+    part files give every rank the same local row count — so the journaled
+    cohorts must agree, which is exactly what `pod-verify` audits."""
+    from .. import chaos
+    from .. import obs
+    from ..config.schema import DataConfig
+    from ..data import pipeline as pipe
+    from ..data import synthetic
+
+    try:
+        rank = int(os.environ.get("SHIFU_TPU_PROCESS_ID", "0") or 0)
+        nproc = int(os.environ.get("SHIFU_TPU_NUM_PROCESSES", "1") or 1)
+    except ValueError:
+        rank, nproc = 0, 1
+    chaos.reload_from_env()
+    out = args.out
+    tele = (os.path.join(out, "telemetry") if rank == 0
+            else os.path.join(out, "telemetry", f"rank-{rank}"))
+    from ..obs import _sinks
+    _sinks.configure(tele)
+    schema = synthetic.make_schema(num_features=args.features)
+    # valid_ratio=0: the drill's agreement contract needs every rank's
+    # LOCAL train-row count equal (no allgathered min without
+    # collectives), and the hash split would skew counts per shard
+    data = DataConfig(paths=(args.data,), delimiter=args.delimiter,
+                      batch_size=int(args.batch_size), valid_ratio=0.0,
+                      shuffle_seed=int(args.seed),
+                      host_shard=args.host_shard)
+    data.validate()
+    prog_dir = os.path.join(out, "data_progress")
+    start = _dryrun_progress_start(prog_dir, nproc)
+    obs.event("pod_data_dryrun_start", rank=rank, hosts=nproc,
+              epoch_start=start, epochs=int(args.epochs),
+              host_shard=args.host_shard)
+    n_files = pipe.count_source_files(data)
+    reg = obs.default_registry()
+    train_rows = None
+    for ep in range(start, int(args.epochs)):
+        # fires the `data.host_shard` chaos probe with epoch context —
+        # the elastic drill's kill lands here, mid-epoch
+        mine = pipe.host_file_shard(data, rank, nproc, epoch=ep)
+        if train_rows is None:
+            train_ds, _valid_ds = pipe.load_datasets(schema, data, rank,
+                                                     nproc)
+            train_rows = int(train_ds.num_rows)
+        if args.epoch_seconds > 0:
+            time.sleep(float(args.epoch_seconds))
+        order_digest = pipe.epoch_order_digest(
+            "batch", train_rows, int(args.batch_size), shuffle=True,
+            seed=int(args.seed), epoch=ep)
+        shard_digest = pipe.shard_assignment_digest(
+            n_files, nproc, seed=int(args.seed), epoch=ep,
+            mode=args.host_shard)
+        obs.event(
+            "pod_epoch_close", epoch=ep, rank=rank, hosts=nproc,
+            files=len(mine), rows=train_rows,
+            order_digest=order_digest, shard_digest=shard_digest,
+            ingest_bytes=int(
+                reg.counter("ingest_source_bytes_total").total()),
+            ingest_s=round(
+                reg.counter("ingest_seconds_total").total(), 6))
+        obs.flush()
+        _dryrun_progress_mark(prog_dir, rank, ep)
+        print(f"data-dryrun rank {rank}/{nproc}: epoch {ep} "
+              f"files={len(mine)} rows={train_rows}", flush=True)
+    obs.event("pod_data_dryrun_done", rank=rank, hosts=nproc,
+              epochs=int(args.epochs))
+    obs.flush()
+    return EXIT_OK
+
+
 def run_timeline(args) -> int:
     """`shifu-tpu timeline <dir>`: the skew-corrected causal fleet
     timeline (obs/timeline.py) — merged member journals, incident
@@ -2171,6 +2355,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.command == "fleet-verify":
         # likewise journal reads only — no jax import
         return run_fleet_verify(args)
+    if args.command == "pod-verify":
+        # likewise journal reads only — no jax import
+        return run_pod_verify(args)
+    if args.command == "data-dryrun":
+        # host-side ingest only — no device work, no collectives
+        return run_data_dryrun(args)
     if args.command == "timeline":
         # likewise journal reads only — no jax import
         return run_timeline(args)

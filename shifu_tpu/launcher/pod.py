@@ -485,3 +485,157 @@ def supervise_pod(spec: PodSpec, child_args: Sequence[str], out_dir: str,
             echo(f"pod: restart budget exhausted ({max_restarts} restarts "
                  "without progress)")
             return rc if isinstance(rc, int) and rc > 0 else 1
+
+
+# -- pod data-plane journal audit -------------------------------------------
+
+
+def _pod_close_rows(events: Sequence[dict]) -> list[dict]:
+    """Normalize per-epoch close records out of a merged event stream:
+    `pod_epoch_close` rows (one per rank per epoch — the data-dryrun gang
+    child journals them) plus the per-host rows embedded in each chief
+    `host_skew` event (real multihost training runs).  Each normalized row:
+    {epoch, rank, hosts, order_digest, shard_digest, ingest_bytes,
+    ingest_s}."""
+    rows: list[dict] = []
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "pod_epoch_close":
+            rows.append({
+                "epoch": ev.get("epoch"), "rank": ev.get("rank"),
+                "hosts": ev.get("hosts"),
+                "order_digest": ev.get("order_digest"),
+                "shard_digest": ev.get("shard_digest"),
+                "ingest_bytes": ev.get("ingest_bytes"),
+                "ingest_s": ev.get("ingest_s"),
+            })
+        elif kind == "host_skew":
+            members = ev.get("hosts") or []
+            for r in members:
+                if not isinstance(r, dict):
+                    continue
+                rows.append({
+                    "epoch": ev.get("epoch"), "rank": r.get("rank"),
+                    "hosts": len(members),
+                    "order_digest": r.get("order_digest"),
+                    "shard_digest": r.get("shard_digest"),
+                    "ingest_bytes": r.get("ingest_bytes"),
+                    "ingest_s": r.get("ingest_s"),
+                })
+    return [r for r in rows
+            if isinstance(r["epoch"], int) and isinstance(r["rank"], int)]
+
+
+def pod_verify_events(events: Sequence[dict],
+                      balance_limit: float = 1.5) -> dict:
+    """Audit a pod training run's merged journals (obs/timeline.load_merged:
+    root journal + one per-rank journal) against the pod data-plane
+    invariants — the fleet-verify analog for the training gang.
+
+    Checks:
+    - epoch_coverage: every epoch up to the max observed was closed by a
+      COMPLETE cohort — some gang width n whose ranks 0..n-1 all journaled
+      a close row for it.  A killed attempt's partial rows are fine; an
+      elastic reshape's narrower cohort is fine; an epoch NO cohort ever
+      completed is not.
+    - order_digest_agreement / shard_digest_agreement: inside every
+      complete cohort all ranks carry the identical digest (the allgather-
+      of-digests contract; rows without the field are skipped, so
+      pre-field journals stay un-audited rather than failing).
+    - ingest_balance: max/min cumulative per-rank source bytes at the last
+      epoch <= balance_limit x the even share (only when >= 2 ranks
+      ingested anything).
+    - recovery: every injected `exit`/`raise` chaos fault is followed by a
+      later (or same-epoch, re-run) complete cohort — the gang rebalanced
+      / the host rejoined and the run still closed its epochs.
+    """
+    rows = _pod_close_rows(events)
+    injections = [ev for ev in events
+                  if ev.get("kind") == "chaos_inject"
+                  and ev.get("action") in ("exit", "raise", "hang")]
+    checks: list[dict] = []
+
+    def check(name: str, ok: bool, detail: str) -> None:
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    by_epoch: dict[int, list[dict]] = {}
+    for r in rows:
+        by_epoch.setdefault(int(r["epoch"]), []).append(r)
+
+    def complete_cohorts(epoch_rows: list[dict]) -> list[list[dict]]:
+        """Groups by gang width whose ranks cover 0..n-1 (newest row per
+        (width, rank) wins — a rank re-running an epoch after a restart
+        supersedes its earlier row)."""
+        by_width: dict[int, dict[int, dict]] = {}
+        for r in epoch_rows:
+            n = r.get("hosts")
+            if isinstance(n, int) and n > 0:
+                by_width.setdefault(n, {})[int(r["rank"])] = r
+        return [list(ranks.values())
+                for n, ranks in sorted(by_width.items())
+                if set(ranks) == set(range(n))]
+
+    epochs = sorted(by_epoch)
+    missing = []
+    disagree_order: list[int] = []
+    disagree_shard: list[int] = []
+    for ep in (range(epochs[-1] + 1) if epochs else ()):
+        cohorts = complete_cohorts(by_epoch.get(ep, []))
+        if not cohorts:
+            missing.append(ep)
+            continue
+        for cohort in cohorts:
+            for key, sink in (("order_digest", disagree_order),
+                              ("shard_digest", disagree_shard)):
+                vals = {r[key] for r in cohort if r.get(key) is not None}
+                if len(vals) > 1:
+                    sink.append(ep)
+    n_epochs = (epochs[-1] + 1) if epochs else 0
+    check("epoch_coverage", not missing and n_epochs > 0,
+          f"{n_epochs - len(missing)}/{n_epochs} epochs closed by a "
+          f"complete cohort" + (f"; missing {missing}" if missing else ""))
+    check("order_digest_agreement", not disagree_order,
+          "all complete cohorts agree" if not disagree_order
+          else f"disagreement at epochs {sorted(set(disagree_order))}")
+    check("shard_digest_agreement", not disagree_shard,
+          "all complete cohorts agree" if not disagree_shard
+          else f"disagreement at epochs {sorted(set(disagree_shard))}")
+
+    # cumulative ingest bytes at each rank's LAST row (counters are
+    # monotonic within an attempt; the last row is the attempt's total)
+    last_by_rank: dict[int, int] = {}
+    for r in sorted(rows, key=lambda r: (r["epoch"])):
+        if isinstance(r.get("ingest_bytes"), (int, float)):
+            last_by_rank[int(r["rank"])] = int(r["ingest_bytes"])
+    loads = [b for b in last_by_rank.values() if b > 0]
+    if len(loads) >= 2:
+        share = sum(loads) / len(loads)
+        worst = max(loads)
+        ok = worst <= share * balance_limit
+        check("ingest_balance", ok,
+              f"max {worst} bytes vs even share {share:.0f} "
+              f"(limit x{balance_limit:g}) across {len(loads)} ranks")
+    else:
+        check("ingest_balance", True,
+              "fewer than 2 ranks recorded ingest bytes — skipped")
+    if injections:
+        last_inj_epoch = max(int(ev.get("epoch") or 0) for ev in injections)
+        recovered = any(
+            ep >= last_inj_epoch and complete_cohorts(by_epoch.get(ep, []))
+            for ep in epochs)
+        check("recovery", recovered,
+              f"{len(injections)} injected kill(s), last at epoch "
+              f"{last_inj_epoch}; "
+              + ("a complete cohort closed at/after it"
+                 if recovered else "no complete cohort after it"))
+    verdict = "PASS" if all(c["ok"] for c in checks) else "FAIL"
+    return {
+        "verdict": verdict,
+        "checks": checks,
+        "counts": {
+            "epochs": n_epochs,
+            "close_rows": len(rows),
+            "ranks": len({r["rank"] for r in rows}),
+            "injections": len(injections),
+        },
+    }

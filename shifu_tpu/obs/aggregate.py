@@ -83,23 +83,50 @@ def skew_line(epoch: int, rows: list[dict],
     operator read the reference AM printed, under SPMD semantics (input
     seconds are the per-host-attributable cost; epoch wall converges)."""
     ordered = sorted(rows, key=lambda r: -float(r.get(sort_key, 0.0)))
-    parts = [
-        (f"{r.get('host', '?')}[{r.get('rank', '?')}] "
-         f"input {float(r.get('input_s', 0.0)):.2f}s "
-         f"(epoch {float(r.get('epoch_s', 0.0)):.2f}s, "
-         f"valid {float(r.get('valid_s', 0.0)):.2f}s)")
-        for r in ordered]
+
+    def _one(r: dict) -> str:
+        s = (f"{r.get('host', '?')}[{r.get('rank', '?')}] "
+             f"input {float(r.get('input_s', 0.0)):.2f}s "
+             f"(epoch {float(r.get('epoch_s', 0.0)):.2f}s, "
+             f"valid {float(r.get('valid_s', 0.0)):.2f}s)")
+        if r.get("ingest_bytes") is not None:
+            # pod data plane: cumulative source ingest per host — a host
+            # rereading more than its ~1/N slice, or grinding on a slow
+            # disk, is named right here in the straggler line
+            s += (f" ingest {float(r['ingest_bytes']) / 1e6:.1f}MB"
+                  f"/{float(r.get('ingest_s', 0.0)):.1f}s")
+        return s
+
     return (f"Epoch {epoch} hosts by input time (slowest first): "
-            + " | ".join(parts))
+            + " | ".join(_one(r) for r in ordered))
+
+
+def digest_agreement(rows: list[dict], key: str) -> Optional[bool]:
+    """Do all hosts agree on digest `key`?  None when NO row carries the
+    field (pre-field journals stay un-audited, not failing); False when
+    any host disagrees or is missing it while others have it."""
+    values = [r.get(key) for r in rows]
+    present = [v for v in values if v is not None]
+    if not present:
+        return None
+    return len(present) == len(values) and len(set(present)) == 1
 
 
 def epoch_skew(epoch: int, input_seconds: float, epoch_seconds: float,
                valid_seconds: float, console=None,
-               journal: bool = True) -> Optional[list[dict]]:
+               journal: bool = True,
+               extra: Optional[dict] = None) -> Optional[list[dict]]:
     """The per-epoch cross-host skew: gather every host's summary, print
     the slowest-first line on the chief, journal a `host_skew` event.
     COLLECTIVE under multihost (every rank must call); returns the rows on
-    the chief, None elsewhere."""
+    the chief, None elsewhere.
+
+    Caller `extra` fields (pod data plane: ingest_bytes / ingest_s /
+    order_digest / shard_digest) ride each host's row through the ONE
+    allgather; when the digests are present the chief also journals
+    per-epoch cross-host agreement (`order_digest_agree` /
+    `shard_digest_agree`) in the host_skew row — the allgather-of-digests
+    close that `pod-verify` audits."""
     import jax
 
     if jax.process_count() <= 1:
@@ -107,24 +134,83 @@ def epoch_skew(epoch: int, input_seconds: float, epoch_seconds: float,
     # per-host HBM high water rides the same gather: a host leaking
     # device memory shows up as a named outlier in the skew table, the
     # multihost complement of the chief-local hbm_watermark event
-    extra = {}
+    fields = dict(extra or {})
     try:
         from . import devprof
         snap = devprof.hbm_snapshot()
         if snap.get("peak_bytes"):
-            extra["hbm_peak_bytes"] = int(snap["peak_bytes"])
+            fields["hbm_peak_bytes"] = int(snap["peak_bytes"])
     except Exception:
         pass
     rows = gather_host_summaries(host_summary(
-        input_seconds, epoch_seconds, valid_seconds, **extra))
+        input_seconds, epoch_seconds, valid_seconds, **fields))
     if jax.process_index() != 0:
         return None
     if console is not None:
         console(skew_line(epoch, rows))
     if journal:
         from . import _sinks
-        _sinks.event("host_skew", epoch=epoch, hosts=rows)
+        _sinks.event("host_skew", epoch=epoch, hosts=rows,
+                     order_digest_agree=digest_agreement(
+                         rows, "order_digest"),
+                     shard_digest_agree=digest_agreement(
+                         rows, "shard_digest"))
     return rows
+
+
+def pod_ingest_rollup(events: list) -> dict:
+    """Fold a pod run's merged journal events (obs/timeline.load_merged —
+    one journal per rank) into the per-host ingest ledger: source bytes
+    and ingest seconds per host, plus pod totals and the max/min byte
+    imbalance.  Pure event fold — no jax, no collectives; the training
+    plane's sibling of `serving_rollup`.
+
+    Per-host identity: the event's `host` stamp when journals carry one,
+    else the merge's `src` index (rank order for per-rank pod journals).
+    Sources folded, newest-wins per host: `ingest_report` rows (per-phase
+    seconds summed), `host_skew` rows' cumulative ingest extras, and
+    dryrun `ingest_source_bytes_total` stamps."""
+    hosts: dict = {}
+
+    def slot(key) -> dict:
+        return hosts.setdefault(str(key), {
+            "ingest_bytes": 0, "ingest_s": 0.0, "files": 0, "reports": 0})
+
+    for ev in events:
+        kind = ev.get("kind")
+        key = ev.get("host") or f"rank{ev.get('src', 0)}"
+        if kind == "ingest_report":
+            s = slot(key)
+            s["reports"] += 1
+            s["files"] += int(ev.get("files") or 0)
+            s["ingest_s"] += sum(
+                float(ev.get(k) or 0.0)
+                for k in ("parse_s", "inflate_s", "write_s"))
+            if ev.get("source_bytes") is not None:
+                s["ingest_bytes"] += int(ev["source_bytes"])
+        elif kind == "host_skew":
+            # cumulative per-host counters gathered at epoch close:
+            # newest event wins (totals, not deltas)
+            for r in ev.get("hosts") or []:
+                if r.get("ingest_bytes") is None:
+                    continue
+                s = slot(r.get("host") or f"rank{r.get('rank', 0)}")
+                s["ingest_bytes"] = int(r["ingest_bytes"])
+                s["ingest_s"] = float(r.get("ingest_s") or 0.0)
+    total_b = sum(h["ingest_bytes"] for h in hosts.values())
+    loads = [h["ingest_bytes"] for h in hosts.values()
+             if h["ingest_bytes"] > 0]
+    return {
+        "hosts": {k: hosts[k] for k in sorted(hosts)},
+        "pod": {
+            "hosts": len(hosts),
+            "ingest_bytes_total": total_b,
+            "ingest_s_total": round(
+                sum(h["ingest_s"] for h in hosts.values()), 3),
+            "imbalance": (round(max(loads) / max(min(loads), 1), 3)
+                          if loads else None),
+        },
+    }
 
 
 # -- multi-daemon serving rollup (pod scale-out prep) ------------------------

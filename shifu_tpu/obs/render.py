@@ -279,6 +279,12 @@ def profile_summary(path: str) -> Optional[dict]:
     aot_fallbacks: list[dict] = []
     aot_packs: list[dict] = []
     prewarm_last: Optional[dict] = None
+    skew_last: Optional[dict] = None
+    skew_count = 0
+    digest_disagreements = 0
+    dcn_last: Optional[dict] = None
+    dcn_saved_b = 0
+    dcn_sync_saved_b = 0
     recovery = {"restore_s": 0.0, "restores": 0, "fallbacks": 0,
                 "cache_fallbacks": 0, "preemption_graces": 0, "resumes": 0}
     for rec in events:
@@ -325,7 +331,7 @@ def profile_summary(path: str) -> Optional[dict]:
             ingests.append({k: rec.get(k) for k in
                             ("mode", "files", "pool_width", "wall_s",
                              "rows", "parse_s", "inflate_s", "write_s",
-                             "tiers")})
+                             "source_bytes", "host_index", "tiers")})
         elif kind == "checkpoint_fallback":
             recovery["fallbacks"] += 1
         elif kind == "cache_fallback":
@@ -361,6 +367,21 @@ def profile_summary(path: str) -> Optional[dict]:
             aot_packs.append(rec)
         elif kind == "model_prewarm":
             prewarm_last = rec
+        elif kind == "host_skew":
+            skew_last = rec
+            skew_count += 1
+            if rec.get("order_digest_agree") is False:
+                digest_disagreements += 1
+            if rec.get("shard_digest_agree") is False:
+                digest_disagreements += 1
+        elif kind == "dcn_placement":
+            dcn_last = rec
+            try:
+                dcn_saved_b += int(rec.get("input_dcn_saved_bytes") or 0)
+                dcn_sync_saved_b += int(
+                    rec.get("dcn_sync_saved_bytes") or 0)
+            except (TypeError, ValueError):
+                pass
 
     totals: dict[str, float] = {}
     fracs, mfus = [], []
@@ -463,6 +484,26 @@ def profile_summary(path: str) -> Optional[dict]:
         aot["prewarm"] = {k: prewarm_last.get(k) for k in
                           ("engine", "buckets", "wall_ms")}
     out["aot"] = aot or None
+    # pod data plane rollup (docs/DATA.md "Multi-host data plane"): the
+    # last epoch's per-host skew table (with its ingest bytes/seconds
+    # extras), whether the cross-host digest agreement ever broke, and the
+    # DCN placement ledger's cumulative savings
+    pod: dict = {}
+    if skew_last is not None:
+        pod["skew_epochs"] = skew_count
+        pod["last_epoch"] = skew_last.get("epoch")
+        pod["hosts"] = skew_last.get("hosts")
+        pod["order_digest_agree"] = skew_last.get("order_digest_agree")
+        pod["shard_digest_agree"] = skew_last.get("shard_digest_agree")
+        pod["digest_disagreements"] = digest_disagreements
+    if dcn_last is not None:
+        pod["dcn"] = {k: dcn_last.get(k) for k in
+                      ("epoch", "tier", "hosts", "slices",
+                       "input_local_bytes", "input_dcn_bytes",
+                       "local_sgd_window")}
+        pod["dcn"]["input_dcn_saved_bytes_total"] = dcn_saved_b
+        pod["dcn"]["dcn_sync_saved_bytes_total"] = dcn_sync_saved_b
+    out["pod"] = pod or None
     return out
 
 
@@ -526,11 +567,43 @@ def render_profile_text(summary: dict) -> str:
     for ing in summary.get("ingest") or []:
         tiers = ing.get("tiers") or {}
         tier_s = " ".join(f"{k}={v}" for k, v in sorted(tiers.items()))
+        src_b = ing.get("source_bytes")
         lines.append(
             f"ingest[{ing.get('mode')}]: {ing.get('files')} files "
             f"x{ing.get('pool_width')} pool in {ing.get('wall_s')}s "
-            f"(inflate {ing.get('inflate_s')}s parse {ing.get('parse_s')}s "
+            + (f"[host {ing.get('host_index')}: {src_b:,}B source] "
+               if isinstance(src_b, (int, float)) and src_b else "")
+            + f"(inflate {ing.get('inflate_s')}s parse {ing.get('parse_s')}s "
             f"write {ing.get('write_s')}s; {tier_s})")
+    pod = summary.get("pod") or {}
+    if pod.get("hosts"):
+        agree = pod.get("order_digest_agree")
+        shard = pod.get("shard_digest_agree")
+        dis = pod.get("digest_disagreements") or 0
+        lines.append(
+            f"pod data plane: {len(pod['hosts'])} hosts, "
+            f"{pod.get('skew_epochs')} skew epoch(s), order digest "
+            + ("agree" if agree else "-" if agree is None else "DISAGREE")
+            + ", shard digest "
+            + ("agree" if shard else "-" if shard is None else "DISAGREE")
+            + (f" ({dis} disagreement(s) across run)" if dis else ""))
+        for r in pod["hosts"]:
+            ib = r.get("ingest_bytes")
+            lines.append(
+                f"  host {r.get('host', '?')}[{r.get('rank', '?')}]: "
+                f"input {r.get('input_s')}s"
+                + (f" ingest {ib:,}B/{r.get('ingest_s')}s"
+                   if isinstance(ib, (int, float)) else ""))
+    dcn = pod.get("dcn") or {}
+    if dcn:
+        lines.append(
+            f"dcn placement: {dcn.get('hosts')} hosts x "
+            f"{dcn.get('slices')} slice(s), per-host input "
+            f"{dcn.get('input_local_bytes'):,}B local / "
+            f"{dcn.get('input_dcn_bytes'):,}B cross-DCN; saved "
+            f"{dcn.get('input_dcn_saved_bytes_total'):,}B input + "
+            f"{dcn.get('dcn_sync_saved_bytes_total'):,}B sync "
+            f"(local-SGD window {dcn.get('local_sgd_window')})")
     comp = summary.get("compiled_functions") or {}
     if comp:
         lines.append("compiled functions (by cost):")

@@ -117,3 +117,22 @@ def data_parallel_mesh(num_devices: Optional[int] = None) -> Mesh:
 def host_shard_info(mesh: Mesh) -> tuple[int, int]:
     """(host_index, num_hosts) for input-file sharding under multi-host SPMD."""
     return jax.process_index(), jax.process_count()
+
+
+def dcn_topology(mesh: Optional[Mesh] = None) -> dict:
+    """Process/slice topology summary for the pod data plane's
+    `dcn_placement` journal row: how many feeding processes and TPU slices
+    the mesh spans (collectives cross DCN only when slices > 1) and this
+    process's device share.  Pure local introspection — no collectives."""
+    devices = (list(np.asarray(mesh.devices).flat) if mesh is not None
+               else list(jax.devices()))
+    me = jax.process_index()
+    return {
+        "processes": jax.process_count(),
+        "process_index": me,
+        "devices": len(devices),
+        "local_devices": sum(
+            1 for d in devices
+            if getattr(d, "process_index", 0) == me),
+        "slices": _num_slices(devices),
+    }

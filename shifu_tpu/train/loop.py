@@ -1365,6 +1365,21 @@ def train(job: JobConfig,
         obs.flush()
         if timing_on:
             console(timer.console_line())
+        # epoch identity, computed once and shared by the straggler line's
+        # cross-host skew row and the overlap report below: which tier
+        # actually served the epoch, and the determinism digest of its
+        # global batch order
+        tier = ("stream" if streamed_this_epoch else
+                "resident" if use_resident else
+                "staged" if use_staged else "batch")
+        digest_rows = 0
+        if train_ds is not None:
+            digest_rows = (min_host_rows
+                           if multihost and tier in ("staged", "resident")
+                           else train_ds.num_rows)
+        order_digest = pipe.epoch_order_digest(
+            tier, digest_rows, local_bs, shuffle=job.data.shuffle,
+            seed=job.data.shuffle_seed, epoch=epoch)
         if multihost:
             # slowest-first per-host line on the chief (collective — every
             # rank contributes; successor of the AM's worker-stats sort,
@@ -1381,8 +1396,31 @@ def train(job: JobConfig,
                 input_s = sum(host_input_times)
             else:
                 input_s = sum(timer.input_times)
-            prof_lib.straggler_line(epoch, epoch_time, valid_time,
-                                    input_s, console)
+            # pod data plane extras ride the skew row's allgather: each
+            # host's cumulative source-ingest cost (a slow-ingest host is
+            # visible as the straggler cause), its epoch order digest, and
+            # its view of the global shard assignment — the chief journals
+            # per-epoch cross-host agreement on both digests in the
+            # host_skew row (obs/aggregate.epoch_skew)
+            reg = obs.default_registry()
+            try:
+                shard_digest = pipe.shard_assignment_digest(
+                    pipe.count_source_files(job.data), nproc,
+                    seed=job.data.shuffle_seed, epoch=epoch,
+                    mode=job.data.host_shard)
+            except OSError:
+                shard_digest = None  # source paths gone mid-run: skew row
+                # still ships, the audit marks the digest unavailable
+            prof_lib.straggler_line(
+                epoch, epoch_time, valid_time, input_s, console,
+                extra={
+                    "ingest_bytes": int(reg.counter(
+                        "ingest_source_bytes_total").total()),
+                    "ingest_s": round(reg.counter(
+                        "ingest_seconds_total").total(), 3),
+                    "order_digest": order_digest,
+                    "shard_digest": shard_digest,
+                })
 
         # early-stopping bookkeeping runs BEFORE the terminal checkpoint
         # save so that checkpoint holds the same best-measured params the
@@ -1469,10 +1507,9 @@ def train(job: JobConfig,
         # difference — host input work that overlapped device compute.
         # `order_digest` pins the determinism contract: a pure function of
         # (seed, epoch, tier), byte-identical with overlap on or off and
-        # across a restart resume (tests/test_overlap.py).
-        tier = ("stream" if streamed_this_epoch else
-                "resident" if use_resident else
-                "staged" if use_staged else "batch")
+        # across a restart resume (tests/test_overlap.py).  `tier`,
+        # `digest_rows` and `order_digest` were computed above, before the
+        # straggler line that shares them.
         exposed_s = sum(timer.input_times)
         if feeder is not None:
             prod_s = feeder.production_seconds(epoch)
@@ -1481,14 +1518,6 @@ def train(job: JobConfig,
         else:
             prod_s = exposed_s  # untimed producer: nothing provably hidden
         hidden_s = max(prod_s - exposed_s, 0.0)
-        digest_rows = 0
-        if train_ds is not None:
-            digest_rows = (min_host_rows
-                           if multihost and tier in ("staged", "resident")
-                           else train_ds.num_rows)
-        order_digest = pipe.epoch_order_digest(
-            tier, digest_rows, local_bs, shuffle=job.data.shuffle,
-            seed=job.data.shuffle_seed, epoch=epoch)
         eff = (hidden_s / (hidden_s + exposed_s)
                if hidden_s + exposed_s > 0 else None)
         obs.event("overlap_report", epoch=epoch, tier=tier,
@@ -1508,6 +1537,36 @@ def train(job: JobConfig,
                       pipe.resident_feature_format(
                           job.schema, job.data, job.model.compute_dtype)
                       if use_resident else None))
+        if multihost:
+            # DCN placement ledger, next to the overlap report it refines:
+            # per-host batch construction (shard_batch_process_local /
+            # shard_blocks_process_local) lands each host's slice on its
+            # OWN devices' DATA-axis shards, so steady-state input traffic
+            # crosses zero DCN links — the analytic savings vs a
+            # replicated input plane (every host shipping every batch) is
+            # (n_hosts - 1) x the local wire bytes.  The local-SGD window
+            # piggybacks its own DCN savings: each skipped per-step grad
+            # sync would have moved ~param_bytes across the slice boundary.
+            topo = mesh_lib.dcn_topology(mesh)
+            local_input_b = int(digest_rows) * int(row_wire_b)
+            spe = int(steps_per_epoch or 0)
+            k_win_now = int(job.train.local_sgd_window)
+            sync_rounds = (spe // k_win_now if k_win_now > 0 else spe)
+            sync_skipped = max(spe - sync_rounds, 0) if k_win_now > 0 else 0
+            param_b = sum(
+                int(leaf.size) * int(np.dtype(leaf.dtype).itemsize)
+                for leaf in jax.tree_util.tree_leaves(state.params))
+            obs.event("dcn_placement", epoch=epoch, tier=tier,
+                      hosts=topo["processes"], slices=topo["slices"],
+                      local_devices=topo["local_devices"],
+                      input_local_bytes=local_input_b,
+                      input_dcn_bytes=0,
+                      input_dcn_saved_bytes=(
+                          (topo["processes"] - 1) * local_input_b),
+                      local_sgd_window=k_win_now,
+                      sync_rounds=sync_rounds,
+                      sync_rounds_skipped=sync_skipped,
+                      dcn_sync_saved_bytes=sync_skipped * param_b)
         hid_c = obs.counter("overlap_hidden_seconds_total",
                             "input seconds hidden behind device compute "
                             "by the overlap engine")

@@ -124,7 +124,8 @@ class StepTimer:
 
 
 def straggler_line(epoch: int, epoch_time: float, valid_time: float,
-                   input_seconds: float, console) -> None:
+                   input_seconds: float, console,
+                   extra: Optional[dict] = None) -> None:
     """Cross-host per-epoch timing aggregation — the successor of the
     reference AM's slowest-first worker sort (appmaster/
     TensorflowSession.java:515-549: every worker's TrainingIntermediateResult
@@ -146,11 +147,15 @@ def straggler_line(epoch: int, epoch_time: float, valid_time: float,
 
     Implementation lives in obs/aggregate.py since the telemetry
     unification: the same gather also journals a `host_skew` event, so the
-    table survives the run as structured data, not just a log line."""
+    table survives the run as structured data, not just a log line.
+
+    `extra` fields (pod data plane: cumulative ingest bytes/seconds, epoch
+    order digest, shard-assignment digest) ride each host's row through the
+    same gather — one allgather per epoch, never two."""
     from .. import obs
 
     obs.aggregate.epoch_skew(epoch, input_seconds, epoch_time, valid_time,
-                             console=console)
+                             console=console, extra=extra)
 
 
 @contextlib.contextmanager

@@ -310,7 +310,7 @@ def test_status_quick_summary_carries_goodput(tmp_path, monkeypatch):
 def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7,
               cold=300.0, hbm=1 << 30, serving=250_000.0,
               serving_p99=6.0, sparse=1.3, ft_mfu=0.31, fleet_eff=0.8,
-              cold_start=40.0):
+              cold_start=40.0, train_eff=0.8):
     return {"value": value, "unit": "samples/sec/chip",
             "goodput": {"goodput_fraction_mean": goodput_frac},
             "xla_compiles": {"total": compiles},
@@ -322,7 +322,8 @@ def _artifact(value=100.0, goodput_frac=0.5, compiles=10, ceiling=0.7,
             "ladder_deepfm_4mvocab_sparse_speedup": sparse,
             "ft_transformer_mfu": ft_mfu,
             "fleet_scaling_efficiency": fleet_eff,
-            "serving_cold_start_ms": cold_start}
+            "serving_cold_start_ms": cold_start,
+            "train_scaling_efficiency": train_eff}
 
 
 @pytest.mark.perf
@@ -441,6 +442,24 @@ def test_perf_gate_fails_each_axis():
     r = perf_gate.run_gate(_artifact(fleet_eff=0.5),
                            _artifact(fleet_eff=0.5))
     assert r["verdict"] == "PASS"
+    # multi-host data-plane scaling collapse (below the 0.6 floor,
+    # ISSUE 20): one host's ingest dominates the interleave
+    r = perf_gate.run_gate(_artifact(train_eff=0.3), base)
+    assert r["verdict"] == "REGRESSION"
+    assert [c for c in r["checks"]
+            if c["name"] == "train_scaling_efficiency"][0]["status"] \
+        == "REGRESSION"
+    # ...above the floor passes even below the baseline (floor-style)
+    r = perf_gate.run_gate(_artifact(train_eff=0.65), base)
+    assert r["verdict"] == "PASS"
+    # ...and a pre-ratchet 0.5 baseline gates against itself, so a
+    # further bleed to 0.45 still fails
+    r = perf_gate.run_gate(_artifact(train_eff=0.5),
+                           _artifact(train_eff=0.5))
+    assert r["verdict"] == "PASS"
+    r = perf_gate.run_gate(_artifact(train_eff=0.45),
+                           _artifact(train_eff=0.5))
+    assert r["verdict"] == "REGRESSION"
     # serving cold-start explosion (above the 3x --cold-start-factor
     # default): a lost AOT pack degrades spawn-to-ready back to live
     # jit compiles (ISSUE 19)
@@ -475,7 +494,7 @@ def test_perf_gate_fails_each_axis():
     # still gates the axes it carries
     r = perf_gate.run_gate({"value": 100.0}, base)
     assert r["verdict"] == "PASS"
-    assert [c["status"] for c in r["checks"]] == ["OK"] + ["SKIP"] * 11
+    assert [c["status"] for c in r["checks"]] == ["OK"] + ["SKIP"] * 12
 
 
 @pytest.mark.perf
@@ -516,7 +535,7 @@ def test_perf_gate_cli_pass_fail_and_check_only(tmp_path):
         _artifact(value=10.0, goodput_frac=0.1, compiles=100, ceiling=0.1,
                   cold=10.0, hbm=8 << 30, serving=10_000.0,
                   serving_p99=90.0, sparse=0.5, ft_mfu=0.05,
-                  fleet_eff=0.1, cold_start=900.0)))
+                  fleet_eff=0.1, cold_start=900.0, train_eff=0.1)))
 
     def run(*args):
         return subprocess.run([sys.executable, gate, *args],

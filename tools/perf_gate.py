@@ -3,7 +3,7 @@ BENCH baseline and exit nonzero on regression.
 
 The repo's first *enforceable* perf trajectory (ISSUE 3): every round the
 driver captures a `BENCH_r*.json`; this gate compares a freshly produced
-`bench_full.json` against the newest of those baselines on twelve axes —
+`bench_full.json` against the newest of those baselines on thirteen axes —
 
 - **throughput / step time**: the headline resident-tier
   samples/sec/chip (`value`) must not fall below
@@ -69,6 +69,16 @@ driver captures a `BENCH_r*.json`; this gate compares a freshly produced
   (tunnel-drift-immune): a serialized router, a lost connection
   pool, or a head-of-line lock would collapse it toward 1/n while
   single-daemon capacity survives.
+- **train scaling efficiency**: `train_scaling_efficiency` (the pod
+  data plane's ingest-scaling ratio from bench.py's multi-host dryrun
+  sweep, ISSUE 20 — single-host ingest seconds divided by `n_hosts x`
+  the slowest host's ingest seconds at the widest sweep width) must
+  not fall below `min(--train-eff-floor, baseline)` — ratchet-floor
+  style like the fleet axis because the field is a same-run ratio
+  (tunnel-drift-immune): a broken shard assignment that piles files
+  onto one host, or a per-host fixed cost that swamps the sharded
+  ingest, collapses it toward 1/n while the single-host parse axes
+  stay green.
 - **serving cold-start**: `serving_cold_start_ms` (time-from-spawn to
   the first healthy wire response on the AOT leg of bench.py's
   `local:2` fleet drill, ISSUE 19) must not exceed `baseline *
@@ -182,6 +192,7 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
              sparse_floor: float = 1.0,
              ft_mfu_floor: float = 0.25,
              fleet_eff_floor: float = 0.6,
+             train_eff_floor: float = 0.6,
              e2e_ceiling_floor: float = 0.5,
              cold_start_factor: float = 3.0) -> dict:
     """The comparison itself (pure — unit-tested on synthetic pairs).
@@ -346,6 +357,24 @@ def run_gate(fresh: dict, baseline: dict, value_threshold: float = 0.3,
         check("fleet_scaling_efficiency", ffe, bfe, ffe >= limit,
               round(limit, 4))
 
+    # train scaling efficiency: the pod data plane's ingest-scaling
+    # ratio from the multi-host dryrun sweep (ISSUE 20).  Same
+    # ratchet-floor shape as the fleet axis — the field is a same-run
+    # ratio of ingest seconds, immune to tunnel/co-tenant drift, and a
+    # regression means the SHARD ASSIGNMENT went lopsided (one host
+    # ingesting most of the bytes) or a per-host fixed cost grew to
+    # rival the sharded ingest itself, while the single-host parse
+    # axes stay green.  SKIP when either side predates the pod data
+    # plane.
+    fte = _num(fresh, "train_scaling_efficiency")
+    bte = _num(baseline, "train_scaling_efficiency")
+    if fte is None or bte is None or bte <= 0:
+        check("train_scaling_efficiency", fte, bte, None, None)
+    else:
+        limit = min(train_eff_floor, bte)
+        check("train_scaling_efficiency", fte, bte, fte >= limit,
+              round(limit, 4))
+
     # serving cold-start: spawn-to-first-healthy-response on the AOT
     # leg of bench.py's fleet drill (ISSUE 19).  Upper bound,
     # factor-style like p99: the number is wall-clock on a shared host,
@@ -433,6 +462,12 @@ def main(argv=None) -> int:
                         "min(this, baseline) (the fleet's scores/s over "
                         "n_daemons x single-daemon capacity, ISSUE 12; "
                         "SKIP when either side lacks the field)")
+    p.add_argument("--train-eff-floor", type=float, default=0.6,
+                   help="fresh train_scaling_efficiency must be >= "
+                        "min(this, baseline) (the pod data plane's "
+                        "ingest scaling from the multi-host dryrun "
+                        "sweep, ISSUE 20; SKIP when either side lacks "
+                        "the field)")
     p.add_argument("--cold-start-factor", type=float, default=3.0,
                    help="fresh serving_cold_start_ms must be <= baseline * "
                         "this factor (the AOT-packed fleet cold-start "
@@ -490,6 +525,7 @@ def main(argv=None) -> int:
                       sparse_floor=args.sparse_floor,
                       ft_mfu_floor=args.ft_mfu_floor,
                       fleet_eff_floor=args.fleet_eff_floor,
+                      train_eff_floor=args.train_eff_floor,
                       e2e_ceiling_floor=args.e2e_ceiling_floor,
                       cold_start_factor=args.cold_start_factor)
     report["fresh"] = args.fresh

@@ -11,7 +11,7 @@ device_profile event dict).
 
 Usage:
     python tools/trace_diff.py <run_A> <run_B> [--epoch N] [--json]
-        [--fail-above PCT] [--serving]
+        [--fail-above PCT] [--serving | --pod]
 
 By default the LAST device_profile of each journal is compared (`--epoch`
 selects a specific captured epoch).  `--fail-above 50` exits 1 when any
@@ -26,6 +26,15 @@ side's last `loadtest_report` (p50/p99/rate + per-stage means), its
 ISSUE 19 — the aot-vs-jit spread) from the journal tail.  An axis absent on either side gets status SKIP, never a
 verdict — perf_gate semantics: a journal predating the tracing layer
 must not fail the gate, it just can't vouch for the new axes.
+
+`--pod` diffs the pod data plane (ISSUE 20): each side's per-host
+cumulative ingest seconds/bytes and the derived
+`train_scaling_efficiency`, read from the run dir's merged per-rank
+journals (`pod_epoch_close` rows / chief `host_skew` per-host rows) or
+from a bench artifact JSON that recorded the sweep.  `--fail-above` is
+direction-aware here too: efficiency regresses DOWN, per-host ingest
+seconds regress UP, and ingest bytes are informational (the gated
+balance check is `shifu-tpu pod-verify`'s job).
 """
 
 from __future__ import annotations
@@ -74,11 +83,18 @@ def load_rollup(path: str, epoch: int | None = None) -> dict:
     return profiles[-1]
 
 
-# serving axes where a BIGGER number is the good direction (everything
-# else — latencies, hedge rate — regresses upward)
-_HIGHER_IS_BETTER = frozenset(("achieved_scores_per_sec",))
-# volume axes: informational only, never gated
-_UNGATED = frozenset(("route.count",))
+# axes where a BIGGER number is the good direction (everything
+# else — latencies, hedge rate, per-host ingest seconds — regresses upward)
+_HIGHER_IS_BETTER = frozenset(("achieved_scores_per_sec",
+                               "train_scaling_efficiency"))
+# volume axes: informational only, never gated (per-host ingest BYTES are
+# a property of the dataset and the shard width, not a perf verdict —
+# the gated balance check lives in `shifu-tpu pod-verify`)
+_UNGATED = frozenset(("route.count", "hosts"))
+
+
+def _ungated(axis: str) -> bool:
+    return axis in _UNGATED or axis.endswith(".ingest_bytes")
 
 
 def _serving_axes(report: dict, routes: list,
@@ -156,14 +172,9 @@ def load_serving_axes(path: str) -> dict:
     return axes
 
 
-def _diff_serving(args) -> int:
-    try:
-        a = load_serving_axes(args.run_a)
-        b = load_serving_axes(args.run_b)
-    except (OSError, ValueError) as e:
-        print(f"trace-diff: {e}", file=sys.stderr, flush=True)
-        return EXIT_USAGE
-
+def _diff_axis_table(a: dict, b: dict, args, mode: str) -> int:
+    """Shared axis-table diff: direction-aware --fail-above gating,
+    SKIP for axes absent on either side, text or --json report."""
     limit = (1.0 + args.fail_above / 100.0) \
         if args.fail_above is not None else None
     rows = []
@@ -176,7 +187,7 @@ def _diff_serving(args) -> int:
             row["delta"] = round(vb - va, 4)
             row["ratio"] = round(vb / va, 4) if va > 0 else None
             row["status"] = "OK"
-            if limit is not None and va > 0 and axis not in _UNGATED:
+            if limit is not None and va > 0 and not _ungated(axis):
                 worse = (vb < va / limit if axis in _HIGHER_IS_BETTER
                          else vb > va * limit)
                 if worse:
@@ -184,12 +195,12 @@ def _diff_serving(args) -> int:
                     blamed.append(axis)
         rows.append(row)
     verdict = "REGRESSION" if blamed else "PASS"
-    report = {"a": args.run_a, "b": args.run_b, "mode": "serving",
+    report = {"a": args.run_a, "b": args.run_b, "mode": mode,
               "axes": rows, "blamed": blamed, "verdict": verdict}
     if args.json:
         print(json.dumps(report))
     else:
-        print(f"trace-diff: {verdict} — serving plane, "
+        print(f"trace-diff: {verdict} — {mode} plane, "
               f"{len(rows)} axis(es), "
               f"{sum(1 for r in rows if r['status'] == 'SKIP')} skipped")
         print(f"  {'axis':<28} {'A':>12} {'B':>12} {'delta':>10} "
@@ -204,6 +215,84 @@ def _diff_serving(args) -> int:
         if blamed:
             print("  blamed: " + ", ".join(blamed))
     return EXIT_PASS if verdict == "PASS" else EXIT_REGRESSION
+
+
+def _diff_serving(args) -> int:
+    try:
+        a = load_serving_axes(args.run_a)
+        b = load_serving_axes(args.run_b)
+    except (OSError, ValueError) as e:
+        print(f"trace-diff: {e}", file=sys.stderr, flush=True)
+        return EXIT_USAGE
+    return _diff_axis_table(a, b, args, "serving")
+
+
+def load_pod_axes(path: str) -> dict:
+    """One side's pod data-plane decomposition: a run dir (merged
+    per-rank journals — `pod_epoch_close` rows from data-dryrun gangs or
+    the per-host rows inside chief `host_skew` events) or a bench
+    artifact JSON carrying `train_scaling_efficiency`.
+
+    From journals, each rank's LAST close row wins (the journaled
+    ingest fields are cumulative counter totals), and
+    `train_scaling_efficiency` is derived as
+    `sum(rank ingest_s) / (hosts x max(rank ingest_s))` — 1.0 when the
+    shard assignment splits the ingest evenly, toward 1/n when one host
+    ingests everything.  Matches bench.py's sweep definition (there t1
+    IS the total work, measured single-host)."""
+    if os.path.isfile(path) and not path.endswith(".jsonl"):
+        with open(path) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict) \
+                and doc.get("train_scaling_efficiency") is not None:
+            axes = {"train_scaling_efficiency":
+                    float(doc["train_scaling_efficiency"])}
+            ts = doc.get("train_scaling") or {}
+            for r, v in enumerate(ts.get("host_ingest_s_n4") or ()):
+                axes[f"host.{r}.ingest_s"] = float(v)
+            for r, v in enumerate(ts.get("host_ingest_bytes_n4") or ()):
+                axes[f"host.{r}.ingest_bytes"] = float(v)
+            return axes
+        raise ValueError(f"{path}: no train_scaling_efficiency field "
+                         "(expected a bench artifact from a round with "
+                         "the pod data plane)")
+    from shifu_tpu.launcher.pod import _pod_close_rows
+    from shifu_tpu.obs import timeline as timeline_mod
+    merged = timeline_mod.load_merged(path, tail_bytes=None)
+    if merged is None:
+        raise ValueError(f"{path}: no telemetry journal found")
+    rows = _pod_close_rows(merged["events"])
+    if not rows:
+        raise ValueError(
+            f"{path}: no pod data-plane rows (pod_epoch_close events or "
+            "host_skew per-host rows) — run a multi-host job or "
+            "`shifu-tpu data-dryrun` first")
+    last: dict = {}
+    for r in rows:  # merged stream is time-ordered: later rows win
+        last[r["rank"]] = r
+    axes: dict = {"hosts": float(len(last))}
+    per_s = []
+    for rank, r in sorted(last.items()):
+        s = r.get("ingest_s")
+        if isinstance(s, (int, float)):
+            axes[f"host.{rank}.ingest_s"] = round(float(s), 4)
+            per_s.append(float(s))
+        if isinstance(r.get("ingest_bytes"), (int, float)):
+            axes[f"host.{rank}.ingest_bytes"] = float(r["ingest_bytes"])
+    if per_s and max(per_s) > 0:
+        axes["train_scaling_efficiency"] = round(
+            sum(per_s) / (len(per_s) * max(per_s)), 4)
+    return axes
+
+
+def _diff_pod(args) -> int:
+    try:
+        a = load_pod_axes(args.run_a)
+        b = load_pod_axes(args.run_b)
+    except (OSError, ValueError) as e:
+        print(f"trace-diff: {e}", file=sys.stderr, flush=True)
+        return EXIT_USAGE
+    return _diff_axis_table(a, b, args, "pod")
 
 
 def main(argv=None) -> int:
@@ -228,10 +317,24 @@ def main(argv=None) -> int:
                    help="diff the serving plane (loadtest stage means + "
                         "route_trace hop/queue aggregates) instead of "
                         "device kernels; missing axes SKIP, never fail")
+    p.add_argument("--pod", action="store_true",
+                   help="diff the pod data plane (per-host ingest "
+                        "seconds/bytes + derived train_scaling_"
+                        "efficiency from pod_epoch_close / host_skew "
+                        "journal rows, or a bench artifact's recorded "
+                        "value) instead of device kernels; "
+                        "direction-aware --fail-above, missing axes "
+                        "SKIP, ingest bytes informational only")
     args = p.parse_args(argv)
 
+    if args.serving and args.pod:
+        print("trace-diff: --serving and --pod are mutually exclusive",
+              file=sys.stderr, flush=True)
+        return EXIT_USAGE
     if args.serving:
         return _diff_serving(args)
+    if args.pod:
+        return _diff_pod(args)
 
     from shifu_tpu.obs import tracefmt
 
